@@ -1,0 +1,25 @@
+"""tpu-push-cdn: a TPU-native publish/subscribe + direct-messaging framework.
+
+A brand-new design with the capabilities of EspressoSystems/Push-CDN
+(reference layer map in SURVEY.md): a marshal (authentication gateway /
+load balancer), a mesh of brokers routing broadcast + direct messages via
+eventually-consistent (versioned-map CRDT) state, and an elastic
+self-reconnecting client.
+
+Architecture (TPU-first, not a port):
+
+- **Host control plane** (``pushcdn_tpu.proto``, ``.broker``, ``.marshal``,
+  ``.client``): asyncio transports, authenticated handshakes, discovery,
+  supervision. Mirrors the *capabilities* of the reference's Rust actor
+  stack (cdn-proto / cdn-broker / cdn-marshal / cdn-client).
+- **Device data plane** (``pushcdn_tpu.parallel``, ``.ops``): broker shards
+  mapped onto a ``jax.sharding.Mesh``; message frames packed into
+  HBM-resident byte tensors; broadcast fan-out as masked ``all_gather`` and
+  direct routing as ``ppermute``/all-to-all over ICI; topic-subscription
+  masking and frame scatter/gather as Pallas kernels; the versioned-map CRDT
+  merge as a vectorized jittable kernel.
+"""
+
+__version__ = "0.1.0"
+
+from pushcdn_tpu.proto.error import Error, ErrorKind  # noqa: F401

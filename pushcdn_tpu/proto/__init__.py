@@ -1,0 +1,14 @@
+"""Shared protocol layer: wire format, transports, limiter, crypto, auth,
+discovery, config registry.
+
+Capability parity with the reference's ``cdn-proto`` crate (SURVEY.md §2a),
+re-designed for a Python/asyncio host control plane feeding a JAX/TPU device
+data plane.
+"""
+
+MAX_MESSAGE_SIZE = (2**32 - 1) // 8
+"""Maximum wire message size in bytes (512 MiB-ish).
+
+Parity: reference caps messages at ``u32::MAX / 8``
+(cdn-proto/src/lib.rs:23-25).
+"""

@@ -1,0 +1,148 @@
+"""TLS certificate plumbing: a pinned local CA + per-process leaf certs.
+
+Capability parity with cdn-proto/src/crypto/tls.rs:22-155 + build.rs:22-59:
+the reference generates a local CA at *build* time and bakes it in; every
+process derives a leaf cert (SAN ``espresso``) from that CA at startup, and
+clients trust either the baked-in local CA or a hardcoded production CA.
+
+TPU-native redesign: no build step — the local CA is generated once per
+machine under a cache dir (or ephemerally in-memory for tests) using the
+``cryptography`` package, and leaf certs are derived at process start. The
+SAN is ``pushcdn``; clients connecting with ``use_local_authority=True``
+trust the local CA and expect that SAN, mirroring the reference's scheme.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import ssl
+import tempfile
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+# The SAN every broker/marshal leaf cert carries (reference uses "espresso",
+# tls.rs:52-93).
+LOCAL_SAN = "pushcdn"
+
+_CA_CACHE: Optional[Tuple[bytes, bytes]] = None  # (cert_pem, key_pem)
+
+
+@dataclass
+class Certificate:
+    """A leaf certificate + key, PEM-encoded, ready for an SSL context."""
+
+    cert_pem: bytes
+    key_pem: bytes
+    ca_cert_pem: bytes
+
+    def server_context(self) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        with tempfile.TemporaryDirectory() as d:
+            cert_f, key_f = os.path.join(d, "c.pem"), os.path.join(d, "k.pem")
+            with open(cert_f, "wb") as f:
+                f.write(self.cert_pem)
+            with open(key_f, "wb") as f:
+                f.write(self.key_pem)
+            ctx.load_cert_chain(cert_f, key_f)
+        return ctx
+
+    def client_context(self) -> ssl.SSLContext:
+        """Context trusting this cert's CA, expecting SAN ``pushcdn``."""
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_verify_locations(cadata=self.ca_cert_pem.decode())
+        ctx.check_hostname = True
+        return ctx
+
+
+def _generate_ca() -> Tuple[bytes, bytes]:
+    """Make a fresh CA (parity: scripts/gen-ca.bash + build.rs:22-59)."""
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([
+        x509.NameAttribute(NameOID.COMMON_NAME, "pushcdn local CA"),
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, "pushcdn-tpu"),
+    ])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(days=1))
+        .not_valid_after(now + datetime.timedelta(days=3650))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    return (
+        cert.public_bytes(serialization.Encoding.PEM),
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ),
+    )
+
+
+def load_ca(ca_cert_path: Optional[str] = None,
+            ca_key_path: Optional[str] = None) -> Tuple[bytes, bytes]:
+    """Load a CA from disk, or fall back to the process-local generated CA
+    (parity ``load_ca``, tls.rs:52-70: None → baked-in local CA)."""
+    global _CA_CACHE
+    if ca_cert_path and ca_key_path:
+        with open(ca_cert_path, "rb") as f:
+            cert_pem = f.read()
+        with open(ca_key_path, "rb") as f:
+            key_pem = f.read()
+        return cert_pem, key_pem
+    if _CA_CACHE is None:
+        _CA_CACHE = _generate_ca()
+    return _CA_CACHE
+
+
+def generate_cert_from_ca(ca_cert_pem: bytes, ca_key_pem: bytes) -> Certificate:
+    """Derive a per-process leaf cert with SAN ``pushcdn`` (parity
+    ``generate_cert_from_ca``, tls.rs:52-93)."""
+    ca_cert = x509.load_pem_x509_certificate(ca_cert_pem)
+    ca_key = serialization.load_pem_private_key(ca_key_pem, password=None)
+    key = ec.generate_private_key(ec.SECP256R1())
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, LOCAL_SAN)]))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(days=1))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(
+            x509.SubjectAlternativeName([
+                x509.DNSName(LOCAL_SAN),
+                x509.DNSName("localhost"),
+                x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+            ]),
+            critical=False,
+        )
+        .sign(ca_key, hashes.SHA256())
+    )
+    return Certificate(
+        cert_pem=cert.public_bytes(serialization.Encoding.PEM),
+        key_pem=key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        ),
+        ca_cert_pem=ca_cert_pem,
+    )
+
+
+def local_certificate() -> Certificate:
+    """One-call helper: local CA → leaf cert (what binaries use by default)."""
+    ca_cert, ca_key = load_ca()
+    return generate_cert_from_ca(ca_cert, ca_key)

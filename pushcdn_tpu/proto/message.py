@@ -1,0 +1,327 @@
+"""Wire format: the 9-variant ``Message`` model and its canonical binary codec.
+
+Capability parity with the reference's Cap'n Proto envelope + hand-written
+enum (cdn-proto/src/message.rs:83-105 for the variants, :107-457 for
+serialize/deserialize; schema in cdn-proto/schema/messages.capnp). Redesigned
+TPU-first instead of using capnp:
+
+- **Flat little-endian layout with the payload last.** The payload of the two
+  hot variants (``Direct``, ``Broadcast``) is the *unprefixed tail* of the
+  frame, so (a) decoding is zero-copy (a ``memoryview`` into the recv
+  buffer), and (b) a frame can be dropped into a fixed-width HBM byte-tensor
+  slot where ``payload_offset``/``length`` are plain int32 columns — see
+  ``pushcdn_tpu.parallel.frames`` for the tensor packing.
+- **One-byte kind tag** doubles as the on-device ``kind`` column.
+- Sync payloads (``UserSync``/``TopicSync``) are opaque bytes whose interior
+  is produced by the CRDT codec (parity with the reference nesting rkyv
+  archives inside the capnp envelope, cdn-broker/src/tasks/broker/sync.rs:24-40).
+
+Permit semantics (parity message.rs:338-341): in ``AuthenticateResponse``,
+``permit == 0`` means failure (see ``context``), ``1`` means success/ack, and
+``> 1`` is an actual redeemable permit.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import List, Sequence, Tuple, Union
+
+from pushcdn_tpu.proto import MAX_MESSAGE_SIZE
+from pushcdn_tpu.proto.error import ErrorKind, bail
+
+BytesLike = Union[bytes, bytearray, memoryview]
+
+# Topic is a plain small int on the wire (parity: `type Topic = u8`,
+# message.rs:26). Validation/pruning lives in pushcdn_tpu.proto.topic.
+Topic = int
+
+# --- kind tags (the u8 discriminant; stable — also used on-device) ---------
+KIND_AUTHENTICATE_WITH_KEY = 1
+KIND_AUTHENTICATE_WITH_PERMIT = 2
+KIND_AUTHENTICATE_RESPONSE = 3
+KIND_DIRECT = 4
+KIND_BROADCAST = 5
+KIND_SUBSCRIBE = 6
+KIND_UNSUBSCRIBE = 7
+KIND_USER_SYNC = 8
+KIND_TOPIC_SYNC = 9
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_U16 = struct.Struct("<H")
+
+
+@dataclass(frozen=True, slots=True)
+class AuthenticateWithKey:
+    """User → marshal: prove key ownership by signing a unix timestamp.
+
+    Parity: message.rs AuthenticateWithKey {public_key, timestamp, signature};
+    flow in cdn-proto/src/connection/auth/user.rs:50-86.
+    """
+
+    public_key: bytes
+    timestamp: int  # unix seconds, checked ±5 s by the marshal
+    signature: bytes
+
+    kind = KIND_AUTHENTICATE_WITH_KEY
+
+
+@dataclass(frozen=True, slots=True)
+class AuthenticateWithPermit:
+    """User → broker: redeem the marshal-issued permit (message.rs)."""
+
+    permit: int
+
+    kind = KIND_AUTHENTICATE_WITH_PERMIT
+
+
+@dataclass(frozen=True, slots=True)
+class AuthenticateResponse:
+    """Marshal/broker → user: permit semantics 0=fail, 1=ack, >1=permit.
+
+    ``context`` is the broker endpoint on marshal success, or the failure
+    reason (parity message.rs:338-341 and auth/marshal.rs:138-144).
+    """
+
+    permit: int
+    context: str = ""
+
+    kind = KIND_AUTHENTICATE_RESPONSE
+
+
+@dataclass(frozen=True, slots=True)
+class Direct:
+    """Point-to-point message to ``recipient`` (a serialized public key).
+
+    Hot-path variant: ``message`` is the unprefixed frame tail (zero-copy).
+    Parity: message.rs Direct {recipient, message}.
+    """
+
+    recipient: bytes
+    message: BytesLike
+
+    kind = KIND_DIRECT
+
+
+@dataclass(frozen=True, slots=True)
+class Broadcast:
+    """Publish to every subscriber of ``topics``.
+
+    Hot-path variant: ``message`` is the unprefixed frame tail (zero-copy).
+    Parity: message.rs Broadcast {topics, message}.
+    """
+
+    topics: Tuple[Topic, ...]
+    message: BytesLike
+
+    kind = KIND_BROADCAST
+
+    def __init__(self, topics: Sequence[Topic], message: BytesLike):
+        object.__setattr__(self, "topics", tuple(topics))
+        object.__setattr__(self, "message", message)
+
+
+@dataclass(frozen=True, slots=True)
+class Subscribe:
+    """User → broker: add topic subscriptions (message.rs Subscribe)."""
+
+    topics: Tuple[Topic, ...]
+
+    kind = KIND_SUBSCRIBE
+
+    def __init__(self, topics: Sequence[Topic]):
+        object.__setattr__(self, "topics", tuple(topics))
+
+
+@dataclass(frozen=True, slots=True)
+class Unsubscribe:
+    """User → broker: drop topic subscriptions (message.rs Unsubscribe)."""
+
+    topics: Tuple[Topic, ...]
+
+    kind = KIND_UNSUBSCRIBE
+
+    def __init__(self, topics: Sequence[Topic]):
+        object.__setattr__(self, "topics", tuple(topics))
+
+
+@dataclass(frozen=True, slots=True)
+class UserSync:
+    """Broker ↔ broker: opaque CRDT delta of the user→broker DirectMap.
+
+    Parity: message.rs UserSync(Vec<u8>); interior produced by
+    pushcdn_tpu.broker.versioned_map serialization.
+    """
+
+    payload: BytesLike
+
+    kind = KIND_USER_SYNC
+
+
+@dataclass(frozen=True, slots=True)
+class TopicSync:
+    """Broker ↔ broker: opaque CRDT delta of topic subscriptions."""
+
+    payload: BytesLike
+
+    kind = KIND_TOPIC_SYNC
+
+
+Message = Union[
+    AuthenticateWithKey,
+    AuthenticateWithPermit,
+    AuthenticateResponse,
+    Direct,
+    Broadcast,
+    Subscribe,
+    Unsubscribe,
+    UserSync,
+    TopicSync,
+]
+
+_ALL_KINDS = {
+    KIND_AUTHENTICATE_WITH_KEY,
+    KIND_AUTHENTICATE_WITH_PERMIT,
+    KIND_AUTHENTICATE_RESPONSE,
+    KIND_DIRECT,
+    KIND_BROADCAST,
+    KIND_SUBSCRIBE,
+    KIND_UNSUBSCRIBE,
+    KIND_USER_SYNC,
+    KIND_TOPIC_SYNC,
+}
+
+
+def serialize(msg: Message) -> bytes:
+    """Encode ``msg`` into one frame (without the outer u32 length prefix —
+    that belongs to the transport's length-delimited framing, parity
+    protocols/mod.rs:353-394).
+
+    Raises ``Error(SERIALIZE)`` on out-of-range fields and
+    ``Error(EXCEEDED_SIZE)`` if the frame would exceed ``MAX_MESSAGE_SIZE``.
+    """
+    kind = msg.kind
+    try:
+        if kind == KIND_DIRECT:
+            out = bytearray(1 + 4 + len(msg.recipient))
+            out[0] = kind
+            _U32.pack_into(out, 1, len(msg.recipient))
+            out[5:] = msg.recipient
+            out += msg.message
+            frame = bytes(out)
+        elif kind == KIND_BROADCAST:
+            topics = msg.topics
+            out = bytearray(1 + 2 + len(topics))
+            out[0] = kind
+            _U16.pack_into(out, 1, len(topics))
+            out[3:3 + len(topics)] = bytes(topics)
+            out += msg.message
+            frame = bytes(out)
+        elif kind in (KIND_SUBSCRIBE, KIND_UNSUBSCRIBE):
+            topics = msg.topics
+            out = bytearray(1 + 2 + len(topics))
+            out[0] = kind
+            _U16.pack_into(out, 1, len(topics))
+            out[3:] = bytes(topics)
+            frame = bytes(out)
+        elif kind in (KIND_USER_SYNC, KIND_TOPIC_SYNC):
+            frame = bytes([kind]) + bytes(msg.payload)
+        elif kind == KIND_AUTHENTICATE_WITH_KEY:
+            pk, sig = msg.public_key, msg.signature
+            frame = (
+                bytes([kind])
+                + _U32.pack(len(pk)) + pk
+                + _U64.pack(msg.timestamp)
+                + _U32.pack(len(sig)) + sig
+            )
+        elif kind == KIND_AUTHENTICATE_WITH_PERMIT:
+            frame = bytes([kind]) + _U64.pack(msg.permit)
+        elif kind == KIND_AUTHENTICATE_RESPONSE:
+            ctx = msg.context.encode("utf-8")
+            frame = bytes([kind]) + _U64.pack(msg.permit) + _U32.pack(len(ctx)) + ctx
+        else:  # pragma: no cover - unreachable with the Message union
+            bail(ErrorKind.SERIALIZE, f"unknown message kind {kind}")
+    except struct.error as exc:
+        bail(ErrorKind.SERIALIZE, f"field out of range serializing kind {kind}", exc)
+    if len(frame) > MAX_MESSAGE_SIZE:
+        bail(ErrorKind.EXCEEDED_SIZE,
+             f"serialized frame {len(frame)} B exceeds max {MAX_MESSAGE_SIZE} B")
+    return frame
+
+
+def deserialize(frame: BytesLike) -> Message:
+    """Decode one frame. ``Direct``/``Broadcast``/sync payloads are returned
+    as zero-copy ``memoryview``s into ``frame``.
+
+    Raises ``Error(DESERIALIZE)`` on malformed input — the broker policy for
+    that is to disconnect the peer (parity tasks/user/handler.rs:106-118).
+    """
+    view = memoryview(frame)
+    n = len(view)
+    if n < 1:
+        bail(ErrorKind.DESERIALIZE, "empty frame")
+    if n > MAX_MESSAGE_SIZE:
+        bail(ErrorKind.EXCEEDED_SIZE, f"frame {n} B exceeds max {MAX_MESSAGE_SIZE} B")
+    kind = view[0]
+    try:
+        if kind == KIND_DIRECT:
+            (rlen,) = _U32.unpack_from(view, 1)
+            if 5 + rlen > n:
+                bail(ErrorKind.DESERIALIZE, "Direct recipient overruns frame")
+            return Direct(recipient=bytes(view[5:5 + rlen]), message=view[5 + rlen:])
+        if kind == KIND_BROADCAST:
+            (ntopics,) = _U16.unpack_from(view, 1)
+            if 3 + ntopics > n:
+                bail(ErrorKind.DESERIALIZE, "Broadcast topics overrun frame")
+            topics = tuple(view[3:3 + ntopics])
+            return Broadcast(topics=topics, message=view[3 + ntopics:])
+        if kind in (KIND_SUBSCRIBE, KIND_UNSUBSCRIBE):
+            (ntopics,) = _U16.unpack_from(view, 1)
+            if 3 + ntopics != n:
+                bail(ErrorKind.DESERIALIZE, "Subscribe/Unsubscribe length mismatch")
+            topics = tuple(view[3:3 + ntopics])
+            return Subscribe(topics) if kind == KIND_SUBSCRIBE else Unsubscribe(topics)
+        if kind == KIND_USER_SYNC:
+            return UserSync(payload=view[1:])
+        if kind == KIND_TOPIC_SYNC:
+            return TopicSync(payload=view[1:])
+        if kind == KIND_AUTHENTICATE_WITH_KEY:
+            off = 1
+            (pklen,) = _U32.unpack_from(view, off)
+            off += 4
+            pk = bytes(view[off:off + pklen])
+            if len(pk) != pklen:
+                bail(ErrorKind.DESERIALIZE, "AuthenticateWithKey pubkey overruns frame")
+            off += pklen
+            (ts,) = _U64.unpack_from(view, off)
+            off += 8
+            (siglen,) = _U32.unpack_from(view, off)
+            off += 4
+            sig = bytes(view[off:off + siglen])
+            if len(sig) != siglen or off + siglen != n:
+                bail(ErrorKind.DESERIALIZE, "AuthenticateWithKey signature length mismatch")
+            return AuthenticateWithKey(public_key=pk, timestamp=ts, signature=sig)
+        if kind == KIND_AUTHENTICATE_WITH_PERMIT:
+            if n != 9:
+                bail(ErrorKind.DESERIALIZE, "AuthenticateWithPermit length mismatch")
+            (permit,) = _U64.unpack_from(view, 1)
+            return AuthenticateWithPermit(permit=permit)
+        if kind == KIND_AUTHENTICATE_RESPONSE:
+            (permit,) = _U64.unpack_from(view, 1)
+            (ctxlen,) = _U32.unpack_from(view, 9)
+            ctx = bytes(view[13:13 + ctxlen])
+            if len(ctx) != ctxlen or 13 + ctxlen != n:
+                bail(ErrorKind.DESERIALIZE, "AuthenticateResponse context length mismatch")
+            return AuthenticateResponse(permit=permit, context=ctx.decode("utf-8"))
+    except struct.error as exc:
+        bail(ErrorKind.DESERIALIZE, f"truncated frame for kind {kind}", exc)
+    bail(ErrorKind.DESERIALIZE, f"unknown message kind {kind}")
+
+
+def peek_kind(frame: BytesLike) -> int:
+    """Read the kind tag without decoding — lets hot loops dispatch before
+    (or instead of) a full deserialize."""
+    if len(frame) < 1:
+        bail(ErrorKind.DESERIALIZE, "empty frame")
+    return memoryview(frame)[0]

@@ -1,0 +1,159 @@
+"""Metrics: Prometheus-style text exposition over HTTP + core gauges.
+
+Capability parity with cdn-proto/src/metrics.rs:18-78 (warp `/metrics`
+endpoint, 30 s running-latency gauge computed from histogram deltas) and
+cdn-proto/src/connection/metrics.rs:12-28 (BYTES_SENT / BYTES_RECV gauges,
+LATENCY histogram of permit-allocation lifetime).
+
+Dependency-free: a tiny registry + asyncio HTTP server producing the
+Prometheus text format. Metrics are always collected (cheap int adds); the
+endpoint is opt-in per binary, matching the reference's `metrics` feature.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class Counter:
+    """Monotonic counter (exposed as prometheus counter)."""
+
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self.value = 0
+        _REGISTRY[name] = self
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+    def render(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} counter\n"
+                f"{self.name} {self.value}\n")
+
+
+class Gauge:
+    """Settable gauge."""
+
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self.value = 0.0
+        _REGISTRY[name] = self
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def inc(self, n: float = 1) -> None:
+        self.value += n
+
+    def dec(self, n: float = 1) -> None:
+        self.value -= n
+
+    def render(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} gauge\n"
+                f"{self.name} {self.value}\n")
+
+
+class Histogram:
+    """Fixed-bucket histogram (seconds)."""
+
+    DEFAULT_BUCKETS = (1e-5, 1e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
+    def __init__(self, name: str, help_: str, buckets=DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.total = 0
+        _REGISTRY[name] = self
+
+    def observe(self, v: float) -> None:
+        self.sum += v
+        self.total += 1
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def render(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        cum = 0
+        for b, c in zip(self.buckets, self.counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {self.total}')
+        out.append(f"{self.name}_sum {self.sum}")
+        out.append(f"{self.name}_count {self.total}")
+        return "\n".join(out) + "\n"
+
+
+_REGISTRY: Dict[str, object] = {}
+
+# Core connection metrics (parity connection/metrics.rs:13-28, incremented
+# by the transport layer at frame write/read).
+BYTES_SENT = Counter("cdn_bytes_sent", "Total bytes written to peers")
+BYTES_RECV = Counter("cdn_bytes_received", "Total bytes read from peers")
+LATENCY = Histogram("cdn_message_latency_seconds",
+                    "Permit-allocation lifetime: receive -> last fan-out send")
+RUNNING_LATENCY = Gauge("cdn_running_latency_seconds",
+                        "30s running average message latency")
+
+
+def observe_message_latency(seconds: float) -> None:
+    LATENCY.observe(seconds)
+
+
+def render_all() -> str:
+    return "".join(m.render() for m in _REGISTRY.values())
+
+
+async def _running_latency_calculator(interval_s: float = 30.0) -> None:
+    """Recompute RUNNING_LATENCY from histogram deltas every ``interval_s``
+    (parity metrics.rs:43-78)."""
+    prev_sum, prev_total = LATENCY.sum, LATENCY.total
+    while True:
+        await asyncio.sleep(interval_s)
+        ds, dn = LATENCY.sum - prev_sum, LATENCY.total - prev_total
+        RUNNING_LATENCY.set(ds / dn if dn else 0.0)
+        prev_sum, prev_total = LATENCY.sum, LATENCY.total
+
+
+async def serve_metrics(bind_endpoint: str) -> asyncio.AbstractServer:
+    """Serve ``GET /metrics`` as Prometheus text (parity metrics.rs:18-39).
+
+    Returns the server; also spawns the running-latency calculator.
+    """
+    from pushcdn_tpu.proto.error import parse_endpoint
+    host, port = parse_endpoint(bind_endpoint)
+
+    async def handler(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            request = await reader.readline()
+            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                pass
+            if b"/metrics" in request:
+                body = render_all().encode()
+                writer.write(b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
+                             + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+            else:
+                writer.write(b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n\r\n")
+            await writer.drain()
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    server = await asyncio.start_server(handler, host, port)
+    asyncio.create_task(_running_latency_calculator())
+    return server

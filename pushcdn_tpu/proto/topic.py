@@ -1,0 +1,69 @@
+"""Topic validation / pruning.
+
+Capability parity with the reference's ``Topic`` trait (``prune()``
+validation, cdn-proto/src/def.rs:31-51) and the ``TestTopic { Global=0,
+DA=1 }`` example (def.rs:23-28). Topics are small ints on the wire
+(``u8``, message.rs:26); a ``TopicSpace`` defines which values are valid.
+
+On-device, a topic set is a bitmask over the topic space (one u32/u64 lane
+per connection) — see pushcdn_tpu.parallel.frames.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Iterable, List, Sequence
+
+
+class TestTopic(enum.IntEnum):
+    """The test topic space (parity def.rs:23-28)."""
+
+    GLOBAL = 0
+    DA = 1
+
+
+@dataclass(frozen=True)
+class TopicSpace:
+    """The set of valid topic values for a deployment.
+
+    ``prune`` mirrors def.rs:37-51: strip unknown values, dedupe, and report
+    whether anything was removed — the broker disconnects users that sent
+    *only* invalid topics (tasks/user/handler.rs topic pruning).
+    """
+
+    valid: frozenset[int]
+
+    @classmethod
+    def from_enum(cls, topic_enum) -> "TopicSpace":
+        return cls(frozenset(int(t) for t in topic_enum))
+
+    @classmethod
+    def range(cls, n: int) -> "TopicSpace":
+        """Topic space 0..n-1 (bitmask-friendly; n ≤ 256)."""
+        return cls(frozenset(range(n)))
+
+    def prune(self, topics: Sequence[int]) -> tuple[List[int], bool]:
+        """Return (valid-deduped-topics, had_invalid)."""
+        seen = set()
+        out: List[int] = []
+        had_invalid = False
+        for t in topics:
+            t = int(t)
+            if t not in self.valid:
+                had_invalid = True
+                continue
+            if t not in seen:
+                seen.add(t)
+                out.append(t)
+        return out, had_invalid
+
+    def bitmask(self, topics: Iterable[int]) -> int:
+        """Pack a topic set into an int bitmask (device representation)."""
+        mask = 0
+        for t in topics:
+            mask |= 1 << int(t)
+        return mask
+
+
+TEST_TOPIC_SPACE = TopicSpace.from_enum(TestTopic)

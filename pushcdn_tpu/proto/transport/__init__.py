@@ -1,0 +1,28 @@
+"""Transport protocols (reference layer L0).
+
+Every transport reduces to an async byte-stream pair behind the uniform
+:class:`~pushcdn_tpu.proto.transport.base.Connection` handle (parity
+cdn-proto/src/connection/protocols/mod.rs:85-306). Implementations:
+
+- ``memory`` — in-process duplex streams behind a global registry (test
+  infra; parity protocols/memory.rs)
+- ``tcp`` — plain TCP with TCP_NODELAY (parity protocols/tcp.rs)
+- ``tcp_tls`` — TLS over TCP with the local/prod CA scheme (parity
+  protocols/tcp_tls.rs)
+- ``quic`` — gated: no QUIC stack in this environment; the class exists so
+  configs referencing it fail with a clear error (parity protocols/quic.rs)
+
+The device data plane's inter-broker "transport" is NOT one of these: broker
+↔ broker fan-out on TPU lowers to XLA collectives over ICI (see
+pushcdn_tpu.parallel) while these host transports carry the user edge.
+"""
+
+from pushcdn_tpu.proto.transport.base import (  # noqa: F401
+    Connection,
+    Listener,
+    Protocol,
+    UnfinalizedConnection,
+)
+from pushcdn_tpu.proto.transport.memory import Memory  # noqa: F401
+from pushcdn_tpu.proto.transport.tcp import Tcp  # noqa: F401
+from pushcdn_tpu.proto.transport.tcp_tls import TcpTls  # noqa: F401

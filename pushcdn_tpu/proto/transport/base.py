@@ -1,0 +1,302 @@
+"""The transport-generic connection machinery.
+
+Capability parity with cdn-proto/src/connection/protocols/mod.rs:
+
+- ``Protocol`` — connect/bind with associated listener + unfinalized
+  connection types (mod.rs:40-81).
+- ``Connection`` — the uniform handle: two actor tasks (writer-drain and
+  reader-pump) bridged to callers by queues (mod.rs:139-217), with
+  ``send_message[_raw]`` / ``recv_message[_raw]`` / ``soft_close``
+  (mod.rs:223-306).
+- Length-delimited framing: u32 big-endian length prefix then payload, max
+  ``MAX_MESSAGE_SIZE``, 5 s per-frame read/write timeouts
+  (mod.rs:309-394; cdn-proto/src/lib.rs:25).
+- The reader acquires limiter byte-permits **before** buffering a frame
+  (mod.rs:328) — backpressure lands on the socket, not on the router.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import struct
+from typing import Optional
+
+from pushcdn_tpu.proto import MAX_MESSAGE_SIZE
+from pushcdn_tpu.proto.error import Error, ErrorKind, bail
+from pushcdn_tpu.proto.limiter import Bytes, Limiter, NO_LIMIT
+from pushcdn_tpu.proto.message import Message, deserialize, serialize
+from pushcdn_tpu.proto import metrics as metrics_mod
+
+# Parity: 5 s read/write timeouts (protocols/mod.rs:336, :368, :379) and a
+# 5 s connect timeout (tcp.rs).
+WRITE_TIMEOUT_S = 5.0
+READ_TIMEOUT_S = 5.0
+CONNECT_TIMEOUT_S = 5.0
+
+_LEN = struct.Struct(">I")
+
+_CLOSE = object()  # sentinel queued to ask the writer task to soft-close
+
+
+class RawStream(abc.ABC):
+    """Minimal async byte-stream pair every transport lowers to."""
+
+    @abc.abstractmethod
+    async def read_exactly(self, n: int) -> bytes: ...
+
+    @abc.abstractmethod
+    async def write(self, data) -> None:
+        """Buffer ``data`` and flush (may await backpressure)."""
+
+    @abc.abstractmethod
+    async def close(self) -> None:
+        """Flush and close the write side gracefully."""
+
+    @abc.abstractmethod
+    def abort(self) -> None:
+        """Tear down immediately."""
+
+
+class AsyncioStream(RawStream):
+    """RawStream over an asyncio (StreamReader, StreamWriter) pair."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+
+    async def read_exactly(self, n: int) -> bytes:
+        return await self.reader.readexactly(n)
+
+    async def write(self, data) -> None:
+        self.writer.write(bytes(data) if isinstance(data, memoryview) else data)
+        await self.writer.drain()
+
+    async def close(self) -> None:
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except Exception:
+            pass
+
+    def abort(self) -> None:
+        try:
+            self.writer.transport.abort()
+        except Exception:
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+
+
+class Connection:
+    """Uniform connection handle with actor-style reader/writer tasks.
+
+    Shape parity with protocols/mod.rs:139-217: a writer task drains a send
+    queue into the stream; a reader task pumps length-delimited frames into
+    a receive queue (acquiring limiter permits first). Any I/O error poisons
+    the connection: both queues wake with the error and subsequent calls
+    raise ``Error(CONNECTION)`` — the caller's policy is removal/reconnect
+    (fault detection *is* "send failed", tasks/broker/sender.rs:35-43).
+    """
+
+    def __init__(self, stream: RawStream, limiter: Limiter = NO_LIMIT,
+                 label: str = "?"):
+        self._stream = stream
+        self._limiter = limiter
+        self.label = label
+        qsize = limiter.queue_size()
+        self._send_q: asyncio.Queue = asyncio.Queue(maxsize=qsize)
+        self._recv_q: asyncio.Queue = asyncio.Queue(maxsize=qsize)
+        self._error: Optional[Error] = None
+        self._closed = False
+        self._writer_task = asyncio.create_task(self._writer_loop())
+        self._reader_task = asyncio.create_task(self._reader_loop())
+
+    # -- actor loops --------------------------------------------------------
+
+    async def _writer_loop(self) -> None:
+        try:
+            while True:
+                item = await self._send_q.get()
+                if item is _CLOSE:
+                    await self._stream.close()
+                    return
+                payload, done = item
+                try:
+                    async with asyncio.timeout(WRITE_TIMEOUT_S):
+                        await self._stream.write(_LEN.pack(len(payload)))
+                        await self._stream.write(
+                            payload.data if isinstance(payload, Bytes) else payload)
+                    metrics_mod.BYTES_SENT.inc(len(payload) + 4)
+                finally:
+                    if isinstance(payload, Bytes):
+                        payload.release()
+                if done is not None and not done.done():
+                    done.set_result(None)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            self._poison(Error(ErrorKind.CONNECTION, f"write failed: {exc!r}", exc))
+
+    async def _reader_loop(self) -> None:
+        try:
+            while True:
+                async with asyncio.timeout(None):
+                    header = await self._stream.read_exactly(4)
+                (length,) = _LEN.unpack(header)
+                if length > MAX_MESSAGE_SIZE:
+                    raise Error(ErrorKind.EXCEEDED_SIZE,
+                                f"peer announced {length} B frame")
+                # Backpressure BEFORE allocating the buffer (mod.rs:328).
+                permit = await self._limiter.allocate_message_bytes(length)
+                try:
+                    async with asyncio.timeout(READ_TIMEOUT_S):
+                        payload = await self._stream.read_exactly(length)
+                except BaseException:
+                    if permit is not None:
+                        permit.release()
+                    raise
+                metrics_mod.BYTES_RECV.inc(length + 4)
+                await self._recv_q.put(Bytes(payload, permit))
+        except asyncio.CancelledError:
+            raise
+        except asyncio.IncompleteReadError as exc:
+            self._poison(Error(ErrorKind.CONNECTION, "peer closed", exc))
+        except Error as err:
+            self._poison(err)
+        except Exception as exc:
+            self._poison(Error(ErrorKind.CONNECTION, f"read failed: {exc!r}", exc))
+
+    def _poison(self, err: Error) -> None:
+        if self._error is None:
+            self._error = err
+        self._closed = True
+        self._stream.abort()
+        # Wake any blocked receiver.
+        try:
+            self._recv_q.put_nowait(err)
+        except asyncio.QueueFull:
+            pass
+        # Wake pending senders whose frames will never flush.
+        while True:
+            try:
+                item = self._send_q.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if item is _CLOSE:
+                continue
+            payload, done = item
+            if isinstance(payload, Bytes):
+                payload.release()
+            if done is not None and not done.done():
+                done.set_exception(err)
+
+    def _check(self) -> None:
+        if self._error is not None:
+            raise self._error
+        if self._closed:
+            raise Error(ErrorKind.CONNECTION, "connection closed")
+
+    # -- public API (parity mod.rs:223-306) ---------------------------------
+
+    async def send_message(self, message: Message, flush: bool = False) -> None:
+        await self.send_raw(serialize(message), flush=flush)
+
+    async def send_raw(self, raw, flush: bool = False) -> None:
+        """Queue a pre-serialized frame (``bytes`` or :class:`Bytes`).
+
+        With ``flush=True``, wait until the frame hits the stream — used by
+        handshakes; the hot path queues and returns (reference
+        send_message_raw semantics).
+        """
+        self._check()
+        done = asyncio.get_running_loop().create_future() if flush else None
+        await self._send_q.put((raw, done))
+        if self._error is not None:  # poisoned while enqueueing
+            raise self._error
+        if done is not None:
+            await done
+
+    async def recv_message(self) -> Message:
+        raw = await self.recv_raw()
+        try:
+            return deserialize(raw.data)
+        finally:
+            raw.release()
+
+    async def recv_raw(self) -> Bytes:
+        """Receive one frame as refcounted :class:`Bytes` (permit attached)."""
+        if self._error is not None and self._recv_q.empty():
+            raise self._error
+        item = await self._recv_q.get()
+        if isinstance(item, Error):
+            # keep the poison visible to subsequent callers
+            try:
+                self._recv_q.put_nowait(item)
+            except asyncio.QueueFull:
+                pass
+            raise item
+        return item
+
+    async def soft_close(self) -> None:
+        """Flush queued frames, then close the write side (parity
+        ``soft_close``, protocols/mod.rs — QUIC does a real finish/stopped
+        dance; for byte streams this is flush+FIN)."""
+        if self._error is not None:
+            raise self._error
+        self._closed = True
+        await self._send_q.put(_CLOSE)
+        try:
+            async with asyncio.timeout(WRITE_TIMEOUT_S):
+                await asyncio.shield(self._writer_task)
+        except (asyncio.TimeoutError, asyncio.CancelledError, Error):
+            pass
+        except Exception:
+            pass
+        self._reader_task.cancel()
+
+    def close(self) -> None:
+        """Tear down immediately (abort both tasks)."""
+        self._closed = True
+        self._writer_task.cancel()
+        self._reader_task.cancel()
+        self._stream.abort()
+
+    @property
+    def is_closed(self) -> bool:
+        return self._closed or self._error is not None
+
+
+class UnfinalizedConnection(abc.ABC):
+    """An accepted-but-not-ready connection; ``finalize`` completes any
+    handshake and spawns the actor tasks (parity mod.rs:64-81 — accept is
+    kept cheap so one slow handshake can't stall the accept loop)."""
+
+    @abc.abstractmethod
+    async def finalize(self, limiter: Limiter = NO_LIMIT) -> Connection: ...
+
+
+class Listener(abc.ABC):
+    """Bound server socket: ``accept`` yields unfinalized connections."""
+
+    @abc.abstractmethod
+    async def accept(self) -> UnfinalizedConnection: ...
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+
+class Protocol(abc.ABC):
+    """A transport implementation (parity ``Protocol`` trait, mod.rs:40-63)."""
+
+    name: str = "?"
+
+    @classmethod
+    @abc.abstractmethod
+    async def connect(cls, endpoint: str, use_local_authority: bool = True,
+                      limiter: Limiter = NO_LIMIT) -> Connection: ...
+
+    @classmethod
+    @abc.abstractmethod
+    async def bind(cls, endpoint: str, certificate=None) -> Listener: ...

@@ -1,0 +1,138 @@
+"""In-process "memory" transport for deterministic single-process tests.
+
+Capability parity with cdn-proto/src/connection/protocols/memory.rs:32-204:
+listeners live in a process-global registry keyed by endpoint string; a
+connect hands one side of a duplex pipe to the listener's accept queue.
+This is the seam that lets whole-system integration tests (marshal + brokers
++ clients) run in one process with no sockets (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Tuple
+
+from pushcdn_tpu.proto.error import Error, ErrorKind, bail
+from pushcdn_tpu.proto.limiter import Limiter, NO_LIMIT
+from pushcdn_tpu.proto.transport.base import (
+    Connection,
+    Listener,
+    Protocol,
+    RawStream,
+    UnfinalizedConnection,
+)
+
+_DUPLEX_BUFFER = 8192  # parity: 8192-byte duplex buffers (memory.rs)
+
+
+class _PipeStream(RawStream):
+    """One side of an in-process duplex: reads from its own StreamReader,
+    writes by feeding the peer's StreamReader."""
+
+    def __init__(self):
+        self.reader = asyncio.StreamReader(limit=2**26)
+        self.peer: "_PipeStream" = None  # set by _duplex()
+        self._closed = False
+
+    async def read_exactly(self, n: int) -> bytes:
+        return await self.reader.readexactly(n)
+
+    async def write(self, data) -> None:
+        if self._closed or self.peer is None:
+            raise ConnectionResetError("memory stream closed")
+        if self.peer._closed:
+            raise ConnectionResetError("peer closed")
+        self.peer.reader.feed_data(bytes(data))
+        # Cooperative backpressure: yield so the peer can drain.
+        if len(self.peer.reader._buffer) > _DUPLEX_BUFFER:  # noqa: SLF001
+            await asyncio.sleep(0)
+
+    async def close(self) -> None:
+        self.abort()
+
+    def abort(self) -> None:
+        if not self._closed:
+            self._closed = True
+            if self.peer is not None:
+                try:
+                    self.peer.reader.feed_eof()
+                except Exception:
+                    pass
+            try:
+                self.reader.feed_eof()
+            except Exception:
+                pass
+
+
+def _duplex() -> Tuple[_PipeStream, _PipeStream]:
+    a, b = _PipeStream(), _PipeStream()
+    a.peer, b.peer = b, a
+    return a, b
+
+
+class _Registry:
+    """Process-global endpoint → listener map (parity: the reference's
+    ``OnceLock<RwLock<HashMap<String, ChannelExchange>>>``, memory.rs:32-36)."""
+
+    def __init__(self):
+        self.listeners: Dict[str, "MemoryListener"] = {}
+
+
+_REGISTRY = _Registry()
+
+
+class _MemoryUnfinalized(UnfinalizedConnection):
+    def __init__(self, stream: _PipeStream):
+        self._stream = stream
+
+    async def finalize(self, limiter: Limiter = NO_LIMIT) -> Connection:
+        return Connection(self._stream, limiter, label="memory")
+
+
+class MemoryListener(Listener):
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+        self._accept_q: "asyncio.Queue[_PipeStream]" = asyncio.Queue()
+        self._closed = False
+
+    async def accept(self) -> UnfinalizedConnection:
+        if self._closed:
+            bail(ErrorKind.CONNECTION, "listener closed")
+        stream = await self._accept_q.get()
+        return _MemoryUnfinalized(stream)
+
+    async def close(self) -> None:
+        self._closed = True
+        _REGISTRY.listeners.pop(self.endpoint, None)
+
+
+class Memory(Protocol):
+    """The in-process transport (parity protocols/memory.rs)."""
+
+    name = "memory"
+
+    @classmethod
+    async def connect(cls, endpoint: str, use_local_authority: bool = True,
+                      limiter: Limiter = NO_LIMIT) -> Connection:
+        listener = _REGISTRY.listeners.get(endpoint)
+        if listener is None or listener._closed:
+            bail(ErrorKind.CONNECTION, f"no memory listener bound at {endpoint!r}")
+        ours, theirs = _duplex()
+        await listener._accept_q.put(theirs)
+        return Connection(ours, limiter, label=f"memory:{endpoint}")
+
+    @classmethod
+    async def bind(cls, endpoint: str, certificate=None) -> Listener:
+        if endpoint in _REGISTRY.listeners:
+            bail(ErrorKind.CONNECTION, f"memory endpoint {endpoint!r} already bound")
+        listener = MemoryListener(endpoint)
+        _REGISTRY.listeners[endpoint] = listener
+        return listener
+
+
+async def gen_testing_connection_pair(limiter: Limiter = NO_LIMIT
+                                      ) -> Tuple[Connection, Connection]:
+    """Directly build a connected pair (parity ``gen_testing_connection``,
+    memory.rs — used heavily by the broker injection harness)."""
+    a, b = _duplex()
+    return Connection(a, limiter, "memory:a"), Connection(b, limiter, "memory:b")

@@ -1,0 +1,32 @@
+"""QUIC transport — gated.
+
+The reference's fourth transport is QUIC via quinn (protocols/quic.rs:37-277:
+one bidirectional stream bootstrapped with a single byte, 5 s keep-alive, a
+real soft-close via finish + stopped). This environment has no QUIC stack
+(no aioquic, and installing packages is disallowed), so the class exists to
+keep the transport registry complete and fail with a clear error if
+selected. The `Protocol` seam means dropping a real implementation in later
+touches nothing else.
+"""
+
+from __future__ import annotations
+
+from pushcdn_tpu.proto.error import ErrorKind, bail
+from pushcdn_tpu.proto.limiter import Limiter, NO_LIMIT
+from pushcdn_tpu.proto.transport.base import Connection, Listener, Protocol
+
+_MSG = ("QUIC transport is unavailable in this build (no QUIC stack in the "
+        "environment); use Tcp, TcpTls, or Memory")
+
+
+class Quic(Protocol):
+    name = "quic"
+
+    @classmethod
+    async def connect(cls, endpoint: str, use_local_authority: bool = True,
+                      limiter: Limiter = NO_LIMIT) -> Connection:
+        bail(ErrorKind.CONNECTION, _MSG)
+
+    @classmethod
+    async def bind(cls, endpoint: str, certificate=None) -> Listener:
+        bail(ErrorKind.CONNECTION, _MSG)

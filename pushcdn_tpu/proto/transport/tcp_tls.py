@@ -1,0 +1,97 @@
+"""TLS-over-TCP transport (the production user-facing edge).
+
+Capability parity with cdn-proto/src/connection/protocols/tcp_tls.rs:44-254:
+server presents a leaf cert derived from the local (or production) CA with
+SAN ``pushcdn``; clients verify against that CA; no mutual TLS (user
+authentication is the signed-timestamp handshake at L4, not client certs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import ssl
+
+from pushcdn_tpu.proto.crypto.tls import LOCAL_SAN, Certificate, local_certificate
+from pushcdn_tpu.proto.error import ErrorKind, bail, parse_endpoint
+from pushcdn_tpu.proto.limiter import Limiter, NO_LIMIT
+from pushcdn_tpu.proto.transport.base import (
+    CONNECT_TIMEOUT_S,
+    AsyncioStream,
+    Connection,
+    Listener,
+    Protocol,
+    UnfinalizedConnection,
+)
+
+
+class _TlsUnfinalized(UnfinalizedConnection):
+    def __init__(self, reader, writer):
+        self._reader, self._writer = reader, writer
+
+    async def finalize(self, limiter: Limiter = NO_LIMIT) -> Connection:
+        # TLS handshake already completed by asyncio's start_server(ssl=...);
+        # the accept loop stays cheap because asyncio performs the handshake
+        # before invoking the client callback.
+        sock = self._writer.get_extra_info("socket")
+        if sock is not None:
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+        return Connection(AsyncioStream(self._reader, self._writer), limiter,
+                          label="tcp+tls")
+
+
+class TcpTlsListener(Listener):
+    def __init__(self):
+        self._accept_q: "asyncio.Queue[_TlsUnfinalized]" = asyncio.Queue()
+        self._server: asyncio.AbstractServer = None
+        self.bound_port: int = 0
+
+    async def _on_client(self, reader, writer):
+        await self._accept_q.put(_TlsUnfinalized(reader, writer))
+
+    async def accept(self) -> UnfinalizedConnection:
+        return await self._accept_q.get()
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+class TcpTls(Protocol):
+    name = "tcp+tls"
+
+    @classmethod
+    async def connect(cls, endpoint: str, use_local_authority: bool = True,
+                      limiter: Limiter = NO_LIMIT) -> Connection:
+        host, port = parse_endpoint(endpoint)
+        if use_local_authority:
+            ctx = local_certificate().client_context()
+        else:
+            ctx = ssl.create_default_context()
+        try:
+            async with asyncio.timeout(CONNECT_TIMEOUT_S):
+                reader, writer = await asyncio.open_connection(
+                    host, port, ssl=ctx, server_hostname=LOCAL_SAN)
+        except (OSError, ssl.SSLError, asyncio.TimeoutError) as exc:
+            bail(ErrorKind.CONNECTION, f"tls connect to {endpoint} failed", exc)
+        return Connection(AsyncioStream(reader, writer), limiter,
+                          label=f"tcp+tls:{endpoint}")
+
+    @classmethod
+    async def bind(cls, endpoint: str, certificate: Certificate = None) -> Listener:
+        host, port = parse_endpoint(endpoint)
+        if certificate is None:
+            certificate = local_certificate()
+        listener = TcpTlsListener()
+        try:
+            server = await asyncio.start_server(
+                listener._on_client, host, port, ssl=certificate.server_context())
+        except (OSError, ssl.SSLError) as exc:
+            bail(ErrorKind.CONNECTION, f"tls bind to {endpoint} failed", exc)
+        listener._server = server
+        listener.bound_port = server.sockets[0].getsockname()[1]
+        return listener

@@ -1,0 +1,80 @@
+"""Memory pool / limiter unit tests (parity limiter/pool.rs behavior)."""
+
+import asyncio
+
+import pytest
+
+from pushcdn_tpu.proto.error import Error, ErrorKind
+from pushcdn_tpu.proto.limiter import Bytes, MemoryPool
+
+
+async def test_allocate_and_release():
+    pool = MemoryPool(1000)
+    p = await pool.allocate(600)
+    assert pool.available == 400
+    p.release()
+    assert pool.available == 1000
+    # double release is a no-op
+    p.release()
+    assert pool.available == 1000
+
+
+async def test_oversized_allocation_errors_not_deadlocks():
+    pool = MemoryPool(100)
+    with pytest.raises(Error) as ei:
+        await pool.allocate(101)
+    assert ei.value.kind == ErrorKind.EXCEEDED_SIZE
+
+
+async def test_blocking_until_release_fifo():
+    pool = MemoryPool(100)
+    p1 = await pool.allocate(80)
+    big = asyncio.create_task(pool.allocate(60))
+    await asyncio.sleep(0.05)
+    assert not big.done()
+    # FIFO fairness: a small allocation queued behind the big one must not
+    # starve it even though it would fit right now.
+    small = asyncio.create_task(pool.allocate(10))
+    await asyncio.sleep(0.05)
+    assert not small.done()
+    p1.release()
+    p_big = await asyncio.wait_for(big, 5)
+    p_small = await asyncio.wait_for(small, 5)
+    assert pool.available == 100 - 60 - 10
+    p_big.release()
+    p_small.release()
+
+
+async def test_cancelled_waiter_does_not_leak():
+    pool = MemoryPool(100)
+    p1 = await pool.allocate(100)
+    waiter = asyncio.create_task(pool.allocate(50))
+    await asyncio.sleep(0.05)
+    waiter.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await waiter
+    p1.release()
+    assert pool.available == 100
+
+
+async def test_bytes_refcounted_fanout_release():
+    """Permit returns to the pool only when the LAST clone releases —
+    exactly the reference's fan-out lifetime (pool.rs:7-14)."""
+    pool = MemoryPool(1000)
+    permit = await pool.allocate(500)
+    b = Bytes(b"x" * 500, permit)
+    clones = [b.clone() for _ in range(7)]
+    b.release()
+    for c in clones[:-1]:
+        c.release()
+    assert pool.available == 500  # still held by the final clone
+    clones[-1].release()
+    assert pool.available == 1000
+
+
+async def test_latency_sample_recorded():
+    pool = MemoryPool(100)
+    p = await pool.allocate(10)
+    await asyncio.sleep(0.01)
+    p.release()
+    assert pool.latency_samples and pool.latency_samples[0] >= 0.009
