@@ -1,0 +1,13 @@
+"""The broker: routing state (CRDT maps) + task runtime.
+
+Capability parity with the reference's ``cdn-broker`` crate (SURVEY.md §2b):
+a state plane (``connections``: users map, brokers map, DirectMap CRDT,
+broadcast subscription indexes) and a task plane (heartbeat, sync,
+whitelist, user listener, broker listener + one receive loop per
+connection), supervised fail-fast.
+
+TPU lowering: the same routing state also exists as a *vectorized twin*
+(owner-table and topic-bitmask tensors, pushcdn_tpu.parallel) so the data
+plane can route entirely on-device over a broker mesh.
+"""
+

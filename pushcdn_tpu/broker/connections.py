@@ -1,0 +1,286 @@
+"""``Connections`` — the broker's entire routing state plane.
+
+Capability parity with cdn-broker/src/connections/mod.rs:34-388 and
+connections/broadcast/mod.rs:19-55:
+
+- users map: ``UserPublicKey → (Connection, AbortOnDropHandle)``;
+- brokers map: ``BrokerIdentifier → (Connection, AbortOnDropHandle)`` plus a
+  per-peer ``TopicSyncMap`` tracking that peer's advertised topics;
+- ``DirectMap``: the global "which broker owns this user" CRDT
+  (``VersionedMap[UserPublicKey, str, str]``, connections/direct/mod.rs:14);
+- ``BroadcastMap``: RelationalMaps for local users and peer brokers, our own
+  ``TopicSyncMap`` advertisement, and previous-topic-set delta tracking;
+- interest queries, sync generation/application, double-connect eviction
+  ("user connected elsewhere", connections/mod.rs:154-162).
+
+Locking: one ``asyncio`` world — Connections is only touched from the
+broker's event loop, which gives the same "one RwLock" discipline as the
+reference (cdn-broker/src/lib.rs:98) for free. Methods are synchronous;
+I/O (closing evicted connections) is delegated to abort handles.
+
+Broker identifiers are carried as **strings** (``BrokerIdentifier``'s
+canonical "pub/priv" form) inside CRDT payloads so the codec stays scalar.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from pushcdn_tpu.broker.relational_map import RelationalMap
+from pushcdn_tpu.broker.versioned_map import VersionedMap
+from pushcdn_tpu.proto.transport.base import Connection
+from pushcdn_tpu.proto.util import AbortOnDropHandle, mnemonic
+
+logger = logging.getLogger("pushcdn.broker")
+
+UserPublicKey = bytes
+Topic = int
+
+
+class SubscriptionStatus(enum.IntEnum):
+    """Value type of the topic-sync CRDT (broadcast/mod.rs SubscriptionStatus)."""
+
+    UNSUBSCRIBED = 0
+    SUBSCRIBED = 1
+
+
+@dataclass
+class UserHandle:
+    connection: Connection
+    abort_handle: Optional[AbortOnDropHandle] = None
+
+
+@dataclass
+class BrokerHandle:
+    connection: Connection
+    abort_handle: Optional[AbortOnDropHandle] = None
+    # That peer's advertised topic set, as a CRDT we merge TopicSync into
+    # (per-broker TopicSyncMap, connections/mod.rs:40-53).
+    topic_sync_map: VersionedMap = None
+
+
+class Connections:
+    """All routing state for one broker."""
+
+    def __init__(self, identity: str):
+        # identity = our BrokerIdentifier in canonical string form
+        self.identity = identity
+        self.users: Dict[UserPublicKey, UserHandle] = {}
+        self.brokers: Dict[str, BrokerHandle] = {}
+        # user → owning-broker CRDT (DirectMap, connections/direct/mod.rs:14)
+        self.direct_map: VersionedMap = VersionedMap(local_identity=identity)
+        # topic interest indexes (BroadcastMap, broadcast/mod.rs:19-55)
+        self.user_topics: RelationalMap = RelationalMap()    # user -> topics
+        self.broker_topics: RelationalMap = RelationalMap()  # peer -> topics
+        # our own advertised-topics CRDT + previous snapshot for deltas
+        self.our_topic_map: VersionedMap = VersionedMap(local_identity=identity)
+        self._previous_local_topics: Set[Topic] = set()
+
+    # ---- users ------------------------------------------------------------
+
+    def add_user(self, public_key: UserPublicKey, connection: Connection,
+                 topics: List[Topic],
+                 abort_handle: Optional[AbortOnDropHandle] = None) -> None:
+        """Register a user: evict any same-broker double-connect, claim the
+        user in the DirectMap, and apply initial subscriptions
+        (connections/mod.rs add_user)."""
+        existing = self.users.pop(public_key, None)
+        if existing is not None:
+            logger.info("user %s reconnected here; evicting old connection",
+                        mnemonic(public_key))
+            self._teardown(existing)
+            self.user_topics.remove_key(public_key)
+        self.users[public_key] = UserHandle(connection, abort_handle)
+        if topics:
+            self.user_topics.associate_key_with_values(public_key, topics)
+        self.direct_map.insert(public_key, self.identity)
+        logger.info("user %s connected (topics=%s)", mnemonic(public_key), topics)
+
+    def remove_user(self, public_key: UserPublicKey,
+                    reason: str = "disconnected") -> None:
+        handle = self.users.pop(public_key, None)
+        if handle is None:
+            return
+        self._teardown(handle)
+        self.user_topics.remove_key(public_key)
+        # Release our DirectMap claim only if we still hold it — a newer
+        # claim by another broker must not be clobbered.
+        self.direct_map.remove_if_equals(public_key, self.identity)
+        logger.info("user %s removed: %s", mnemonic(public_key), reason)
+
+    def has_user(self, public_key: UserPublicKey) -> bool:
+        return public_key in self.users
+
+    def get_user_connection(self, public_key: UserPublicKey) -> Optional[Connection]:
+        h = self.users.get(public_key)
+        return None if h is None else h.connection
+
+    @property
+    def num_users(self) -> int:
+        return len(self.users)
+
+    # ---- brokers ----------------------------------------------------------
+
+    def add_broker(self, identifier: str, connection: Connection,
+                   abort_handle: Optional[AbortOnDropHandle] = None) -> None:
+        existing = self.brokers.pop(identifier, None)
+        if existing is not None:
+            logger.info("broker %s reconnected; evicting old link", identifier)
+            self._teardown(existing)
+            self.broker_topics.remove_key(identifier)
+        self.brokers[identifier] = BrokerHandle(
+            connection, abort_handle,
+            topic_sync_map=VersionedMap(local_identity=identifier))
+        logger.info("broker %s connected", identifier)
+
+    def remove_broker(self, identifier: str, reason: str = "disconnected") -> None:
+        handle = self.brokers.pop(identifier, None)
+        if handle is None:
+            return
+        self._teardown(handle)
+        self.broker_topics.remove_key(identifier)
+        # Forget (locally, without tombstoning) every user the dead peer
+        # owned — they will re-appear when they reconnect elsewhere
+        # (remove_by_value_no_modify, versioned_map.rs).
+        dropped = self.direct_map.remove_by_value_no_modify(identifier)
+        logger.info("broker %s removed (%s); forgot %d routed users",
+                    identifier, reason, len(dropped))
+
+    def has_broker(self, identifier: str) -> bool:
+        return identifier in self.brokers
+
+    def get_broker_connection(self, identifier: str) -> Optional[Connection]:
+        h = self.brokers.get(identifier)
+        return None if h is None else h.connection
+
+    def all_broker_identifiers(self) -> List[str]:
+        return list(self.brokers.keys())
+
+    @property
+    def num_brokers(self) -> int:
+        return len(self.brokers)
+
+    # ---- subscriptions ----------------------------------------------------
+
+    def subscribe_user_to(self, public_key: UserPublicKey,
+                          topics: List[Topic]) -> None:
+        if public_key in self.users and topics:
+            self.user_topics.associate_key_with_values(public_key, topics)
+
+    def unsubscribe_user_from(self, public_key: UserPublicKey,
+                              topics: List[Topic]) -> None:
+        if topics:
+            self.user_topics.dissociate_key_from_values(public_key, topics)
+
+    def subscribe_broker_to(self, identifier: str, topics: List[Topic]) -> None:
+        if identifier in self.brokers and topics:
+            self.broker_topics.associate_key_with_values(identifier, topics)
+
+    def unsubscribe_broker_from(self, identifier: str,
+                                topics: List[Topic]) -> None:
+        if topics:
+            self.broker_topics.dissociate_key_from_values(identifier, topics)
+
+    # ---- routing queries --------------------------------------------------
+
+    def get_broker_identifier_of_user(self,
+                                      public_key: UserPublicKey) -> Optional[str]:
+        """DirectMap lookup (connections/mod.rs:69)."""
+        return self.direct_map.get(public_key)
+
+    def get_interested_by_topic(self, topics: List[Topic], to_users_only: bool
+                                ) -> Tuple[List[UserPublicKey], List[str]]:
+        """Who should receive a broadcast on ``topics``
+        (connections/mod.rs:94-124). ``to_users_only=True`` is the
+        loop-prevention rule for broker-originated broadcasts."""
+        users = list(self.user_topics.get_keys_by_values(topics))
+        if to_users_only:
+            return users, []
+        return users, list(self.broker_topics.get_keys_by_values(topics))
+
+    # ---- sync generation (parity tasks/broker/sync.rs + mod.rs:205-237) ---
+
+    def get_full_user_sync(self) -> bytes:
+        return VersionedMap.serialize_entries(self.direct_map.full())
+
+    def get_partial_user_sync(self) -> Optional[bytes]:
+        delta = self.direct_map.diff()
+        if not delta:
+            return None
+        return VersionedMap.serialize_entries(delta)
+
+    def _refresh_our_topics(self) -> None:
+        """Fold the current local-interest topic set into our topic CRDT
+        (set-difference vs previous snapshot, connections/mod.rs:205-237)."""
+        current: Set[Topic] = set()
+        for user in self.user_topics.keys():
+            current |= self.user_topics.get_values_of_key(user)
+        for t in current - self._previous_local_topics:
+            self.our_topic_map.insert(t, int(SubscriptionStatus.SUBSCRIBED))
+        for t in self._previous_local_topics - current:
+            self.our_topic_map.insert(t, int(SubscriptionStatus.UNSUBSCRIBED))
+        self._previous_local_topics = current
+
+    def get_full_topic_sync(self) -> bytes:
+        self._refresh_our_topics()
+        return VersionedMap.serialize_entries(self.our_topic_map.full())
+
+    def get_partial_topic_sync(self) -> Optional[bytes]:
+        self._refresh_our_topics()
+        delta = self.our_topic_map.diff()
+        if not delta:
+            return None
+        return VersionedMap.serialize_entries(delta)
+
+    # ---- sync application -------------------------------------------------
+
+    def apply_user_sync(self, payload) -> List[UserPublicKey]:
+        """Merge a peer's DirectMap delta. Returns local users to EVICT
+        because the merge says they are now owned elsewhere — the
+        double-connect kick across brokers (connections/mod.rs:154-162)."""
+        incoming = VersionedMap.deserialize_entries(payload)
+        changed = self.direct_map.merge(incoming)
+        evict: List[UserPublicKey] = []
+        for key, _old, new in changed:
+            if new is not None and new != self.identity and key in self.users:
+                evict.append(key)
+        for key in evict:
+            logger.info("user %s connected elsewhere (%s); evicting",
+                        mnemonic(key), self.direct_map.get(key))
+            self.remove_user(key, reason="user connected elsewhere")
+        return evict
+
+    def apply_topic_sync(self, from_broker: str, payload) -> None:
+        """Merge a peer's advertised-topic delta into its per-broker map and
+        mirror the result into the broker interest index
+        (connections/mod.rs:165-191)."""
+        handle = self.brokers.get(from_broker)
+        if handle is None:
+            return
+        incoming = VersionedMap.deserialize_entries(payload)
+        changed = handle.topic_sync_map.merge(incoming)
+        for topic, _old, new in changed:
+            if new == int(SubscriptionStatus.SUBSCRIBED):
+                self.subscribe_broker_to(from_broker, [int(topic)])
+            else:
+                self.unsubscribe_broker_from(from_broker, [int(topic)])
+
+    # ---- teardown ---------------------------------------------------------
+
+    @staticmethod
+    def _teardown(handle) -> None:
+        if handle.abort_handle is not None:
+            handle.abort_handle.abort()
+        try:
+            handle.connection.close()
+        except Exception:
+            pass
+
+    def remove_all(self) -> None:
+        for key in list(self.users):
+            self.remove_user(key, "broker shutdown")
+        for ident in list(self.brokers):
+            self.remove_broker(ident, "broker shutdown")

@@ -1,0 +1,13 @@
+"""The device data plane: broker shards on a JAX mesh.
+
+This package is the TPU-native heart of the framework (SURVEY.md §2e /
+§7 stage 7). The host control plane (transports, auth, discovery) feeds
+fixed-shape HBM-resident state here:
+
+- ``frames``  — message frames packed into byte tensors (slot rings)
+- ``crdt``    — vectorized versioned-map merge (the DirectMap twin)
+- ``router``  — jitted broadcast/direct routing over a broker-mesh axis:
+  masked ``all_gather`` fan-out, ``ppermute`` direct hops
+- ``mesh``    — broker-mesh topology; answers "get_other_brokers" from mesh
+  coordinates instead of the discovery registry
+"""

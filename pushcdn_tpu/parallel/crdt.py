@@ -1,0 +1,134 @@
+"""Vectorized versioned-map CRDT — the device twin of
+:class:`pushcdn_tpu.broker.versioned_map.VersionedMap`.
+
+The host CRDT is a hash map with branchy per-key merge; on TPU the same
+semantics become an elementwise ``select`` over fixed-shape arrays
+(SURVEY.md §7 hard-part #2: per-key argmax over (version, identity)):
+
+- state is three aligned arrays over user slots:
+  ``owners[i]`` (int32 owning-broker mesh index, ``-1`` = absent/tombstone),
+  ``versions[i]`` (uint32 modification counter),
+  ``identities[i]`` (int32 conflict identity of the last modifier);
+- ``merge`` adopts the incoming entry wherever
+  ``(v_in > v_loc) | ((v_in == v_loc) & (id_in > id_loc))`` — exactly the
+  host ``VersionedValue.dominates`` rule, so the two implementations are
+  property-tested for equivalence (tests/test_crdt_device.py);
+- eviction ("user connected elsewhere", connections/mod.rs:154-162) falls
+  out as a mask: slots that changed AND are locally connected AND whose new
+  owner is not us.
+
+All functions are jit-safe (static shapes, no data-dependent control flow)
+and run identically under ``shard_map`` per mesh shard.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+ABSENT = -1  # owner value for "no claim / tombstone"
+
+
+class CrdtState(NamedTuple):
+    """Aligned per-slot CRDT arrays (one row of the DirectMap twin)."""
+
+    owners: jax.Array      # int32[N]
+    versions: jax.Array    # uint32[N]
+    identities: jax.Array  # int32[N]
+
+
+def empty_state(num_slots: int) -> CrdtState:
+    return CrdtState(
+        owners=jnp.full((num_slots,), ABSENT, dtype=jnp.int32),
+        versions=jnp.zeros((num_slots,), dtype=jnp.uint32),
+        identities=jnp.full((num_slots,), ABSENT, dtype=jnp.int32),
+    )
+
+
+def dominates(v_in: jax.Array, id_in: jax.Array,
+              v_loc: jax.Array, id_loc: jax.Array) -> jax.Array:
+    """Elementwise last-writer-wins: version, then ordered identity
+    (VersionedValue.dominates / versioned_map.rs:201-269)."""
+    return (v_in > v_loc) | ((v_in == v_loc) & (id_in > id_loc))
+
+
+@jax.jit
+def merge(local: CrdtState, incoming: CrdtState) -> Tuple[CrdtState, jax.Array]:
+    """Merge ``incoming`` into ``local``; returns (state', changed_mask).
+
+    ``changed_mask[i]`` is True where the live value (owner) actually
+    changed — the signal callers use for eviction, mirroring the host
+    ``VersionedMap.merge`` return value.
+    """
+    adopt = dominates(incoming.versions, incoming.identities,
+                      local.versions, local.identities)
+    # Slots the incoming delta doesn't mention carry version 0 → never adopt
+    # (version 0 is reserved: host versions start at 1).
+    adopt = adopt & (incoming.versions > 0)
+    new = CrdtState(
+        owners=jnp.where(adopt, incoming.owners, local.owners),
+        versions=jnp.where(adopt, incoming.versions, local.versions),
+        identities=jnp.where(adopt, incoming.identities, local.identities),
+    )
+    changed = adopt & (incoming.owners != local.owners)
+    return new, changed
+
+
+@jax.jit
+def eviction_mask(changed: jax.Array, new_owners: jax.Array,
+                  locally_connected: jax.Array, self_index: jax.Array
+                  ) -> jax.Array:
+    """Which locally-connected users must be kicked because the merged map
+    says another broker now owns them (the cross-broker double-connect
+    kick)."""
+    return changed & locally_connected & (new_owners != self_index) \
+        & (new_owners != ABSENT)
+
+
+@jax.jit
+def local_claim(state: CrdtState, slot_mask: jax.Array,
+                self_index: jax.Array) -> CrdtState:
+    """Claim every slot in ``slot_mask`` for ``self_index`` (vectorized
+    ``insert``: bump version, set identity)."""
+    return CrdtState(
+        owners=jnp.where(slot_mask, self_index, state.owners),
+        versions=jnp.where(slot_mask, state.versions + 1, state.versions),
+        identities=jnp.where(slot_mask, self_index, state.identities),
+    )
+
+
+@jax.jit
+def local_release(state: CrdtState, slot_mask: jax.Array,
+                  self_index: jax.Array) -> CrdtState:
+    """Tombstone every slot in ``slot_mask`` we still own (vectorized
+    ``remove_if_equals(slot, self)``)."""
+    ours = slot_mask & (state.owners == self_index)
+    return CrdtState(
+        owners=jnp.where(ours, ABSENT, state.owners),
+        versions=jnp.where(ours, state.versions + 1, state.versions),
+        identities=jnp.where(ours, self_index, state.identities),
+    )
+
+
+def merge_all_gathered(local: CrdtState, gathered: CrdtState,
+                       axis_size: int) -> Tuple[CrdtState, jax.Array]:
+    """Fold the deltas of every mesh peer (stacked on axis 0, e.g. from an
+    ``all_gather`` over the broker axis) into ``local`` — the device analog
+    of applying every peer's UserSync in one step.
+
+    ``gathered`` arrays have shape [axis_size, N]. Associative & commutative
+    (it's a join-semilattice), so a single pairwise reduction tree is exact.
+    """
+    def body(carry, xs):
+        state, changed_any = carry
+        incoming = CrdtState(*xs)
+        state, changed = merge(state, incoming)
+        return (state, changed_any | changed), None
+
+    init_changed = jnp.zeros(local.owners.shape, dtype=bool)
+    (state, changed), _ = jax.lax.scan(
+        body, (local, init_changed),
+        (gathered.owners, gathered.versions, gathered.identities))
+    return state, changed

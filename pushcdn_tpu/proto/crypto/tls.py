@@ -95,6 +95,10 @@ def load_ca(ca_cert_path: Optional[str] = None,
     """Load a CA from disk, or fall back to the process-local generated CA
     (parity ``load_ca``, tls.rs:52-70: None → baked-in local CA)."""
     global _CA_CACHE
+    if bool(ca_cert_path) != bool(ca_key_path):
+        from pushcdn_tpu.proto.error import ErrorKind, bail
+        bail(ErrorKind.PARSE,
+             "provide both ca_cert_path and ca_key_path, or neither")
     if ca_cert_path and ca_key_path:
         with open(ca_cert_path, "rb") as f:
             cert_pem = f.read()

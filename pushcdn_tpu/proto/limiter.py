@@ -41,7 +41,6 @@ class _ByteSemaphore:
     def __init__(self, capacity: int):
         self._capacity = capacity
         self._available = capacity
-        self._waiters: "asyncio.Queue[tuple[int, asyncio.Future]]" = None  # lazy
         self._wait_list: list[tuple[int, asyncio.Future]] = []
 
     async def acquire(self, n: int) -> None:
@@ -57,14 +56,10 @@ class _ByteSemaphore:
                 self._wait_list.remove((n, fut))
             elif fut.done() and not fut.cancelled():
                 # Woken and cancelled concurrently: hand the grant back.
-                self._release_granted(n)
+                self.release(n)
             raise
 
     def release(self, n: int) -> None:
-        self._available += n
-        self._wake()
-
-    def _release_granted(self, n: int) -> None:
         self._available += n
         self._wake()
 

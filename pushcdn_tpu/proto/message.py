@@ -242,7 +242,8 @@ def serialize(msg: Message) -> bytes:
             frame = bytes([kind]) + _U64.pack(msg.permit) + _U32.pack(len(ctx)) + ctx
         else:  # pragma: no cover - unreachable with the Message union
             bail(ErrorKind.SERIALIZE, f"unknown message kind {kind}")
-    except struct.error as exc:
+    except (struct.error, ValueError) as exc:
+        # ValueError covers topic values outside u8 range (bytes(topics)).
         bail(ErrorKind.SERIALIZE, f"field out of range serializing kind {kind}", exc)
     if len(frame) > MAX_MESSAGE_SIZE:
         bail(ErrorKind.EXCEEDED_SIZE,
@@ -317,6 +318,26 @@ def deserialize(frame: BytesLike) -> Message:
     except struct.error as exc:
         bail(ErrorKind.DESERIALIZE, f"truncated frame for kind {kind}", exc)
     bail(ErrorKind.DESERIALIZE, f"unknown message kind {kind}")
+
+
+def materialize(msg: Message) -> Message:
+    """Copy any zero-copy ``memoryview`` fields into owned ``bytes``.
+
+    ``deserialize`` returns views into the receive buffer; the buffer's pool
+    permit cannot be released while views outlive it. Convenience APIs
+    (``Connection.recv_message``) materialize so the permit accounting stays
+    exact; the broker hot path uses ``recv_raw`` + ``deserialize`` and
+    releases the permit after fan-out instead.
+    """
+    kind = msg.kind
+    if kind == KIND_DIRECT and isinstance(msg.message, memoryview):
+        return Direct(recipient=msg.recipient, message=bytes(msg.message))
+    if kind == KIND_BROADCAST and isinstance(msg.message, memoryview):
+        return Broadcast(topics=msg.topics, message=bytes(msg.message))
+    if kind in (KIND_USER_SYNC, KIND_TOPIC_SYNC) and isinstance(msg.payload, memoryview):
+        cls = UserSync if kind == KIND_USER_SYNC else TopicSync
+        return cls(payload=bytes(msg.payload))
+    return msg
 
 
 def peek_kind(frame: BytesLike) -> int:

@@ -96,6 +96,7 @@ class Histogram:
 
 
 _REGISTRY: Dict[str, object] = {}
+_BACKGROUND_TASKS: List[asyncio.Task] = []  # keep refs so GC can't kill them
 
 # Core connection metrics (parity connection/metrics.rs:13-28, incremented
 # by the transport layer at frame write/read).
@@ -155,5 +156,6 @@ async def serve_metrics(bind_endpoint: str) -> asyncio.AbstractServer:
                 pass
 
     server = await asyncio.start_server(handler, host, port)
-    asyncio.create_task(_running_latency_calculator())
+    if not _BACKGROUND_TASKS:  # exactly one calculator per process
+        _BACKGROUND_TASKS.append(asyncio.create_task(_running_latency_calculator()))
     return server

@@ -25,7 +25,7 @@ from typing import Optional
 from pushcdn_tpu.proto import MAX_MESSAGE_SIZE
 from pushcdn_tpu.proto.error import Error, ErrorKind, bail
 from pushcdn_tpu.proto.limiter import Bytes, Limiter, NO_LIMIT
-from pushcdn_tpu.proto.message import Message, deserialize, serialize
+from pushcdn_tpu.proto.message import Message, deserialize, materialize, serialize
 from pushcdn_tpu.proto import metrics as metrics_mod
 
 # Parity: 5 s read/write timeouts (protocols/mod.rs:336, :368, :379) and a
@@ -219,9 +219,13 @@ class Connection:
             await done
 
     async def recv_message(self) -> Message:
+        """Receive + decode one message, copying payload views out of the
+        receive buffer so the pool permit can be released immediately. Hot
+        paths that fan raw frames out should use :meth:`recv_raw` and
+        release after the last send instead."""
         raw = await self.recv_raw()
         try:
-            return deserialize(raw.data)
+            return materialize(deserialize(raw.data))
         finally:
             raw.release()
 

@@ -25,27 +25,75 @@ from pushcdn_tpu.proto.transport.base import (
 _DUPLEX_BUFFER = 8192  # parity: 8192-byte duplex buffers (memory.rs)
 
 
-class _PipeStream(RawStream):
-    """One side of an in-process duplex: reads from its own StreamReader,
-    writes by feeding the peer's StreamReader."""
+class _BoundedBuffer:
+    """A bounded in-process byte buffer with real backpressure: writers
+    block while ``len >= capacity`` (parity with the reference's 8192-byte
+    duplex halves — a fast producer cannot grow memory unboundedly)."""
 
-    def __init__(self):
-        self.reader = asyncio.StreamReader(limit=2**26)
-        self.peer: "_PipeStream" = None  # set by _duplex()
+    def __init__(self, capacity: int = _DUPLEX_BUFFER):
+        self.capacity = capacity
+        self._buf = bytearray()
+        self._eof = False
+        self._cond = asyncio.Condition()
+
+    async def write(self, data: bytes) -> None:
+        async with self._cond:
+            # Chunk so a frame larger than the capacity still flows.
+            view = memoryview(data)
+            while len(view):
+                while len(self._buf) >= self.capacity and not self._eof:
+                    await self._cond.wait()
+                if self._eof:
+                    raise ConnectionResetError("memory stream closed")
+                room = max(self.capacity - len(self._buf), 1)
+                self._buf += view[:room]
+                view = view[room:]
+                self._cond.notify_all()
+
+    async def read_exactly(self, n: int) -> bytes:
+        # Consume incrementally: n may exceed the buffer capacity (a frame
+        # bigger than the duplex window streams through it).
+        out = bytearray()
+        async with self._cond:
+            while len(out) < n:
+                if not self._buf:
+                    if self._eof:
+                        raise asyncio.IncompleteReadError(bytes(out), n)
+                    await self._cond.wait()
+                    continue
+                take = min(n - len(out), len(self._buf))
+                out += self._buf[:take]
+                del self._buf[:take]
+                self._cond.notify_all()
+            return bytes(out)
+
+    def set_eof(self) -> None:
+        self._eof = True
+        # May be called from sync context (abort); schedule the wakeup.
+        async def _notify():
+            async with self._cond:
+                self._cond.notify_all()
+        try:
+            asyncio.get_running_loop().create_task(_notify())
+        except RuntimeError:
+            pass
+
+
+class _PipeStream(RawStream):
+    """One side of an in-process duplex over two bounded buffers."""
+
+    def __init__(self, rx: _BoundedBuffer, tx: _BoundedBuffer):
+        self._rx = rx
+        self._tx = tx
         self._closed = False
 
     async def read_exactly(self, n: int) -> bytes:
-        return await self.reader.readexactly(n)
+        return await self._rx.read_exactly(n)
 
     async def write(self, data) -> None:
-        if self._closed or self.peer is None:
+        if self._closed:
             raise ConnectionResetError("memory stream closed")
-        if self.peer._closed:
-            raise ConnectionResetError("peer closed")
-        self.peer.reader.feed_data(bytes(data))
-        # Cooperative backpressure: yield so the peer can drain.
-        if len(self.peer.reader._buffer) > _DUPLEX_BUFFER:  # noqa: SLF001
-            await asyncio.sleep(0)
+        await self._tx.write(bytes(data))
 
     async def close(self) -> None:
         self.abort()
@@ -53,21 +101,13 @@ class _PipeStream(RawStream):
     def abort(self) -> None:
         if not self._closed:
             self._closed = True
-            if self.peer is not None:
-                try:
-                    self.peer.reader.feed_eof()
-                except Exception:
-                    pass
-            try:
-                self.reader.feed_eof()
-            except Exception:
-                pass
+            self._tx.set_eof()
+            self._rx.set_eof()
 
 
 def _duplex() -> Tuple[_PipeStream, _PipeStream]:
-    a, b = _PipeStream(), _PipeStream()
-    a.peer, b.peer = b, a
-    return a, b
+    ab, ba = _BoundedBuffer(), _BoundedBuffer()
+    return _PipeStream(rx=ba, tx=ab), _PipeStream(rx=ab, tx=ba)
 
 
 class _Registry:

@@ -44,20 +44,28 @@ class _TcpUnfinalized(UnfinalizedConnection):
 
 class TcpListener(Listener):
     def __init__(self):
-        self._accept_q: "asyncio.Queue[_TcpUnfinalized]" = asyncio.Queue()
+        self._accept_q: "asyncio.Queue" = asyncio.Queue()
         self._server: asyncio.AbstractServer = None
+        self._closed = False
         self.bound_port: int = 0
 
     async def _on_client(self, reader, writer):
         await self._accept_q.put(_TcpUnfinalized(reader, writer))
 
     async def accept(self) -> UnfinalizedConnection:
-        return await self._accept_q.get()
+        if self._closed:
+            bail(ErrorKind.CONNECTION, "listener closed")
+        item = await self._accept_q.get()
+        if item is None:  # close() sentinel
+            bail(ErrorKind.CONNECTION, "listener closed")
+        return item
 
     async def close(self) -> None:
+        self._closed = True
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        self._accept_q.put_nowait(None)  # wake any blocked accept()
 
 
 class Tcp(Protocol):

@@ -45,20 +45,28 @@ class _TlsUnfinalized(UnfinalizedConnection):
 
 class TcpTlsListener(Listener):
     def __init__(self):
-        self._accept_q: "asyncio.Queue[_TlsUnfinalized]" = asyncio.Queue()
+        self._accept_q: "asyncio.Queue" = asyncio.Queue()
         self._server: asyncio.AbstractServer = None
+        self._closed = False
         self.bound_port: int = 0
 
     async def _on_client(self, reader, writer):
         await self._accept_q.put(_TlsUnfinalized(reader, writer))
 
     async def accept(self) -> UnfinalizedConnection:
-        return await self._accept_q.get()
+        if self._closed:
+            bail(ErrorKind.CONNECTION, "listener closed")
+        item = await self._accept_q.get()
+        if item is None:  # close() sentinel
+            bail(ErrorKind.CONNECTION, "listener closed")
+        return item
 
     async def close(self) -> None:
+        self._closed = True
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        self._accept_q.put_nowait(None)  # wake any blocked accept()
 
 
 class TcpTls(Protocol):
@@ -70,12 +78,14 @@ class TcpTls(Protocol):
         host, port = parse_endpoint(endpoint)
         if use_local_authority:
             ctx = local_certificate().client_context()
+            server_hostname = LOCAL_SAN
         else:
             ctx = ssl.create_default_context()
+            server_hostname = host
         try:
             async with asyncio.timeout(CONNECT_TIMEOUT_S):
                 reader, writer = await asyncio.open_connection(
-                    host, port, ssl=ctx, server_hostname=LOCAL_SAN)
+                    host, port, ssl=ctx, server_hostname=server_hostname)
         except (OSError, ssl.SSLError, asyncio.TimeoutError) as exc:
             bail(ErrorKind.CONNECTION, f"tls connect to {endpoint} failed", exc)
         return Connection(AsyncioStream(reader, writer), limiter,

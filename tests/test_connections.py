@@ -1,0 +1,153 @@
+"""Connections state-plane tests: interest queries, sync generation and
+application, cross-broker double-connect eviction, topic-sync convergence
+(parity cdn-broker/src/connections/mod.rs:390-527)."""
+
+import asyncio
+
+from pushcdn_tpu.broker.connections import Connections, SubscriptionStatus
+from pushcdn_tpu.proto.transport.memory import gen_testing_connection_pair
+
+B1 = "pub1:1/priv1:1"
+B2 = "pub2:1/priv2:1"
+
+
+async def _user(conns: Connections, key: bytes, topics):
+    local, remote = await gen_testing_connection_pair()
+    conns.add_user(key, local, list(topics))
+    return remote
+
+
+async def _broker(conns: Connections, ident: str):
+    local, remote = await gen_testing_connection_pair()
+    conns.add_broker(ident, local)
+    return remote
+
+
+async def test_interest_queries_and_loop_prevention():
+    c = Connections(B1)
+    await _user(c, b"u1", [0])
+    await _user(c, b"u2", [0, 1])
+    await _broker(c, B2)
+    c.subscribe_broker_to(B2, [1])
+
+    users, brokers = c.get_interested_by_topic([0], to_users_only=False)
+    assert sorted(users) == [b"u1", b"u2"] and brokers == []
+    users, brokers = c.get_interested_by_topic([1], to_users_only=False)
+    assert users == [b"u2"] and brokers == [B2]
+    # to_users_only=True: the broker-originated loop-prevention rule
+    users, brokers = c.get_interested_by_topic([1], to_users_only=True)
+    assert users == [b"u2"] and brokers == []
+
+
+async def test_direct_map_claims_and_release():
+    c = Connections(B1)
+    await _user(c, b"alice", [])
+    assert c.get_broker_identifier_of_user(b"alice") == B1
+    c.remove_user(b"alice")
+    assert c.get_broker_identifier_of_user(b"alice") is None
+
+
+async def test_user_sync_round_trip_and_eviction():
+    """B1's claim propagates to B2; B2 taking the user over evicts it from
+    B1 on the next sync — the cross-broker double-connect kick."""
+    c1, c2 = Connections(B1), Connections(B2)
+    await _broker(c1, B2)
+    await _broker(c2, B1)
+
+    await _user(c1, b"alice", [0])
+    payload = c1.get_partial_user_sync()
+    assert payload is not None
+    c2.apply_user_sync(payload)
+    assert c2.get_broker_identifier_of_user(b"alice") == B1
+
+    # alice reconnects at B2: claim bumps version
+    await _user(c2, b"alice", [0])
+    payload2 = c2.get_partial_user_sync()
+    evicted = c1.apply_user_sync(payload2)
+    assert evicted == [b"alice"]
+    assert not c1.has_user(b"alice")
+    assert c1.get_broker_identifier_of_user(b"alice") == B2
+
+
+async def test_full_user_sync_on_join():
+    c1 = Connections(B1)
+    for i in range(5):
+        await _user(c1, f"user{i}".encode(), [])
+    c2 = Connections(B2)
+    c2.apply_user_sync(c1.get_full_user_sync())
+    for i in range(5):
+        assert c2.get_broker_identifier_of_user(f"user{i}".encode()) == B1
+
+
+async def test_topic_sync_updates_broker_interest():
+    c1, c2 = Connections(B1), Connections(B2)
+    await _broker(c2, B1)
+
+    await _user(c1, b"u", [0, 1])
+    payload = c1.get_partial_topic_sync()
+    assert payload is not None
+    c2.apply_topic_sync(B1, payload)
+    _users, brokers = c2.get_interested_by_topic([0], to_users_only=False)
+    assert brokers == [B1]
+
+    # unsubscribe: u drops topic 0 -> next delta flips it off
+    c1.unsubscribe_user_from(b"u", [0])
+    payload2 = c1.get_partial_topic_sync()
+    assert payload2 is not None
+    c2.apply_topic_sync(B1, payload2)
+    _users, brokers = c2.get_interested_by_topic([0], to_users_only=False)
+    assert brokers == []
+    _users, brokers = c2.get_interested_by_topic([1], to_users_only=False)
+    assert brokers == [B1]
+
+
+async def test_topic_sync_out_of_order_convergence():
+    """Deltas applied out of order still converge (parity
+    connections/mod.rs:473-526)."""
+    c1 = Connections(B1)
+    await _user(c1, b"u", [0])
+    d1 = c1.get_partial_topic_sync()
+    c1.unsubscribe_user_from(b"u", [0])
+    d2 = c1.get_partial_topic_sync()
+    c1.subscribe_user_to(b"u", [0])
+    d3 = c1.get_partial_topic_sync()
+
+    for order in ([d1, d2, d3], [d3, d1, d2], [d2, d3, d1]):
+        c2 = Connections(B2)
+        await _broker(c2, B1)
+        for d in order:
+            if d:
+                c2.apply_topic_sync(B1, d)
+        _u, brokers = c2.get_interested_by_topic([0], to_users_only=False)
+        assert brokers == [B1], order
+
+
+async def test_remove_broker_forgets_routed_users():
+    c1 = Connections(B1)
+    await _broker(c1, B2)
+    c1.apply_user_sync(
+        _seed_user_sync(B2, [b"remote-user-1", b"remote-user-2"]))
+    assert c1.get_broker_identifier_of_user(b"remote-user-1") == B2
+    c1.remove_broker(B2)
+    assert c1.get_broker_identifier_of_user(b"remote-user-1") is None
+    # forgetting is local-only: nothing queued for the next partial sync
+    assert c1.get_partial_user_sync() is None
+
+
+async def test_same_broker_double_connect_evicts_old():
+    c = Connections(B1)
+    r1 = await _user(c, b"alice", [0])
+    r2 = await _user(c, b"alice", [1])  # reconnect, same broker
+    assert c.num_users == 1
+    assert c.user_topics.get_values_of_key(b"alice") == {1}
+    del r1, r2
+
+
+def _seed_user_sync(owner: str, users):
+    """Hand-build a user-sync payload as if from a peer broker (the trick
+    the reference harness uses, cdn-broker/src/tests/mod.rs:356-382)."""
+    from pushcdn_tpu.broker.versioned_map import VersionedMap
+    m = VersionedMap(local_identity=owner)
+    for u in users:
+        m.insert(u, owner)
+    return VersionedMap.serialize_entries(m.full())

@@ -1,0 +1,153 @@
+"""VersionedMap CRDT semantics tests.
+
+Ports the exact scenarios of the reference's unit tests
+(cdn-broker/src/connections/versioned_map.rs:272-377): insert/remove,
+conflict resolution by ordered identity, partial diffs, purge; plus codec
+round-trips and out-of-order merge convergence.
+"""
+
+from pushcdn_tpu.broker.versioned_map import VersionedMap, VersionedValue
+
+
+def test_insert_get_remove():
+    m = VersionedMap(local_identity="b1/priv1")
+    m.insert(b"alice", "b1/priv1")
+    assert m.get(b"alice") == "b1/priv1"
+    assert b"alice" in m
+    assert len(m) == 1
+    removed = m.remove(b"alice")
+    assert removed == "b1/priv1"
+    assert m.get(b"alice") is None
+    assert len(m) == 0
+    # tombstone still present internally for propagation
+    assert b"alice" in m.full()
+
+
+def test_version_bumps_on_reinsert():
+    m = VersionedMap(local_identity="a")
+    m.insert("k", 1)
+    m.insert("k", 2)
+    m.remove("k")
+    assert m.full()["k"].version == 3
+
+
+def test_merge_last_writer_wins_by_version():
+    a = VersionedMap(local_identity="brokerA")
+    b = VersionedMap(local_identity="brokerB")
+    a.insert(b"user", "brokerA")
+    b.merge(a.diff())
+    assert b.get(b"user") == "brokerA"
+    # b takes over the user: higher version wins everywhere
+    b.insert(b"user", "brokerB")
+    changed = a.merge(b.diff())
+    assert a.get(b"user") == "brokerB"
+    assert [(k, new) for k, _old, new in changed] == [(b"user", "brokerB")]
+
+
+def test_merge_tie_broken_by_identity():
+    """Equal versions: the ordered conflict identity decides, identically on
+    both replicas (versioned_map.rs conflict-resolution test)."""
+    a = VersionedMap(local_identity="brokerA")
+    b = VersionedMap(local_identity="brokerZ")
+    a.insert(b"user", "brokerA")   # version 1, identity brokerA
+    b.insert(b"user", "brokerZ")   # version 1, identity brokerZ
+    delta_a, delta_b = a.diff(), b.diff()
+    a.merge(delta_b)
+    b.merge(delta_a)
+    assert a.get(b"user") == b.get(b"user") == "brokerZ"
+
+
+def test_merge_idempotent_and_stale_ignored():
+    a = VersionedMap(local_identity="A")
+    a.insert("k", "v1")
+    snapshot = dict(a.full())
+    a.insert("k", "v2")
+    changed = a.merge(snapshot)  # stale: version 1 < 2
+    assert changed == []
+    assert a.get("k") == "v2"
+    assert a.merge(a.full()) == []  # self-merge is a no-op
+
+
+def test_partial_diff_only_contains_modifications():
+    m = VersionedMap(local_identity="A")
+    m.insert("k1", 1)
+    m.insert("k2", 2)
+    assert set(m.diff().keys()) == {"k1", "k2"}
+    assert m.diff() == {}  # cleared
+    m.insert("k1", 10)
+    m.remove("k2")
+    d = m.diff()
+    assert set(d.keys()) == {"k1", "k2"}
+    assert d["k1"].value == 10
+    assert d["k2"].value is None  # tombstone travels in the diff
+
+
+def test_remove_if_equals():
+    m = VersionedMap(local_identity="A")
+    m.insert(b"u", "A")
+    assert not m.remove_if_equals(b"u", "B")
+    assert m.get(b"u") == "A"
+    assert m.remove_if_equals(b"u", "A")
+    assert m.get(b"u") is None
+
+
+def test_remove_by_value_no_modify():
+    m = VersionedMap(local_identity="A")
+    m.insert(b"u1", "B")
+    m.insert(b"u2", "B")
+    m.insert(b"u3", "C")
+    m.diff()  # clear modification tracking
+    dropped = m.remove_by_value_no_modify("B")
+    assert sorted(dropped) == [b"u1", b"u2"]
+    assert m.get(b"u1") is None and b"u1" not in m.full()  # no tombstone
+    assert m.diff() == {}  # not marked modified
+    assert m.get(b"u3") == "C"
+
+
+def test_purge_tombstones():
+    m = VersionedMap(local_identity="A")
+    m.insert("k1", 1)
+    m.insert("k2", 2)
+    m.remove("k1")
+    assert len(m.full()) == 2
+    assert m.purge_tombstones() == 1
+    assert len(m.full()) == 1
+    assert m.get("k2") == 2
+
+
+def test_out_of_order_delivery_converges():
+    """Deltas applied in any order converge (parity: the out-of-order
+    topic-sync test, connections/mod.rs:473-526)."""
+    src = VersionedMap(local_identity="S")
+    deltas = []
+    for i in range(5):
+        src.insert(b"user", f"owner-{i}")
+        deltas.append(src.diff())
+    import itertools
+    for perm in itertools.permutations(range(5)):
+        dst = VersionedMap(local_identity="D")
+        for i in perm:
+            dst.merge(deltas[i])
+        assert dst.get(b"user") == "owner-4"
+
+
+def test_codec_round_trip():
+    m = VersionedMap(local_identity="b1/p1")
+    m.insert(b"\x00\xffuser", "b2/p2")
+    m.insert(b"other", "b1/p1")
+    m.remove(b"other")
+    payload = VersionedMap.serialize_entries(m.full())
+    out = VersionedMap.deserialize_entries(payload)
+    assert out.keys() == m.full().keys()
+    for k, vv in m.full().items():
+        assert out[k].value == vv.value
+        assert out[k].version == vv.version
+        assert out[k].identity == vv.identity
+
+
+def test_codec_int_keys_topic_sync_shape():
+    m = VersionedMap(local_identity="b1/p1")
+    m.insert(3, 1)   # topic 3 SUBSCRIBED
+    m.insert(7, 0)   # topic 7 UNSUBSCRIBED
+    out = VersionedMap.deserialize_entries(VersionedMap.serialize_entries(m.full()))
+    assert out[3].value == 1 and out[7].value == 0

@@ -1,0 +1,127 @@
+"""Property-test: the vectorized device CRDT merge is equivalent to the
+host ``VersionedMap`` (SURVEY.md §7 stage 4: "property-test equivalence
+against the Python CRDT")."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from pushcdn_tpu.broker.versioned_map import VersionedMap, VersionedValue
+from pushcdn_tpu.parallel.crdt import (
+    ABSENT,
+    CrdtState,
+    empty_state,
+    eviction_mask,
+    local_claim,
+    local_release,
+    merge,
+    merge_all_gathered,
+)
+
+N = 64
+
+
+def _host_to_device(m: VersionedMap, n: int = N) -> CrdtState:
+    owners = np.full(n, ABSENT, np.int32)
+    versions = np.zeros(n, np.uint32)
+    identities = np.full(n, ABSENT, np.int32)
+    for k, vv in m.full().items():
+        owners[k] = ABSENT if vv.value is None else vv.value
+        versions[k] = vv.version
+        identities[k] = vv.identity
+    return CrdtState(jnp.asarray(owners), jnp.asarray(versions),
+                     jnp.asarray(identities))
+
+
+def _random_map(rng, ident: int, steps: int) -> VersionedMap:
+    m = VersionedMap(local_identity=ident)
+    for _ in range(steps):
+        k = rng.randrange(N)
+        if rng.random() < 0.25:
+            m.remove(k)
+        else:
+            m.insert(k, rng.randrange(8))
+    return m
+
+
+def test_merge_equivalence_randomized():
+    rng = random.Random(42)
+    for trial in range(20):
+        a = _random_map(rng, ident=rng.randrange(8), steps=rng.randrange(1, 80))
+        b = _random_map(rng, ident=rng.randrange(8), steps=rng.randrange(1, 80))
+
+        dev_a, dev_b = _host_to_device(a), _host_to_device(b)
+        merged_dev, changed = merge(dev_a, dev_b)
+
+        host = a  # merge b into a
+        host_changed = host.merge(b.full())
+
+        expect = _host_to_device(host)
+        np.testing.assert_array_equal(np.asarray(merged_dev.owners),
+                                      np.asarray(expect.owners), err_msg=f"trial {trial}")
+        np.testing.assert_array_equal(np.asarray(merged_dev.versions),
+                                      np.asarray(expect.versions))
+        np.testing.assert_array_equal(np.asarray(merged_dev.identities),
+                                      np.asarray(expect.identities))
+        # changed slots where live value changed must match host report
+        host_changed_slots = sorted(k for k, old, new in host_changed)
+        dev_changed_slots = sorted(np.nonzero(np.asarray(changed))[0].tolist())
+        assert dev_changed_slots == host_changed_slots
+
+
+def test_merge_commutative_and_idempotent():
+    rng = random.Random(7)
+    a = _host_to_device(_random_map(rng, 1, 50))
+    b = _host_to_device(_random_map(rng, 2, 50))
+    ab, _ = merge(a, b)
+    ba, _ = merge(b, a)
+    for x, y in zip(ab, ba):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    aa, changed = merge(ab, ab)
+    assert not np.asarray(changed).any()
+
+
+def test_claim_release_and_eviction_mask():
+    state = empty_state(8)
+    mask = jnp.asarray([True, True, False, False, False, False, False, False])
+    state = local_claim(state, mask, jnp.int32(3))
+    assert np.asarray(state.owners)[:2].tolist() == [3, 3]
+    assert np.asarray(state.versions)[:2].tolist() == [1, 1]
+
+    # peer 5 claims slot 0 with a higher version -> we must evict slot 0
+    peer = empty_state(8)
+    peer_mask = jnp.asarray([True] + [False] * 7)
+    peer = local_claim(peer, peer_mask, jnp.int32(5))
+    peer = local_claim(peer, peer_mask, jnp.int32(5))  # version 2 > our 1
+
+    merged, changed = merge(state, peer)
+    locally_connected = mask
+    evict = eviction_mask(changed, merged.owners, locally_connected, jnp.int32(3))
+    assert np.asarray(evict).tolist() == [True] + [False] * 7
+
+    # releasing slot 1 (still ours) tombstones it
+    rel_mask = jnp.asarray([False, True] + [False] * 6)
+    merged = local_release(merged, rel_mask, jnp.int32(3))
+    assert int(merged.owners[1]) == ABSENT
+    assert int(merged.versions[1]) == 2
+
+
+def test_merge_all_gathered_matches_sequential():
+    rng = random.Random(99)
+    local = _host_to_device(_random_map(rng, 0, 40))
+    peers = [_host_to_device(_random_map(rng, i + 1, 40)) for i in range(4)]
+    gathered = CrdtState(
+        owners=jnp.stack([p.owners for p in peers]),
+        versions=jnp.stack([p.versions for p in peers]),
+        identities=jnp.stack([p.identities for p in peers]),
+    )
+    folded, changed_any = merge_all_gathered(local, gathered, 4)
+    seq = local
+    changed_seq = np.zeros(N, bool)
+    for p in peers:
+        seq, ch = merge(seq, p)
+        changed_seq |= np.asarray(ch)
+    for x, y in zip(folded, seq):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    np.testing.assert_array_equal(np.asarray(changed_any), changed_seq)

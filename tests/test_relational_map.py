@@ -1,0 +1,65 @@
+"""RelationalMap bidirectional-multimap invariants (parity with the tests at
+cdn-broker/src/connections/broadcast/relational_map.rs:119-347)."""
+
+import random
+
+from pushcdn_tpu.broker.relational_map import RelationalMap
+
+
+def test_associate_and_lookup():
+    m = RelationalMap()
+    m.associate_key_with_values(b"u1", [0, 1])
+    m.associate_key_with_values(b"u2", [1, 2])
+    assert m.get_values_of_key(b"u1") == {0, 1}
+    assert m.get_keys_by_value(1) == {b"u1", b"u2"}
+    assert m.get_keys_by_values([0, 2]) == {b"u1", b"u2"}
+    assert m.get_keys_by_values([5]) == set()
+    assert m.check_invariants()
+
+
+def test_dissociate():
+    m = RelationalMap()
+    m.associate_key_with_values(b"u1", [0, 1, 2])
+    m.dissociate_key_from_values(b"u1", [1])
+    assert m.get_values_of_key(b"u1") == {0, 2}
+    assert m.get_keys_by_value(1) == set()
+    # dissociating everything drops the key entirely
+    m.dissociate_key_from_values(b"u1", [0, 2])
+    assert b"u1" not in m
+    assert len(m) == 0
+    assert m.check_invariants()
+
+
+def test_remove_key():
+    m = RelationalMap()
+    m.associate_key_with_values(b"u1", [0, 1])
+    m.associate_key_with_values(b"u2", [1])
+    gone = m.remove_key(b"u1")
+    assert gone == {0, 1}
+    assert m.get_keys_by_value(1) == {b"u2"}
+    assert m.get_keys_by_value(0) == set()
+    assert m.check_invariants()
+
+
+def test_dissociate_missing_is_noop():
+    m = RelationalMap()
+    m.dissociate_key_from_values(b"ghost", [1, 2])
+    assert m.remove_key(b"ghost") == set()
+    assert m.check_invariants()
+
+
+def test_randomized_invariants():
+    rng = random.Random(1234)
+    m = RelationalMap()
+    keys = [f"k{i}".encode() for i in range(10)]
+    for _ in range(2000):
+        op = rng.randrange(3)
+        key = rng.choice(keys)
+        vals = [rng.randrange(8) for _ in range(rng.randrange(1, 4))]
+        if op == 0:
+            m.associate_key_with_values(key, vals)
+        elif op == 1:
+            m.dissociate_key_from_values(key, vals)
+        else:
+            m.remove_key(key)
+    assert m.check_invariants()
