@@ -1,0 +1,184 @@
+"""Broker bootstrap and fail-fast supervision.
+
+Capability parity with cdn-broker/src/lib.rs:43-319: config → ``local_ip``
+substitution, discovery client, dual listeners (public = users, private =
+peer brokers), optional metrics endpoint; ``start`` spawns the five
+long-lived tasks (heartbeat, sync, whitelist, user listener, broker
+listener) and the process dies if any of them exits (lib.rs:302-318).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+from dataclasses import dataclass
+from typing import Optional
+
+from pushcdn_tpu.broker import metrics as broker_metrics
+from pushcdn_tpu.broker.connections import Connections
+from pushcdn_tpu.broker.tasks import heartbeat as heartbeat_task
+from pushcdn_tpu.broker.tasks import listeners as listener_tasks
+from pushcdn_tpu.broker.tasks import sync as sync_task
+from pushcdn_tpu.broker.tasks import whitelist as whitelist_task
+from pushcdn_tpu.proto import metrics as metrics_mod
+from pushcdn_tpu.proto.crypto.signature import KeyPair
+from pushcdn_tpu.proto.crypto.tls import Certificate, generate_cert_from_ca, load_ca
+from pushcdn_tpu.proto.def_ import RunDef
+from pushcdn_tpu.proto.discovery.base import BrokerIdentifier
+from pushcdn_tpu.proto.error import Error, ErrorKind, bail
+from pushcdn_tpu.proto.limiter import Limiter
+
+logger = logging.getLogger("pushcdn.broker")
+
+GIB = 1024 * 1024 * 1024
+
+
+def _substitute_local_ip(endpoint: str) -> str:
+    """Replace the magic host ``local_ip`` with this machine's primary
+    address (parity cdn-broker/src/lib.rs:157-168)."""
+    if not endpoint.startswith("local_ip"):
+        return endpoint
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))  # no traffic sent; just picks a route
+        ip = s.getsockname()[0]
+    except OSError:
+        ip = "127.0.0.1"
+    finally:
+        s.close()
+    return endpoint.replace("local_ip", ip, 1)
+
+
+@dataclass
+class BrokerConfig:
+    """Parity ``Config<R>`` (cdn-broker/src/lib.rs:43-96)."""
+
+    run_def: RunDef
+    keypair: KeyPair
+    discovery_endpoint: str
+    public_advertise_endpoint: str
+    public_bind_endpoint: str
+    private_advertise_endpoint: str
+    private_bind_endpoint: str
+    metrics_bind_endpoint: Optional[str] = None
+    ca_cert_path: Optional[str] = None
+    ca_key_path: Optional[str] = None
+    # 1 GiB default pool (binaries/broker.rs:67-72)
+    global_memory_pool_size: int = GIB
+    # operational cadences (heartbeat.rs:39,107; sync.rs:142; whitelist.rs)
+    heartbeat_interval_s: float = 10.0
+    sync_interval_s: float = 10.0
+    whitelist_interval_s: float = 60.0
+    membership_ttl_s: float = 60.0
+    auth_timeout_s: float = 5.0
+
+
+class Broker:
+    """One broker process (parity ``Broker``/``Inner``, lib.rs:98-319)."""
+
+    def __init__(self, config: BrokerConfig):
+        self.config = config
+        self.run_def = config.run_def
+        self.identity: BrokerIdentifier = None       # set in new()
+        self.discovery = None
+        self.limiter: Limiter = None
+        self.connections: Connections = None
+        self.certificate: Optional[Certificate] = None
+        self.user_listener = None
+        self.broker_listener = None
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = asyncio.Event()
+        self._metrics_server = None
+        self.seen_dialing: set[str] = set()  # peers we're currently dialing
+
+    @classmethod
+    async def new(cls, config: BrokerConfig) -> "Broker":
+        self = cls(config)
+        c = config
+
+        public_adv = _substitute_local_ip(c.public_advertise_endpoint)
+        private_adv = _substitute_local_ip(c.private_advertise_endpoint)
+        self.identity = BrokerIdentifier(public_adv, private_adv)
+
+        self.discovery = await self.run_def.discovery.new(
+            c.discovery_endpoint, identity=self.identity,
+            global_permits=self.run_def.global_permits)
+
+        ca_cert, ca_key = load_ca(c.ca_cert_path, c.ca_key_path)
+        self.certificate = generate_cert_from_ca(ca_cert, ca_key)
+
+        self.limiter = Limiter(global_pool_bytes=c.global_memory_pool_size)
+        self.connections = Connections(str(self.identity))
+
+        # public listener carries users, private carries peer brokers
+        # (lib.rs:190-212)
+        self.user_listener = await self.run_def.user_def.protocol.bind(
+            _substitute_local_ip(c.public_bind_endpoint),
+            certificate=self.certificate)
+        self.broker_listener = await self.run_def.broker_def.protocol.bind(
+            _substitute_local_ip(c.private_bind_endpoint),
+            certificate=self.certificate)
+
+        if c.metrics_bind_endpoint:
+            self._metrics_server = await metrics_mod.serve_metrics(
+                c.metrics_bind_endpoint)
+        logger.info("broker %s ready (users on %s, brokers on %s)",
+                    self.identity, c.public_bind_endpoint, c.private_bind_endpoint)
+        return self
+
+    # -- supervision --------------------------------------------------------
+
+    async def start(self) -> None:
+        """Spawn the five supervised tasks (lib.rs:269-318)."""
+        spawn = asyncio.create_task
+        self._tasks = [
+            spawn(heartbeat_task.run_heartbeat_task(self), name="heartbeat"),
+            spawn(sync_task.run_sync_task(self), name="sync"),
+            spawn(whitelist_task.run_whitelist_task(self), name="whitelist"),
+            spawn(listener_tasks.run_user_listener_task(self), name="user-listener"),
+            spawn(listener_tasks.run_broker_listener_task(self), name="broker-listener"),
+        ]
+
+    async def run_until_failure(self) -> None:
+        """Fail-fast: the first core task to exit brings the broker down
+        (parity select! at lib.rs:302-318)."""
+        await self.start()
+        done, _pending = await asyncio.wait(
+            self._tasks, return_when=asyncio.FIRST_COMPLETED)
+        task = done.pop()
+        exc = task.exception()
+        await self.stop()
+        if exc is not None:
+            raise Error(ErrorKind.CONNECTION,
+                        f"core task {task.get_name()!r} died: {exc!r}", exc)
+        bail(ErrorKind.CONNECTION, f"core task {task.get_name()!r} exited")
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        for t in self._tasks:
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        self.connections.remove_all()
+        for listener in (self.user_listener, self.broker_listener):
+            if listener is not None:
+                try:
+                    await listener.close()
+                except Exception:
+                    pass
+        if self.discovery is not None:
+            await self.discovery.close()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            await self._metrics_server.wait_closed()
+            self._metrics_server = None
+        broker_metrics.NUM_USERS_CONNECTED.set(0)
+        broker_metrics.NUM_BROKERS_CONNECTED.set(0)
+        logger.info("broker %s stopped", self.identity)
+
+    # -- convenience (used by tasks) ---------------------------------------
+
+    def update_metrics(self) -> None:
+        broker_metrics.NUM_USERS_CONNECTED.set(self.connections.num_users)
+        broker_metrics.NUM_BROKERS_CONNECTED.set(self.connections.num_brokers)

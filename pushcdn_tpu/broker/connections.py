@@ -215,9 +215,7 @@ class Connections:
     def _refresh_our_topics(self) -> None:
         """Fold the current local-interest topic set into our topic CRDT
         (set-difference vs previous snapshot, connections/mod.rs:205-237)."""
-        current: Set[Topic] = set()
-        for user in self.user_topics.keys():
-            current |= self.user_topics.get_values_of_key(user)
+        current: Set[Topic] = set(self.user_topics.values())
         for t in current - self._previous_local_topics:
             self.our_topic_map.insert(t, int(SubscriptionStatus.SUBSCRIBED))
         for t in self._previous_local_topics - current:

@@ -1,0 +1,8 @@
+"""Broker gauges (parity cdn-broker/src/metrics.rs:13-21)."""
+
+from pushcdn_tpu.proto.metrics import Gauge
+
+NUM_USERS_CONNECTED = Gauge("cdn_num_users_connected",
+                            "Users currently connected to this broker")
+NUM_BROKERS_CONNECTED = Gauge("cdn_num_brokers_connected",
+                              "Peer brokers currently connected to this broker")

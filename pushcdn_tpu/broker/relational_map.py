@@ -71,6 +71,11 @@ class RelationalMap(Generic[K, T]):
     def keys(self) -> List[K]:
         return list(self._forward.keys())
 
+    def values(self) -> List[T]:
+        """All values with ≥1 associated key — O(distinct values), straight
+        off the inverse index (used for 'which topics have local interest')."""
+        return list(self._inverse.keys())
+
     def __contains__(self, key: K) -> bool:
         return key in self._forward
 

@@ -1,0 +1,208 @@
+"""Receive loops + the routing core (the #1 hot path).
+
+Capability parity with cdn-broker/src/tasks/user/handler.rs:26-163 and
+tasks/broker/handler.rs:31-272:
+
+- ``user_receive_loop``: per-message recv-raw → deserialize (zero-copy) →
+  hook → route ``Direct``/``Broadcast`` to users **and** brokers, or apply
+  ``Subscribe``/``Unsubscribe`` locally; an invalid message disconnects the
+  user (user/handler.rs:104-161).
+- ``broker_receive_loop``: ``Direct`` → deliver to own user only
+  (``to_user_only=True``); ``Broadcast`` → local users only (prevents
+  re-forward loops); ``UserSync``/``TopicSync`` → CRDT merge
+  (broker/handler.rs:121-193).
+- ``handle_direct_message`` (broker/handler.rs:197-237): DirectMap lookup →
+  self? send-to-user : send-to-broker (suppressed when ``to_user_only``).
+- ``handle_broadcast_message`` (broker/handler.rs:240-272): interest query →
+  fan-out. The serialized frame is forwarded **verbatim** (one deserialize
+  per hop for dispatch; payload bytes shared via refcounted ``Bytes``).
+
+Latency accounting: each frame's pool permit lives from socket-read to
+last-fan-out-write; its lifetime feeds the LATENCY histogram
+(limiter.AllocationPermit), mirroring the reference's latency proxy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import TYPE_CHECKING, List, Sequence
+
+from pushcdn_tpu.broker.tasks.senders import (
+    try_send_to_broker,
+    try_send_to_brokers,
+    try_send_to_user,
+)
+from pushcdn_tpu.proto import metrics as metrics_mod
+from pushcdn_tpu.proto.def_ import HookResult
+from pushcdn_tpu.proto.error import Error
+from pushcdn_tpu.proto.limiter import Bytes
+from pushcdn_tpu.proto.message import (
+    Broadcast,
+    Direct,
+    Subscribe,
+    TopicSync,
+    Unsubscribe,
+    UserSync,
+    deserialize,
+)
+from pushcdn_tpu.proto.util import mnemonic
+
+if TYPE_CHECKING:
+    from pushcdn_tpu.broker.broker import Broker
+
+logger = logging.getLogger("pushcdn.broker")
+
+
+# ---------------------------------------------------------------------------
+# routing core
+# ---------------------------------------------------------------------------
+
+async def handle_direct_message(broker: "Broker", recipient: bytes,
+                                raw: Bytes, to_user_only: bool) -> None:
+    """One-hop direct routing (broker/handler.rs:197-237)."""
+    owner = broker.connections.get_broker_identifier_of_user(recipient)
+    if owner is None:
+        return  # unknown user: drop
+    if owner == broker.connections.identity:
+        await try_send_to_user(broker, recipient, raw)
+    elif not to_user_only:
+        # forward one hop to the owning broker; the remote end delivers
+        # with to_user_only=True so it can never bounce back
+        await try_send_to_broker(broker, owner, raw)
+
+
+async def handle_broadcast_message(broker: "Broker", topics: Sequence[int],
+                                   raw: Bytes, to_users_only: bool) -> None:
+    """Interest-driven fan-out (broker/handler.rs:240-272)."""
+    users, brokers = broker.connections.get_interested_by_topic(
+        list(topics), to_users_only)
+    for ident in brokers:
+        await try_send_to_broker(broker, ident, raw)
+    for user in users:
+        await try_send_to_user(broker, user, raw)
+
+
+# ---------------------------------------------------------------------------
+# user receive loop
+# ---------------------------------------------------------------------------
+
+async def user_receive_loop(broker: "Broker", public_key: bytes,
+                            connection) -> None:
+    """Pump one user's messages until the connection dies or the user is
+    kicked (user/handler.rs:104-161)."""
+    hook = broker.run_def.user_def.hook
+    topics = broker.run_def.topics
+    try:
+        while True:
+            raw = await connection.recv_raw()
+            try:
+                try:
+                    message = deserialize(raw.data)
+                except Error:
+                    # malformed frame ⇒ disconnect (user/handler.rs:106-118)
+                    logger.info("user %s sent malformed frame; disconnecting",
+                                mnemonic(public_key))
+                    break
+                result = hook(public_key, message)
+                if result == HookResult.SKIP:
+                    continue
+                if result == HookResult.DISCONNECT:
+                    break
+
+                if isinstance(message, Direct):
+                    await handle_direct_message(
+                        broker, message.recipient, raw, to_user_only=False)
+                elif isinstance(message, Broadcast):
+                    pruned, _bad = topics.prune(message.topics)
+                    if pruned:
+                        await handle_broadcast_message(
+                            broker, pruned, raw, to_users_only=False)
+                elif isinstance(message, Subscribe):
+                    pruned, bad = topics.prune(message.topics)
+                    if bad:
+                        # unknown topic ⇒ disconnect (subscribe.rs test
+                        # behavior: invalid-topic subscriptions kick)
+                        break
+                    broker.connections.subscribe_user_to(public_key, pruned)
+                elif isinstance(message, Unsubscribe):
+                    pruned, _bad = topics.prune(message.topics)
+                    broker.connections.unsubscribe_user_from(public_key, pruned)
+                else:
+                    # users may not send auth or sync messages post-handshake
+                    break
+            finally:
+                raw.release()
+    except (Error, asyncio.IncompleteReadError):
+        pass  # connection died: fall through to removal
+    except asyncio.CancelledError:
+        raise
+    finally:
+        # Only deregister if WE are still the registered connection — a
+        # same-broker double-connect evicts the old loop (cancelling it)
+        # after the new connection has already taken the map slot, and the
+        # old loop's cleanup must not remove the new entry.
+        if broker.connections.get_user_connection(public_key) is connection:
+            broker.connections.remove_user(public_key, reason="receive loop ended")
+        broker.update_metrics()
+
+
+# ---------------------------------------------------------------------------
+# broker receive loop
+# ---------------------------------------------------------------------------
+
+async def broker_receive_loop(broker: "Broker", identifier: str,
+                              connection) -> None:
+    """Pump a peer broker's messages (broker/handler.rs:121-193)."""
+    hook = broker.run_def.broker_def.hook
+    topics = broker.run_def.topics
+    try:
+        while True:
+            raw = await connection.recv_raw()
+            try:
+                try:
+                    message = deserialize(raw.data)
+                except Error:
+                    logger.warning("broker %s sent malformed frame; dropping link",
+                                   identifier)
+                    break
+                result = hook(identifier, message)
+                if result == HookResult.SKIP:
+                    continue
+                if result == HookResult.DISCONNECT:
+                    break
+
+                if isinstance(message, Direct):
+                    # deliver to our own user only — never re-forward
+                    # (broker/handler.rs:148-153)
+                    await handle_direct_message(
+                        broker, message.recipient, raw, to_user_only=True)
+                elif isinstance(message, Broadcast):
+                    # users only — prevents broadcast loops
+                    # (broker/handler.rs:156-161)
+                    pruned, _bad = topics.prune(message.topics)
+                    if pruned:
+                        await handle_broadcast_message(
+                            broker, pruned, raw, to_users_only=True)
+                elif isinstance(message, UserSync):
+                    broker.connections.apply_user_sync(message.payload)
+                    broker.update_metrics()
+                elif isinstance(message, TopicSync):
+                    broker.connections.apply_topic_sync(identifier,
+                                                        message.payload)
+                else:
+                    logger.warning("broker %s sent unexpected %s; dropping link",
+                                   identifier, type(message).__name__)
+                    break
+            finally:
+                raw.release()
+    except (Error, asyncio.IncompleteReadError):
+        pass
+    except asyncio.CancelledError:
+        raise
+    finally:
+        # Same guard as the user loop: a replaced link's cancelled loop must
+        # not deregister the replacement.
+        if broker.connections.get_broker_connection(identifier) is connection:
+            broker.connections.remove_broker(identifier, reason="receive loop ended")
+        broker.update_metrics()

@@ -1,0 +1,62 @@
+"""Heartbeat + mesh formation.
+
+Capability parity with cdn-broker/src/tasks/broker/heartbeat.rs:28-109:
+every heartbeat interval (10 s default), publish our user count to
+discovery with the membership TTL (60 s), fetch the peer set, and dial any
+live peer we aren't connected to — but only when ``peer ≥ self`` in the
+identifier total order, so each unordered pair is dialed from exactly one
+side (heartbeat.rs:69-73). The candidate list is shuffled to avoid
+lockstep connection storms (heartbeat.rs:77).
+
+The mesh self-heals through this task: a dead link is removed by the
+senders/receive loops, and the next tick re-dials (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import TYPE_CHECKING
+
+from pushcdn_tpu.broker.tasks.listeners import handle_broker_connection
+
+if TYPE_CHECKING:
+    from pushcdn_tpu.broker.broker import Broker
+
+logger = logging.getLogger("pushcdn.broker")
+
+
+async def _dial(broker: "Broker", peer) -> None:
+    peer_id = str(peer)
+    try:
+        connection = await broker.run_def.broker_def.protocol.connect(
+            peer.private_advertise_endpoint, limiter=broker.limiter)
+        await handle_broker_connection(broker, connection, outbound=True)
+    except Exception as exc:
+        logger.info("dial to broker %s failed: %r", peer_id, exc)
+    finally:
+        broker.seen_dialing.discard(peer_id)
+
+
+async def heartbeat_once(broker: "Broker") -> None:
+    await broker.discovery.perform_heartbeat(
+        broker.connections.num_users, broker.config.membership_ttl_s)
+    peers = await broker.discovery.get_other_brokers()
+    me = str(broker.identity)
+    candidates = [
+        p for p in peers
+        if str(p) >= me                                    # pairwise dedup
+        and not broker.connections.has_broker(str(p))      # not connected
+        and str(p) not in broker.seen_dialing              # not mid-dial
+    ]
+    random.shuffle(candidates)  # avoid lockstep (heartbeat.rs:77)
+    for peer in candidates:
+        broker.seen_dialing.add(str(peer))
+        asyncio.create_task(_dial(broker, peer))
+
+
+async def run_heartbeat_task(broker: "Broker") -> None:
+    while True:
+        await heartbeat_once(broker)
+        await asyncio.sleep(broker.config.heartbeat_interval_s)

@@ -1,0 +1,69 @@
+"""Send helpers. **Failure ⇒ removal**: a failed send is the fault
+detector — the peer is removed and its tasks aborted (parity
+cdn-broker/src/tasks/broker/sender.rs:17-58, tasks/user/sender.rs:16-32;
+SURVEY.md §5 "failure *is* an I/O error").
+
+All senders take refcounted :class:`Bytes` frames and clone per recipient —
+fan-out shares one payload buffer (Arc-clone parity, handler.rs hot path).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Iterable, List
+
+from pushcdn_tpu.proto.limiter import Bytes
+from pushcdn_tpu.proto.util import mnemonic
+
+if TYPE_CHECKING:
+    from pushcdn_tpu.broker.broker import Broker
+
+logger = logging.getLogger("pushcdn.broker")
+
+
+async def try_send_to_user(broker: "Broker", public_key: bytes,
+                           raw: Bytes) -> bool:
+    """Queue ``raw`` (one clone) to a local user; remove the user on
+    failure. The clone is released by the writer task after the frame hits
+    the stream, or by us on failure."""
+    connection = broker.connections.get_user_connection(public_key)
+    if connection is None:
+        return False
+    clone = raw.clone()
+    try:
+        await connection.send_raw(clone)
+        return True
+    except Exception as exc:
+        clone.release()
+        logger.info("send to user %s failed (%r); removing",
+                    mnemonic(public_key), exc)
+        broker.connections.remove_user(public_key, reason="send failed")
+        broker.update_metrics()
+        return False
+
+
+async def try_send_to_broker(broker: "Broker", identifier: str,
+                             raw: Bytes) -> bool:
+    connection = broker.connections.get_broker_connection(identifier)
+    if connection is None:
+        return False
+    clone = raw.clone()
+    try:
+        await connection.send_raw(clone)
+        return True
+    except Exception as exc:
+        clone.release()
+        logger.info("send to broker %s failed (%r); removing", identifier, exc)
+        broker.connections.remove_broker(identifier, reason="send failed")
+        broker.update_metrics()
+        return False
+
+
+async def try_send_to_brokers(broker: "Broker", identifiers: Iterable[str],
+                              raw: Bytes) -> int:
+    """Fan a frame out to many peers (sender.rs try_send_to_brokers)."""
+    sent = 0
+    for ident in list(identifiers):
+        if await try_send_to_broker(broker, ident, raw):
+            sent += 1
+    return sent

@@ -52,8 +52,11 @@ def encode_value(v, out: bytearray) -> None:
     elif isinstance(v, bool):
         bail(ErrorKind.SERIALIZE, "bool not supported in versioned-map codec")
     elif isinstance(v, int):
+        if not 0 <= v <= 0xFFFFFFFFFFFFFFFF:
+            bail(ErrorKind.SERIALIZE,
+                 f"int {v} out of u64 range in versioned-map codec")
         out.append(_T_INT)
-        out += _U64.pack(v & 0xFFFFFFFFFFFFFFFF)
+        out += _U64.pack(v)
     elif isinstance(v, (bytes, bytearray, memoryview)):
         b = bytes(v)
         out.append(_T_BYTES)
@@ -239,15 +242,20 @@ class VersionedMap(Generic[K, V, C]):
 
     @staticmethod
     def deserialize_entries(payload) -> Dict[K, VersionedValue[V, C]]:
-        view = memoryview(payload)
-        (n,) = _U32.unpack_from(view, 0)
-        off = 4
-        out: Dict[K, VersionedValue] = {}
-        for _ in range(n):
-            k, off = decode_value(view, off)
-            (version,) = _U64.unpack_from(view, off)
-            off += 8
-            identity, off = decode_value(view, off)
-            value, off = decode_value(view, off)
-            out[k] = VersionedValue(value, version, identity)
-        return out
+        """Raises ``Error(DESERIALIZE)`` on any truncated/malformed payload
+        so the broker receive loop's disconnect-the-peer policy applies."""
+        try:
+            view = memoryview(payload)
+            (n,) = _U32.unpack_from(view, 0)
+            off = 4
+            out: Dict[K, VersionedValue] = {}
+            for _ in range(n):
+                k, off = decode_value(view, off)
+                (version,) = _U64.unpack_from(view, off)
+                off += 8
+                identity, off = decode_value(view, off)
+                value, off = decode_value(view, off)
+                out[k] = VersionedValue(value, version, identity)
+            return out
+        except (struct.error, IndexError, UnicodeDecodeError) as exc:
+            bail(ErrorKind.DESERIALIZE, "malformed versioned-map payload", exc)

@@ -1,0 +1,3 @@
+"""The elastic, self-healing client (parity ``cdn-client``, SURVEY.md §2d)."""
+
+from pushcdn_tpu.client.client import Client, ClientConfig  # noqa: F401

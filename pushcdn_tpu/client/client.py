@@ -1,0 +1,197 @@
+"""The elastic client: auto-reconnecting, subscription-replaying.
+
+Capability parity with cdn-client/src/lib.rs:37-481:
+
+- shared state: marshal endpoint, keypair, subscribed-topic set, and an
+  optional live connection (lib.rs:37-69);
+- **single-flight reconnect**: one reconnect at a time, guarded by a
+  1-permit semaphore; concurrent callers wait for the winner
+  (lib.rs:204-258), retrying every 2 s with a 10 s per-attempt timeout;
+- on ANY send/recv error the connection is torn down and lazily re-dialed
+  (``disconnect_on_error!``, lib.rs:149-165) — the client re-authenticates
+  through the marshal, which re-load-balances it;
+- subscriptions are replayed during the broker handshake (topics ride the
+  ``Subscribe`` sent at auth, lib.rs:112-121), so a reconnect restores
+  delivery without caller involvement;
+- ``subscribe``/``unsubscribe`` compute deltas against the local topic set
+  and update it only on successful send (lib.rs:295-481 API semantics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import List, Optional, Set, Type
+
+from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME, KeyPair, SignatureScheme
+from pushcdn_tpu.proto.error import Error, ErrorKind, bail
+from pushcdn_tpu.proto.limiter import Limiter, NO_LIMIT
+from pushcdn_tpu.proto.auth import user as user_auth
+from pushcdn_tpu.proto.message import (
+    Broadcast,
+    Direct,
+    Message,
+    Subscribe,
+    Unsubscribe,
+    serialize,
+)
+from pushcdn_tpu.proto.transport.base import Connection, Protocol
+
+logger = logging.getLogger("pushcdn.client")
+
+RETRY_INTERVAL_S = 2.0      # lib.rs reconnect cadence
+CONNECT_TIMEOUT_S = 10.0    # per-attempt timeout
+
+
+@dataclass
+class ClientConfig:
+    """Parity with the client Config (cdn-client/src/lib.rs)."""
+
+    marshal_endpoint: str
+    keypair: KeyPair
+    protocol: Type[Protocol]
+    scheme: Type[SignatureScheme] = DEFAULT_SCHEME
+    subscribed_topics: Set[int] = field(default_factory=set)
+    use_local_authority: bool = True
+    limiter: Limiter = NO_LIMIT
+
+
+class Client:
+    """Clonable-by-reference handle over an elastic connection."""
+
+    def __init__(self, config: ClientConfig):
+        self.config = config
+        self._topics: Set[int] = set(config.subscribed_topics)
+        self._connection: Optional[Connection] = None
+        self._reconnect_sem = asyncio.Semaphore(1)  # single-flight guard
+
+    # -- connection management ---------------------------------------------
+
+    async def _connect_once(self) -> Connection:
+        """One full marshal→broker dance (ClientRef::connect, lib.rs:79-121)."""
+        c = self.config
+        # hop 1: marshal
+        marshal_conn = await c.protocol.connect(
+            c.marshal_endpoint, c.use_local_authority, c.limiter)
+        try:
+            permit, broker_endpoint = await user_auth.authenticate_with_marshal(
+                marshal_conn, c.scheme, c.keypair)
+        finally:
+            marshal_conn.close()
+        # hop 2: the assigned broker
+        broker_conn = await c.protocol.connect(
+            broker_endpoint, c.use_local_authority, c.limiter)
+        try:
+            await user_auth.authenticate_with_broker(
+                broker_conn, permit, sorted(self._topics))
+        except BaseException:
+            broker_conn.close()
+            raise
+        logger.info("connected to broker at %s", broker_endpoint)
+        return broker_conn
+
+    async def ensure_initialized(self) -> None:
+        """Block until a live connection exists (lib.rs:321)."""
+        await self._get_connection()
+
+    async def _get_connection(self) -> Connection:
+        conn = self._connection
+        if conn is not None and not conn.is_closed:
+            return conn
+        async with self._reconnect_sem:  # single-flight (lib.rs:204-258)
+            conn = self._connection
+            if conn is not None and not conn.is_closed:
+                return conn
+            while True:
+                try:
+                    async with asyncio.timeout(CONNECT_TIMEOUT_S):
+                        self._connection = await self._connect_once()
+                    return self._connection
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    logger.info("connect attempt failed (%r); retrying in %ss",
+                                exc, RETRY_INTERVAL_S)
+                    await asyncio.sleep(RETRY_INTERVAL_S)
+
+    def _disconnect_on_error(self) -> None:
+        """Tear the connection down so the next call re-dials
+        (disconnect_on_error!, lib.rs:149-165)."""
+        conn, self._connection = self._connection, None
+        if conn is not None:
+            conn.close()
+
+    # -- messaging API (lib.rs:295-481) -------------------------------------
+
+    async def send_message(self, message: Message) -> None:
+        conn = await self._get_connection()
+        try:
+            await conn.send_message(message)
+        except Exception as exc:
+            self._disconnect_on_error()
+            bail(ErrorKind.CONNECTION, "send failed; connection reset", exc)
+
+    async def send_broadcast_message(self, topics: List[int],
+                                     payload: bytes) -> None:
+        await self.send_message(Broadcast(topics=topics, message=payload))
+
+    async def send_direct_message(self, recipient_public_key: bytes,
+                                  payload: bytes) -> None:
+        await self.send_message(Direct(recipient=recipient_public_key,
+                                       message=payload))
+
+    async def receive_message(self) -> Message:
+        conn = await self._get_connection()
+        try:
+            return await conn.recv_message()
+        except Exception as exc:
+            self._disconnect_on_error()
+            bail(ErrorKind.CONNECTION, "receive failed; connection reset", exc)
+
+    # -- subscriptions -------------------------------------------------------
+
+    async def subscribe(self, topics: List[int]) -> None:
+        """Send only the delta; update local state on success (lib.rs
+        subscribe semantics)."""
+        new = [t for t in topics if t not in self._topics]
+        if not new:
+            return
+        conn = await self._get_connection()
+        try:
+            await conn.send_message(Subscribe(new), flush=True)
+        except Exception as exc:
+            self._disconnect_on_error()
+            bail(ErrorKind.CONNECTION, "subscribe failed", exc)
+        self._topics.update(new)
+
+    async def unsubscribe(self, topics: List[int]) -> None:
+        gone = [t for t in topics if t in self._topics]
+        if not gone:
+            return
+        conn = await self._get_connection()
+        try:
+            await conn.send_message(Unsubscribe(gone), flush=True)
+        except Exception as exc:
+            self._disconnect_on_error()
+            bail(ErrorKind.CONNECTION, "unsubscribe failed", exc)
+        self._topics.difference_update(gone)
+
+    @property
+    def subscribed_topics(self) -> Set[int]:
+        return set(self._topics)
+
+    @property
+    def public_key(self) -> bytes:
+        return self.config.keypair.public_key
+
+    # -- teardown ------------------------------------------------------------
+
+    async def soft_close(self) -> None:
+        conn = self._connection
+        if conn is not None:
+            await conn.soft_close()
+            self._connection = None
+
+    def close(self) -> None:
+        self._disconnect_on_error()

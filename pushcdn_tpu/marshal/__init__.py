@@ -1,0 +1,6 @@
+"""The marshal: authentication gateway / load balancer.
+
+Capability parity with the reference's ``cdn-marshal`` crate (SURVEY.md §2c).
+"""
+
+from pushcdn_tpu.marshal.marshal import Marshal, MarshalConfig  # noqa: F401

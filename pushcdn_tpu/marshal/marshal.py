@@ -1,0 +1,112 @@
+"""Marshal server: accept, verify, hand out a permit, soft-close.
+
+Capability parity with cdn-marshal/src/lib.rs:80-180 + handlers.rs:19-38:
+bind the user-facing listener, accept-loop, and for each connection run
+``MarshalAuth::verify_user`` under a 5 s timeout then soft-close. The
+marshal is stateless (all state lives in discovery) and horizontally
+scalable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from pushcdn_tpu.proto import metrics as metrics_mod
+from pushcdn_tpu.proto.auth import marshal as marshal_auth
+from pushcdn_tpu.proto.crypto.tls import Certificate, generate_cert_from_ca, load_ca
+from pushcdn_tpu.proto.def_ import RunDef
+from pushcdn_tpu.proto.error import Error
+from pushcdn_tpu.proto.limiter import Limiter
+
+logger = logging.getLogger("pushcdn.marshal")
+
+
+@dataclass
+class MarshalConfig:
+    """Parity with the marshal Config (cdn-marshal/src/lib.rs:30-76)."""
+
+    run_def: RunDef
+    discovery_endpoint: str
+    bind_endpoint: str  # default port 1737 in the reference binary
+    metrics_bind_endpoint: Optional[str] = None
+    ca_cert_path: Optional[str] = None
+    ca_key_path: Optional[str] = None
+    global_memory_pool_size: int = 1024 * 1024 * 1024
+    auth_timeout_s: float = 5.0
+
+
+class Marshal:
+    def __init__(self, config: MarshalConfig):
+        self.config = config
+        self.run_def = config.run_def
+        self.discovery = None
+        self.listener = None
+        self.limiter: Limiter = None
+        self.certificate: Optional[Certificate] = None
+        self._accept_task: Optional[asyncio.Task] = None
+        self._metrics_server = None
+
+    @classmethod
+    async def new(cls, config: MarshalConfig) -> "Marshal":
+        self = cls(config)
+        self.discovery = await self.run_def.discovery.new(
+            config.discovery_endpoint, identity=None,
+            global_permits=self.run_def.global_permits)
+        ca_cert, ca_key = load_ca(config.ca_cert_path, config.ca_key_path)
+        self.certificate = generate_cert_from_ca(ca_cert, ca_key)
+        self.limiter = Limiter(global_pool_bytes=config.global_memory_pool_size)
+        self.listener = await self.run_def.user_def.protocol.bind(
+            config.bind_endpoint, certificate=self.certificate)
+        if config.metrics_bind_endpoint:
+            self._metrics_server = await metrics_mod.serve_metrics(
+                config.metrics_bind_endpoint)
+        logger.info("marshal listening on %s", config.bind_endpoint)
+        return self
+
+    async def start(self) -> None:
+        self._accept_task = asyncio.create_task(self._accept_loop(),
+                                                name="marshal-accept")
+
+    async def _accept_loop(self) -> None:
+        while True:
+            unfinalized = await self.listener.accept()
+            asyncio.create_task(self._handle_connection(unfinalized))
+
+    async def _handle_connection(self, unfinalized) -> None:
+        """Parity handlers.rs:21-37: finalize → verify (5 s) → soft-close."""
+        connection = None
+        try:
+            connection = await unfinalized.finalize(self.limiter)
+            async with asyncio.timeout(self.config.auth_timeout_s):
+                public_key, permit = await marshal_auth.verify_user(
+                    connection, self.discovery,
+                    self.run_def.user_def.scheme)
+            await connection.soft_close()
+        except (Error, asyncio.TimeoutError) as exc:
+            logger.info("marshal auth failed: %r", exc)
+            if connection is not None:
+                connection.close()
+        except asyncio.CancelledError:
+            if connection is not None:
+                connection.close()
+            raise
+
+    async def stop(self) -> None:
+        if self._accept_task is not None:
+            self._accept_task.cancel()
+            try:
+                await self._accept_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self.listener is not None:
+            await self.listener.close()
+        if self.discovery is not None:
+            await self.discovery.close()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            await self._metrics_server.wait_closed()
+            self._metrics_server = None
+        logger.info("marshal stopped")

@@ -112,13 +112,13 @@ def local_release(state: CrdtState, slot_mask: jax.Array,
     )
 
 
-def merge_all_gathered(local: CrdtState, gathered: CrdtState,
-                       axis_size: int) -> Tuple[CrdtState, jax.Array]:
+def merge_all_gathered(local: CrdtState,
+                       gathered: CrdtState) -> Tuple[CrdtState, jax.Array]:
     """Fold the deltas of every mesh peer (stacked on axis 0, e.g. from an
     ``all_gather`` over the broker axis) into ``local`` — the device analog
     of applying every peer's UserSync in one step.
 
-    ``gathered`` arrays have shape [axis_size, N]. Associative & commutative
+    ``gathered`` arrays have shape [num_peers, N]. Associative & commutative
     (it's a join-semilattice), so a single pairwise reduction tree is exact.
     """
     def body(carry, xs):

@@ -1,0 +1,74 @@
+"""User-side authentication.
+
+Capability parity with cdn-proto/src/connection/auth/user.rs:28-162:
+
+1. ``authenticate_with_marshal`` — sign the current unix timestamp under the
+   ``USER_MARSHAL_AUTH`` namespace, send ``AuthenticateWithKey``, receive
+   ``AuthenticateResponse`` carrying ``(permit, broker_endpoint)``
+   (user.rs:50-86).
+2. ``authenticate_with_broker`` — redeem the permit at that broker, await
+   the ack, then send the ``Subscribe`` topic list so subscriptions survive
+   reconnects (user.rs:108-161).
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from typing import List, Tuple, Type
+
+from pushcdn_tpu.proto.crypto.signature import KeyPair, Namespace, SignatureScheme
+from pushcdn_tpu.proto.error import ErrorKind, bail
+from pushcdn_tpu.proto.message import (
+    AuthenticateResponse,
+    AuthenticateWithKey,
+    AuthenticateWithPermit,
+    Subscribe,
+)
+from pushcdn_tpu.proto.transport.base import Connection
+
+_TS = struct.Struct("<Q")
+
+
+def signable_timestamp(timestamp: int) -> bytes:
+    return _TS.pack(timestamp)
+
+
+async def authenticate_with_marshal(
+        connection: Connection, scheme: Type[SignatureScheme],
+        keypair: KeyPair) -> Tuple[int, str]:
+    """Returns ``(permit, broker_public_endpoint)`` or raises
+    ``Error(AUTHENTICATION)``."""
+    timestamp = int(time.time())
+    signature = scheme.sign(keypair.private_key, Namespace.USER_MARSHAL_AUTH,
+                            signable_timestamp(timestamp))
+    await connection.send_message(AuthenticateWithKey(
+        public_key=keypair.public_key, timestamp=timestamp,
+        signature=signature), flush=True)
+
+    response = await connection.recv_message()
+    if not isinstance(response, AuthenticateResponse):
+        bail(ErrorKind.AUTHENTICATION,
+             f"marshal sent unexpected {type(response).__name__}")
+    if response.permit <= 1:
+        # permit 0 = failure with reason; 1 would be a bare ack which the
+        # marshal never sends (message.rs:338-341 semantics)
+        bail(ErrorKind.AUTHENTICATION,
+             f"marshal rejected authentication: {response.context!r}")
+    return response.permit, response.context
+
+
+async def authenticate_with_broker(
+        connection: Connection, permit: int, topics: List[int]) -> None:
+    """Redeem ``permit``; on ack, send our subscription set (user.rs:108-161)."""
+    await connection.send_message(AuthenticateWithPermit(permit=permit),
+                                  flush=True)
+    response = await connection.recv_message()
+    if not isinstance(response, AuthenticateResponse):
+        bail(ErrorKind.AUTHENTICATION,
+             f"broker sent unexpected {type(response).__name__}")
+    if response.permit != 1:
+        bail(ErrorKind.AUTHENTICATION,
+             f"broker rejected permit: {response.context!r}")
+    # Replay our subscriptions as part of the handshake (user.rs:152-158).
+    await connection.send_message(Subscribe(topics), flush=True)

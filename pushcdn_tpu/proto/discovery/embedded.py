@@ -1,0 +1,164 @@
+"""Embedded discovery: SQLite-backed membership/permits/whitelist.
+
+Capability parity with cdn-proto/src/discovery/embedded.rs:39-423 (+ schema
+in cdn-proto/local_db/migrations.sql): same semantics as the Redis/KeyDB
+implementation with explicit expiry pruning — ``brokers`` rows age out after
+their heartbeat TTL, permits after theirs; whitelist is a plain key set and
+an EMPTY whitelist admits everyone.
+
+Used for local runs and single-process integration tests: every actor opens
+the same SQLite file, which stands in for KeyDB exactly the way the Memory
+transport stands in for the network (SURVEY.md §4).
+
+Operations are synchronous sqlite3 under the hood (they are local,
+microsecond-scale, and infrequent: heartbeats every 10 s, auth handshakes);
+the async interface is kept so the Redis implementation can be truly async.
+"""
+
+from __future__ import annotations
+
+import secrets
+import sqlite3
+import time
+from typing import List, Optional
+
+from pushcdn_tpu.proto.discovery.base import BrokerIdentifier, DiscoveryClient
+from pushcdn_tpu.proto.error import ErrorKind, bail
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS brokers (
+    identifier TEXT PRIMARY KEY,
+    num_connections INTEGER NOT NULL DEFAULT 0,
+    expiry REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS permits (
+    permit INTEGER PRIMARY KEY,
+    broker TEXT NOT NULL,
+    public_key BLOB NOT NULL,
+    expiry REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS whitelist (
+    public_key BLOB PRIMARY KEY
+);
+"""
+
+
+class Embedded(DiscoveryClient):
+    """SQLite discovery client (parity ``Embedded``, embedded.rs:39-423)."""
+
+    def __init__(self, path: str, identity: Optional[BrokerIdentifier],
+                 global_permits: bool = False):
+        self.path = path
+        self.identity = identity
+        # global_permits: permits redeemable at any broker (the reference's
+        # `global-permits` cargo feature, threaded through discovery/auth)
+        self.global_permits = global_permits
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA busy_timeout=5000")
+        self._db.executescript(_SCHEMA)
+        self._db.commit()
+
+    @classmethod
+    async def new(cls, endpoint: str,
+                  identity: Optional[BrokerIdentifier] = None,
+                  global_permits: bool = False) -> "Embedded":
+        """``endpoint`` is a filesystem path (or ":memory:" for throwaway)."""
+        try:
+            return cls(endpoint, identity, global_permits)
+        except sqlite3.Error as exc:
+            bail(ErrorKind.FILE, f"cannot open embedded discovery at {endpoint}", exc)
+
+    # -- membership ---------------------------------------------------------
+
+    def _prune(self) -> None:
+        now = time.time()
+        self._db.execute("DELETE FROM brokers WHERE expiry < ?", (now,))
+        self._db.execute("DELETE FROM permits WHERE expiry < ?", (now,))
+        self._db.commit()
+
+    async def perform_heartbeat(self, num_connections: int,
+                                heartbeat_expiry_s: float) -> None:
+        if self.identity is None:
+            bail(ErrorKind.PARSE, "heartbeat requires a broker identity")
+        self._db.execute(
+            "INSERT INTO brokers (identifier, num_connections, expiry) "
+            "VALUES (?, ?, ?) ON CONFLICT(identifier) DO UPDATE SET "
+            "num_connections=excluded.num_connections, expiry=excluded.expiry",
+            (str(self.identity), num_connections,
+             time.time() + heartbeat_expiry_s))
+        self._db.commit()
+
+    async def get_other_brokers(self) -> List[BrokerIdentifier]:
+        self._prune()
+        me = str(self.identity) if self.identity else None
+        rows = self._db.execute(
+            "SELECT identifier FROM brokers").fetchall()
+        return [BrokerIdentifier.from_string(r[0]) for r in rows
+                if r[0] != me]
+
+    async def get_with_least_connections(self) -> BrokerIdentifier:
+        """Load = live connections + outstanding permits (parity
+        redis.rs:139-167)."""
+        self._prune()
+        rows = self._db.execute(
+            "SELECT b.identifier, b.num_connections + "
+            " (SELECT COUNT(*) FROM permits p WHERE p.broker = b.identifier) "
+            "FROM brokers b ORDER BY 2 ASC, b.identifier ASC").fetchall()
+        if not rows:
+            bail(ErrorKind.CONNECTION, "no live brokers in discovery")
+        return BrokerIdentifier.from_string(rows[0][0])
+
+    # -- permits ------------------------------------------------------------
+
+    async def issue_permit(self, for_broker: BrokerIdentifier,
+                           expiry_s: float, public_key: bytes) -> int:
+        # permit semantics: 0=fail, 1=ack, >1=real permit (message.rs:338-341)
+        while True:
+            permit = secrets.randbits(62) + 2
+            try:
+                self._db.execute(
+                    "INSERT INTO permits (permit, broker, public_key, expiry) "
+                    "VALUES (?, ?, ?, ?)",
+                    (permit, str(for_broker), bytes(public_key),
+                     time.time() + expiry_s))
+                self._db.commit()
+                return permit
+            except sqlite3.IntegrityError:
+                continue  # permit collision: retry
+
+    async def validate_permit(self, broker: BrokerIdentifier,
+                              permit: int) -> Optional[bytes]:
+        """Redeem-and-delete (GETDEL parity, redis permit redemption)."""
+        self._prune()
+        row = self._db.execute(
+            "SELECT broker, public_key FROM permits WHERE permit = ?",
+            (permit,)).fetchone()
+        if row is None:
+            return None
+        if not self.global_permits and row[0] != str(broker):
+            return None  # issued for a different broker
+        self._db.execute("DELETE FROM permits WHERE permit = ?", (permit,))
+        self._db.commit()
+        return bytes(row[1])
+
+    # -- whitelist ----------------------------------------------------------
+
+    async def set_whitelist(self, users: List[bytes]) -> None:
+        self._db.execute("DELETE FROM whitelist")
+        self._db.executemany(
+            "INSERT OR IGNORE INTO whitelist (public_key) VALUES (?)",
+            [(bytes(u),) for u in users])
+        self._db.commit()
+
+    async def check_whitelist(self, user: bytes) -> bool:
+        n = self._db.execute("SELECT COUNT(*) FROM whitelist").fetchone()[0]
+        if n == 0:
+            return True  # empty whitelist admits everyone
+        row = self._db.execute(
+            "SELECT 1 FROM whitelist WHERE public_key = ?",
+            (bytes(user),)).fetchone()
+        return row is not None
+
+    async def close(self) -> None:
+        self._db.close()

@@ -173,12 +173,17 @@ class Connection:
             self._error = err
         self._closed = True
         self._stream.abort()
+        self._drain_queues(err)
         # Wake any blocked receiver.
         try:
             self._recv_q.put_nowait(err)
         except asyncio.QueueFull:
             pass
-        # Wake pending senders whose frames will never flush.
+
+    def _drain_queues(self, err: Optional[Error]) -> None:
+        """Release every queued frame's pool permit (both directions). A
+        closed/poisoned connection must hand its bytes back to the global
+        pool or fan-out clones leak permits until the broker stalls."""
         while True:
             try:
                 item = self._send_q.get_nowait()
@@ -190,7 +195,17 @@ class Connection:
             if isinstance(payload, Bytes):
                 payload.release()
             if done is not None and not done.done():
-                done.set_exception(err)
+                if err is not None:
+                    done.set_exception(err)
+                else:
+                    done.cancel()
+        while True:
+            try:
+                item = self._recv_q.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if isinstance(item, Bytes):
+                item.release()
 
     def _check(self) -> None:
         if self._error is not None:
@@ -261,11 +276,12 @@ class Connection:
         self._reader_task.cancel()
 
     def close(self) -> None:
-        """Tear down immediately (abort both tasks)."""
+        """Tear down immediately (abort both tasks, return queued permits)."""
         self._closed = True
         self._writer_task.cancel()
         self._reader_task.cancel()
         self._stream.abort()
+        self._drain_queues(self._error)
 
     @property
     def is_closed(self) -> bool:

@@ -1,0 +1,160 @@
+"""Deterministic broker routing tests on the injection harness.
+
+Parity with cdn-broker/src/tests/broadcast.rs:26-167 and
+tests/direct.rs:27-173: exact delivery sets, absence of duplicates, and the
+loop-prevention rules.
+"""
+
+import pytest
+
+from pushcdn_tpu.broker.test_harness import TestDefinition
+from pushcdn_tpu.proto.message import Broadcast, Direct
+
+# topics: TestTopic.GLOBAL=0, TestTopic.DA=1
+
+
+async def test_broadcast_from_user():
+    """User broadcast reaches subscribed users AND subscribed brokers;
+    unsubscribed entities get nothing (broadcast.rs user-origin case)."""
+    run = await TestDefinition(
+        connected_users=[[0], [0], [1]],
+        connected_brokers=[([0], []), ([1], [])],
+    ).run()
+    try:
+        msg = Broadcast(topics=[0], message=b"hello global")
+        await run.send_message_as(run.user(0), msg)
+        await run.assert_received(run.user(0), msg)   # sender is subscribed
+        await run.assert_received(run.user(1), msg)
+        await run.assert_received(run.peer(0), msg)   # subscribed peer
+        await run.assert_silence(run.user(2))          # wrong topic
+        await run.assert_silence(run.peer(1))          # wrong topic
+    finally:
+        await run.shutdown()
+
+
+async def test_broadcast_from_broker_loop_prevention():
+    """Broker-originated broadcast goes to local users ONLY — never
+    re-forwarded to other brokers (to_users_only, handler.rs:156-161)."""
+    run = await TestDefinition(
+        connected_users=[[0], [1]],
+        connected_brokers=[([0], []), ([0], [])],
+    ).run()
+    try:
+        msg = Broadcast(topics=[0], message=b"from peer")
+        await run.send_message_as(run.peer(0), msg)
+        await run.assert_received(run.user(0), msg)
+        await run.assert_silence(run.user(1))   # wrong topic
+        await run.assert_silence(run.peer(1))   # loop prevention
+        await run.assert_silence(run.peer(0))   # not echoed back
+    finally:
+        await run.shutdown()
+
+
+async def test_direct_user_to_self():
+    run = await TestDefinition(connected_users=[[0]]).run()
+    try:
+        msg = Direct(recipient=b"user-0", message=b"note to self")
+        await run.send_message_as(run.user(0), msg)
+        await run.assert_received(run.user(0), msg)
+    finally:
+        await run.shutdown()
+
+
+async def test_direct_user_to_user_same_broker():
+    run = await TestDefinition(connected_users=[[0], [0]],
+                               connected_brokers=[([], [])]).run()
+    try:
+        msg = Direct(recipient=b"user-1", message=b"hi neighbor")
+        await run.send_message_as(run.user(0), msg)
+        await run.assert_received(run.user(1), msg)
+        await run.assert_silence(run.user(0))
+        await run.assert_silence(run.peer(0))  # local delivery: no broker hop
+    finally:
+        await run.shutdown()
+
+
+async def test_direct_user_to_remote_broker():
+    """Recipient owned by a peer broker: exactly one forward to that peer
+    (direct.rs user→remote-broker case)."""
+    run = await TestDefinition(
+        connected_users=[[0]],
+        connected_brokers=[([], [b"remote-user"]), ([], [])],
+    ).run()
+    try:
+        msg = Direct(recipient=b"remote-user", message=b"cross-broker")
+        await run.send_message_as(run.user(0), msg)
+        await run.assert_received(run.peer(0), msg)  # the owner
+        await run.assert_silence(run.peer(1))         # nobody else
+        await run.assert_silence(run.user(0))
+    finally:
+        await run.shutdown()
+
+
+async def test_direct_from_broker_delivered_locally_only():
+    """A Direct arriving FROM a peer broker is delivered to our local user
+    (to_user_only) — and never bounced to another broker
+    (direct.rs broker→user + broker→user-not-returned cases)."""
+    run = await TestDefinition(
+        connected_users=[[0]],
+        connected_brokers=[([], []), ([], [b"foreign-user"])],
+    ).run()
+    try:
+        # delivered: we own user-0
+        msg = Direct(recipient=b"user-0", message=b"inbound")
+        await run.send_message_as(run.peer(0), msg)
+        await run.assert_received(run.user(0), msg)
+
+        # NOT re-forwarded: foreign-user is owned by peer(1), but a
+        # broker-originated Direct must never take a second broker hop
+        msg2 = Direct(recipient=b"foreign-user", message=b"should stop here")
+        await run.send_message_as(run.peer(0), msg2)
+        await run.assert_silence(run.peer(1))
+        await run.assert_silence(run.user(0))
+    finally:
+        await run.shutdown()
+
+
+async def test_unknown_recipient_dropped():
+    run = await TestDefinition(connected_users=[[0]]).run()
+    try:
+        await run.send_message_as(
+            run.user(0), Direct(recipient=b"ghost", message=b"anyone?"))
+        await run.assert_silence(run.user(0))
+    finally:
+        await run.shutdown()
+
+
+async def test_subscribe_unsubscribe_live():
+    """Subscriptions applied mid-connection change routing (parity
+    subscribe-delivery aspects of tests/subscribe.rs)."""
+    from pushcdn_tpu.proto.message import Subscribe, Unsubscribe
+    run = await TestDefinition(connected_users=[[0], []]).run()
+    try:
+        msg = Broadcast(topics=[1], message=b"DA block")
+        await run.send_message_as(run.user(0), msg)
+        await run.assert_silence(run.user(1))  # not yet subscribed
+
+        await run.send_message_as(run.user(1), Subscribe([1]))
+        import asyncio
+        await asyncio.sleep(0.05)  # let the receive loop apply it
+        await run.send_message_as(run.user(0), msg)
+        await run.assert_received(run.user(1), msg)
+
+        await run.send_message_as(run.user(1), Unsubscribe([1]))
+        await asyncio.sleep(0.05)
+        await run.send_message_as(run.user(0), msg)
+        await run.assert_silence(run.user(1))
+    finally:
+        await run.shutdown()
+
+
+async def test_malformed_frame_disconnects_user():
+    run = await TestDefinition(connected_users=[[0], [0]]).run()
+    try:
+        await run.user(0).remote.send_raw(b"\xfe garbage frame", flush=True)
+        import asyncio
+        await asyncio.sleep(0.1)
+        assert not run.broker.connections.has_user(b"user-0")
+        assert run.broker.connections.has_user(b"user-1")
+    finally:
+        await run.shutdown()

@@ -116,7 +116,7 @@ def test_merge_all_gathered_matches_sequential():
         versions=jnp.stack([p.versions for p in peers]),
         identities=jnp.stack([p.identities for p in peers]),
     )
-    folded, changed_any = merge_all_gathered(local, gathered, 4)
+    folded, changed_any = merge_all_gathered(local, gathered)
     seq = local
     changed_seq = np.zeros(N, bool)
     for p in peers:
