@@ -1,0 +1,2 @@
+"""Pallas TPU kernels for the hot routing ops (SURVEY.md §7 stage 7:
+"Pallas kernels for topic-mask × subscriber-gather")."""

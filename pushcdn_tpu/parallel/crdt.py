@@ -54,6 +54,17 @@ def dominates(v_in: jax.Array, id_in: jax.Array,
     return (v_in > v_loc) | ((v_in == v_loc) & (id_in > id_loc))
 
 
+def _adopt_mask(local: CrdtState, incoming: CrdtState) -> jax.Array:
+    """Where does the incoming entry win? The single source of truth for
+    the LWW rule (property-tested against the host VersionedMap); every
+    merge variant below routes through this."""
+    adopt = dominates(incoming.versions, incoming.identities,
+                      local.versions, local.identities)
+    # Slots the incoming delta doesn't mention carry version 0 → never adopt
+    # (version 0 is reserved: host versions start at 1).
+    return adopt & (incoming.versions > 0)
+
+
 @jax.jit
 def merge(local: CrdtState, incoming: CrdtState) -> Tuple[CrdtState, jax.Array]:
     """Merge ``incoming`` into ``local``; returns (state', changed_mask).
@@ -62,11 +73,7 @@ def merge(local: CrdtState, incoming: CrdtState) -> Tuple[CrdtState, jax.Array]:
     changed — the signal callers use for eviction, mirroring the host
     ``VersionedMap.merge`` return value.
     """
-    adopt = dominates(incoming.versions, incoming.identities,
-                      local.versions, local.identities)
-    # Slots the incoming delta doesn't mention carry version 0 → never adopt
-    # (version 0 is reserved: host versions start at 1).
-    adopt = adopt & (incoming.versions > 0)
+    adopt = _adopt_mask(local, incoming)
     new = CrdtState(
         owners=jnp.where(adopt, incoming.owners, local.owners),
         versions=jnp.where(adopt, incoming.versions, local.versions),
@@ -112,23 +119,51 @@ def local_release(state: CrdtState, slot_mask: jax.Array,
     )
 
 
-def merge_all_gathered(local: CrdtState,
-                       gathered: CrdtState) -> Tuple[CrdtState, jax.Array]:
-    """Fold the deltas of every mesh peer (stacked on axis 0, e.g. from an
+def merge_all_gathered_with_payload(
+        local: CrdtState, local_payload: jax.Array,
+        gathered: CrdtState, gathered_payload: jax.Array
+) -> Tuple[CrdtState, jax.Array, jax.Array]:
+    """Fold every mesh peer's delta (stacked on axis 0, e.g. from an
     ``all_gather`` over the broker axis) into ``local`` — the device analog
-    of applying every peer's UserSync in one step.
+    of applying every peer's UserSync in one step — with an aligned per-slot
+    ``payload`` array riding the same dominance decision: wherever a peer's
+    CRDT entry is adopted, its payload is adopted too.
 
-    ``gathered`` arrays have shape [num_peers, N]. Associative & commutative
-    (it's a join-semilattice), so a single pairwise reduction tree is exact.
+    The router uses the payload for each user's **topic-subscription
+    bitmask**: the owning broker is authoritative for the mask, so the mask
+    travels with the ownership claim (the device analog of the reference
+    pairing UserSync with TopicSync, tasks/broker/sync.rs).
+
+    ``gathered`` arrays have shape [num_peers, N]. The merge is associative
+    & commutative (a join-semilattice), so the sequential fold is exact.
     """
     def body(carry, xs):
-        state, changed_any = carry
-        incoming = CrdtState(*xs)
-        state, changed = merge(state, incoming)
-        return (state, changed_any | changed), None
+        state, payload, changed_any = carry
+        in_owners, in_versions, in_ids, in_payload = xs
+        incoming = CrdtState(in_owners, in_versions, in_ids)
+        adopt = _adopt_mask(state, incoming)
+        new_state = CrdtState(
+            owners=jnp.where(adopt, incoming.owners, state.owners),
+            versions=jnp.where(adopt, incoming.versions, state.versions),
+            identities=jnp.where(adopt, incoming.identities, state.identities),
+        )
+        new_payload = jnp.where(adopt, in_payload, payload)
+        changed = adopt & (incoming.owners != state.owners)
+        return (new_state, new_payload, changed_any | changed), None
 
     init_changed = jnp.zeros(local.owners.shape, dtype=bool)
-    (state, changed), _ = jax.lax.scan(
-        body, (local, init_changed),
-        (gathered.owners, gathered.versions, gathered.identities))
+    (state, payload, changed), _ = jax.lax.scan(
+        body, (local, local_payload, init_changed),
+        (gathered.owners, gathered.versions, gathered.identities,
+         gathered_payload))
+    return state, payload, changed
+
+
+def merge_all_gathered(local: CrdtState,
+                       gathered: CrdtState) -> Tuple[CrdtState, jax.Array]:
+    """Payload-free variant of :func:`merge_all_gathered_with_payload`."""
+    dummy = jnp.zeros(local.owners.shape, dtype=jnp.uint32)
+    g_dummy = jnp.zeros(gathered.owners.shape, dtype=jnp.uint32)
+    state, _payload, changed = merge_all_gathered_with_payload(
+        local, dummy, gathered, g_dummy)
     return state, changed

@@ -1,0 +1,189 @@
+"""Message frames as fixed-shape HBM byte tensors.
+
+This is the device-side twin of the wire format (SURVEY.md §7 stage 1
+"tensor packing" and hard-part #1): a batch of variable-length messages is
+packed into a fixed ``[SLOTS, FRAME_BYTES]`` uint8 tensor plus aligned
+metadata columns, so routing runs as vectorized ops instead of per-message
+Python:
+
+- ``kind``       int32[S]  — the wire kind tag (KIND_DIRECT/KIND_BROADCAST)
+- ``length``     int32[S]  — payload length in bytes (0 ⇒ empty slot)
+- ``topic_mask`` uint32[S] — broadcast interest bits (1 << topic)
+- ``dest``       int32[S]  — direct-recipient *user slot* (-1 for broadcast)
+- ``valid``      bool[S]   — slot occupancy
+
+The byte-semaphore backpressure of the host limiter becomes slot-credit
+accounting here: a ``FrameRing`` has a fixed number of slots, ``push`` fails
+when full, and the host pumps only as many messages per step as there are
+free slots ("block the reader, not the router" re-expressed for HBM).
+
+User identity on device is a dense *user slot* index managed by
+``UserSlots`` (public key ↔ slot), so the DirectMap twin
+(pushcdn_tpu.parallel.crdt) and the router index the same space.
+
+Messages larger than ``frame_bytes`` stay on the host path (the reference
+streams up to 512 MiB through one socket frame; the device plane is for the
+fan-out-heavy small/medium message regime where throughput is won).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pushcdn_tpu.proto.error import ErrorKind, bail
+from pushcdn_tpu.proto.message import KIND_BROADCAST, KIND_DIRECT
+
+DEFAULT_FRAME_BYTES = 1024
+DEFAULT_SLOTS = 1024
+
+
+class UserSlots:
+    """Dense user-slot allocator: public key ↔ int slot (device identity)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._key_to_slot: Dict[bytes, int] = {}
+        self._slot_to_key: List[Optional[bytes]] = [None] * capacity
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+
+    def assign(self, public_key: bytes) -> int:
+        slot = self._key_to_slot.get(public_key)
+        if slot is not None:
+            return slot
+        if not self._free:
+            bail(ErrorKind.EXCEEDED_SIZE,
+                 f"user-slot table full ({self.capacity})")
+        slot = self._free.pop()
+        self._key_to_slot[public_key] = slot
+        self._slot_to_key[slot] = public_key
+        return slot
+
+    def release(self, public_key: bytes) -> None:
+        slot = self._key_to_slot.pop(public_key, None)
+        if slot is not None:
+            self._slot_to_key[slot] = None
+            self._free.append(slot)
+
+    def slot_of(self, public_key: bytes) -> Optional[int]:
+        return self._key_to_slot.get(public_key)
+
+    def key_of(self, slot: int) -> Optional[bytes]:
+        return self._slot_to_key[slot]
+
+    def __len__(self) -> int:
+        return len(self._key_to_slot)
+
+
+@dataclass
+class FrameBatch:
+    """One step's worth of packed ingress frames (numpy, host-side; the
+    router moves them to device)."""
+
+    bytes_: np.ndarray      # uint8[S, F]
+    kind: np.ndarray        # int32[S]
+    length: np.ndarray     # int32[S]
+    topic_mask: np.ndarray  # uint32[S]
+    dest: np.ndarray        # int32[S]
+    valid: np.ndarray       # bool[S]
+
+    @property
+    def num_valid(self) -> int:
+        return int(self.valid.sum())
+
+
+class FrameRing:
+    """Fixed-capacity staging ring the host packs messages into.
+
+    ``push_*`` returns False when no slot is free (backpressure: the caller
+    keeps the message queued on the host). ``take_batch`` snapshots and
+    clears up to ``slots`` frames for one router step.
+    """
+
+    def __init__(self, slots: int = DEFAULT_SLOTS,
+                 frame_bytes: int = DEFAULT_FRAME_BYTES):
+        self.slots = slots
+        self.frame_bytes = frame_bytes
+        self._bytes = np.zeros((slots, frame_bytes), dtype=np.uint8)
+        self._kind = np.zeros(slots, dtype=np.int32)
+        self._length = np.zeros(slots, dtype=np.int32)
+        self._topic_mask = np.zeros(slots, dtype=np.uint32)
+        self._dest = np.full(slots, -1, dtype=np.int32)
+        self._valid = np.zeros(slots, dtype=bool)
+        self._next = 0
+        self._used = 0
+
+    @property
+    def free_slots(self) -> int:
+        return self.slots - self._used
+
+    def _alloc(self) -> Optional[int]:
+        # Slots fill sequentially and are only freed wholesale by
+        # take_batch, so the cursor always points at a free slot.
+        if self._used >= self.slots:
+            return None
+        i = self._next
+        self._next += 1
+        self._used += 1
+        return i
+
+    def _put(self, i: int, payload: bytes, kind: int, topic_mask: int,
+             dest: int) -> None:
+        n = len(payload)
+        self._bytes[i, :n] = np.frombuffer(payload, dtype=np.uint8)
+        if n < self.frame_bytes:
+            self._bytes[i, n:] = 0
+        self._kind[i] = kind
+        self._length[i] = n
+        self._topic_mask[i] = topic_mask
+        self._dest[i] = dest
+        self._valid[i] = True
+
+    def push_broadcast(self, payload: bytes, topic_mask: int) -> bool:
+        if len(payload) > self.frame_bytes:
+            bail(ErrorKind.EXCEEDED_SIZE,
+                 f"payload {len(payload)} B exceeds frame slot "
+                 f"{self.frame_bytes} B; use the host path")
+        i = self._alloc()
+        if i is None:
+            return False
+        self._put(i, payload, KIND_BROADCAST, topic_mask, -1)
+        return True
+
+    def push_direct(self, payload: bytes, dest_slot: int) -> bool:
+        if len(payload) > self.frame_bytes:
+            bail(ErrorKind.EXCEEDED_SIZE,
+                 f"payload {len(payload)} B exceeds frame slot "
+                 f"{self.frame_bytes} B; use the host path")
+        i = self._alloc()
+        if i is None:
+            return False
+        self._put(i, payload, KIND_DIRECT, 0, dest_slot)
+        return True
+
+    def take_batch(self) -> FrameBatch:
+        """Snapshot the ring as one step's batch and clear it (slot credits
+        return to the host pump)."""
+        batch = FrameBatch(
+            bytes_=self._bytes.copy(), kind=self._kind.copy(),
+            length=self._length.copy(), topic_mask=self._topic_mask.copy(),
+            dest=self._dest.copy(), valid=self._valid.copy(),
+        )
+        self._valid[:] = False
+        self._length[:] = 0
+        self._used = 0
+        self._next = 0
+        return batch
+
+
+def empty_batch(slots: int, frame_bytes: int) -> FrameBatch:
+    return FrameBatch(
+        bytes_=np.zeros((slots, frame_bytes), np.uint8),
+        kind=np.zeros(slots, np.int32),
+        length=np.zeros(slots, np.int32),
+        topic_mask=np.zeros(slots, np.uint32),
+        dest=np.full(slots, -1, np.int32),
+        valid=np.zeros(slots, bool),
+    )

@@ -1,0 +1,148 @@
+"""Broker-mesh topology: devices as broker shards.
+
+Capability parity with SURVEY.md §2e's north-star row "Discovery registry →
+device-mesh topology query": on a TPU pod the broker mesh is *static* — its
+membership is the device list of a ``jax.sharding.Mesh`` — so
+``get_other_brokers`` is answered from mesh coordinates with **zero I/O**,
+while permits + whitelist (durable, user-facing state) stay in a backing
+discovery store. Dynamic membership (the reference's churn case, bad-broker)
+maps to a **liveness mask** over a fixed max-size mesh (SURVEY.md §7 hard
+part #3): dead shards are masked out of routing rather than reshaping the
+mesh; re-forming the physical mesh is a slow-path host event.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from pushcdn_tpu.parallel.router import BROKER_AXIS
+from pushcdn_tpu.proto.discovery.base import BrokerIdentifier, DiscoveryClient
+from pushcdn_tpu.proto.discovery.embedded import Embedded
+from pushcdn_tpu.proto.error import ErrorKind, bail
+
+
+def make_broker_mesh(num_brokers: Optional[int] = None,
+                     devices=None) -> Mesh:
+    """A 1-D mesh whose ``"brokers"`` axis is the broker-shard axis.
+
+    On a pod slice the devices are laid out so neighboring broker indexes
+    are ICI neighbors (jax's default device order follows the torus);
+    inter-broker all_gathers then ride ICI rings, never DCN/host.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_brokers is not None:
+        if num_brokers > len(devices):
+            bail(ErrorKind.PARSE,
+                 f"asked for {num_brokers} broker shards but only "
+                 f"{len(devices)} devices are attached")
+        devices = devices[:num_brokers]
+    return Mesh(np.array(devices), (BROKER_AXIS,))
+
+
+def broker_identifier_for_device(mesh: Mesh, index: int) -> BrokerIdentifier:
+    """Synthesize the canonical identity of a device-resident broker shard.
+
+    The string form keeps the BrokerIdentifier total order aligned with the
+    mesh index order, so CRDT tie-breaks agree between the host plane and
+    the device plane.
+    """
+    dev = mesh.devices.flat[index]
+    return BrokerIdentifier(
+        public_advertise_endpoint=f"mesh{index:04d}:pub",
+        private_advertise_endpoint=f"device:{dev.id}",
+    )
+
+
+class MeshDiscovery(DiscoveryClient):
+    """Discovery backed by mesh topology for membership + an embedded store
+    for permits/whitelist.
+
+    - ``get_other_brokers`` / ``get_with_least_connections``: answered from
+      the mesh (+ liveness mask, + host-reported load), no I/O;
+    - ``issue_permit`` / ``validate_permit`` / whitelist: delegated to the
+      backing :class:`Embedded` store (durable, shared with the marshal).
+    """
+
+    def __init__(self, mesh: Mesh, backing: Embedded,
+                 identity: Optional[BrokerIdentifier]):
+        self.mesh = mesh
+        self.backing = backing
+        self.identity = identity
+        n = mesh.devices.size
+        self.live = np.ones(n, dtype=bool)     # liveness mask (host-managed)
+        self.load = np.zeros(n, dtype=np.int64)  # host-reported user counts
+        if identity is not None and identity not in self._identifiers():
+            bail(ErrorKind.PARSE,
+                 f"identity {identity} is not a shard of this mesh; use "
+                 "broker_identifier_for_device(mesh, i)")
+
+    @classmethod
+    async def new(cls, endpoint: str,
+                  identity: Optional[BrokerIdentifier] = None,
+                  global_permits: bool = False,
+                  mesh: Optional[Mesh] = None) -> "MeshDiscovery":
+        backing = await Embedded.new(endpoint, identity=identity,
+                                     global_permits=global_permits)
+        return cls(mesh if mesh is not None else make_broker_mesh(),
+                   backing, identity)
+
+    # -- membership from topology ------------------------------------------
+
+    def _identifiers(self) -> List[BrokerIdentifier]:
+        return [broker_identifier_for_device(self.mesh, i)
+                for i in range(self.mesh.devices.size)]
+
+    def mark_dead(self, index: int) -> None:
+        """Mask a shard out of routing (the churn slow-path)."""
+        self.live[index] = False
+
+    def mark_live(self, index: int) -> None:
+        self.live[index] = True
+
+    async def perform_heartbeat(self, num_connections: int,
+                                heartbeat_expiry_s: float) -> None:
+        """Load is recorded in-process; mesh membership needs no TTL (a
+        device doesn't silently leave — the host marks it dead)."""
+        if self.identity is None:
+            bail(ErrorKind.PARSE, "heartbeat requires a broker identity")
+        for i, ident in enumerate(self._identifiers()):
+            if ident == self.identity:
+                self.load[i] = num_connections
+                return
+
+    async def get_other_brokers(self) -> List[BrokerIdentifier]:
+        return [ident for i, ident in enumerate(self._identifiers())
+                if self.live[i] and ident != self.identity]
+
+    async def get_with_least_connections(self) -> BrokerIdentifier:
+        live = [(self.load[i], i) for i in range(self.mesh.devices.size)
+                if self.live[i]]
+        if not live:
+            bail(ErrorKind.CONNECTION, "no live broker shards in the mesh")
+        _load, i = min(live)
+        return broker_identifier_for_device(self.mesh, i)
+
+    # -- durable state: delegate -------------------------------------------
+
+    async def issue_permit(self, for_broker: BrokerIdentifier,
+                           expiry_s: float, public_key: bytes) -> int:
+        return await self.backing.issue_permit(for_broker, expiry_s, public_key)
+
+    async def validate_permit(self, broker: BrokerIdentifier,
+                              permit: int) -> Optional[bytes]:
+        return await self.backing.validate_permit(broker, permit)
+
+    async def set_whitelist(self, users: List[bytes]) -> None:
+        await self.backing.set_whitelist(users)
+
+    async def check_whitelist(self, user: bytes) -> bool:
+        return await self.backing.check_whitelist(user)
+
+    async def close(self) -> None:
+        await self.backing.close()

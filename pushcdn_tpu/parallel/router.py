@@ -1,0 +1,186 @@
+"""The device router: broadcast/direct fan-out over a broker-mesh axis.
+
+This is the TPU lowering of the broker hot path (SURVEY.md §2e / §7 stage
+7). The reference routes by hash-map lookups and per-peer TCP writes
+(cdn-broker/src/tasks/broker/handler.rs:197-272); here one jitted step,
+run under ``shard_map`` over the ``"brokers"`` mesh axis, does the same
+work for a whole batch at once:
+
+- **inter-broker hop** = one ``all_gather`` of the frame tensors over the
+  broker axis (ICI) — every frame crosses the mesh exactly once, the
+  vectorized analog of the reference's "deserialize once per hop, forward
+  raw bytes" rule;
+- **CRDT sync** rides the same step: per-shard DirectMap claims are
+  all-gathered and folded with the versioned dominance rule
+  (pushcdn_tpu.parallel.crdt) — the 10 s sync task becomes a per-step
+  merge, and user topic masks travel with the ownership claim;
+- **broadcast routing** = a topic-bitmask AND between every gathered frame
+  and every local user (VPU; optionally the Pallas kernel in
+  pushcdn_tpu.ops.topic_kernel);
+- **direct routing** = equality match of the frame's destination user slot
+  against locally-owned users — delivery-iff-owner makes the reference's
+  ``to_user_only`` loop-prevention rule structural: nothing is ever
+  re-forwarded;
+- **double-connect eviction** falls out of the merge's changed-mask
+  (``evictions``), exactly like ``apply_user_sync``'s kick list.
+
+Outputs stay on device as ``(gathered frames, delivery mask)``; the host
+egress pump walks the mask to enqueue frame bytes to user sockets.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pushcdn_tpu.parallel.crdt import (
+    CrdtState,
+    empty_state,
+    merge_all_gathered_with_payload,
+)
+from pushcdn_tpu.ops.delivery_kernel import delivery_matrix
+
+BROKER_AXIS = "brokers"
+
+# None = auto (Pallas on TPU / interpreter elsewhere when shapes align);
+# flip to False to force the jnp reference path (bench comparisons).
+USE_PALLAS_DELIVERY: Optional[bool] = None
+
+
+class RouterState(NamedTuple):
+    """Per-shard routing state: the DirectMap twin + per-user topic masks."""
+
+    crdt: CrdtState          # owners/versions/identities, each int32/uint32[U]
+    topic_masks: jax.Array   # uint32[U] — authoritative at the owner
+
+
+class IngressBatch(NamedTuple):
+    """One step of packed ingress frames (see parallel.frames)."""
+
+    frame_bytes: jax.Array  # uint8[S, F]
+    kind: jax.Array         # int32[S]
+    length: jax.Array       # int32[S]
+    topic_mask: jax.Array   # uint32[S]
+    dest: jax.Array         # int32[S]
+    valid: jax.Array        # bool[S]
+
+
+class RouteResult(NamedTuple):
+    gathered_bytes: jax.Array   # uint8[B*S, F] — every frame, post-ICI
+    gathered_length: jax.Array  # int32[B*S]
+    deliver: jax.Array          # bool[U, B*S] — local delivery matrix
+    state: RouterState          # merged CRDT + masks
+    evictions: jax.Array        # bool[U] — locally-owned users now owned elsewhere
+
+
+def empty_router_state(num_users: int) -> RouterState:
+    return RouterState(
+        crdt=empty_state(num_users),
+        topic_masks=jnp.zeros((num_users,), dtype=jnp.uint32),
+    )
+
+
+def routing_step(state: RouterState, batch: IngressBatch,
+                 my_index: jax.Array, axis_name: Optional[str]
+                 ) -> RouteResult:
+    """One routing step for one broker shard.
+
+    With ``axis_name=None`` this is the single-broker fast path (no
+    collectives — the degenerate mesh). Under ``shard_map`` the gathers run
+    over ICI.
+    """
+    U = state.topic_masks.shape[0]
+
+    def gather(x):
+        if axis_name is None:
+            return x[None]  # [1, ...]
+        return jax.lax.all_gather(x, axis_name)
+
+    # ---- 1. the inter-broker hop: one all_gather over ICI ----------------
+    g_bytes = gather(batch.frame_bytes)     # [B, S, F]
+    g_kind = gather(batch.kind)             # [B, S]
+    g_length = gather(batch.length)
+    g_tmask = gather(batch.topic_mask)
+    g_dest = gather(batch.dest)
+    g_valid = gather(batch.valid)
+
+    # ---- 2. CRDT anti-entropy rides the same step ------------------------
+    g_owners = gather(state.crdt.owners)         # [B, U]
+    g_versions = gather(state.crdt.versions)
+    g_ids = gather(state.crdt.identities)
+    g_masks = gather(state.topic_masks)
+    was_local = state.crdt.owners == my_index
+    merged, masks, _changed = merge_all_gathered_with_payload(
+        state.crdt, state.topic_masks,
+        CrdtState(g_owners, g_versions, g_ids), g_masks)
+    now_local = merged.owners == my_index
+    evictions = was_local & ~now_local  # "user connected elsewhere" kick
+
+    # ---- 3. delivery matrix for locally-owned users ----------------------
+    # (fused Pallas kernel on TPU; jnp reference elsewhere)
+    B, S = g_kind.shape
+    valid_f = g_valid.reshape(B * S)
+    kind_f = jnp.where(valid_f, g_kind.reshape(B * S), 0)  # invalid ⇒ kind 0
+    tmask_f = g_tmask.reshape(B * S)
+    dest_f = g_dest.reshape(B * S)
+
+    deliver = delivery_matrix(masks, now_local, tmask_f, kind_f, dest_f,
+                              use_pallas=USE_PALLAS_DELIVERY)
+
+    return RouteResult(
+        gathered_bytes=g_bytes.reshape(B * S, -1),
+        gathered_length=g_length.reshape(B * S),
+        deliver=deliver,
+        state=RouterState(crdt=merged, topic_masks=masks),
+        evictions=evictions,
+    )
+
+
+# ---------------------------------------------------------------------------
+# jitted entry points
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def routing_step_single(state: RouterState, batch: IngressBatch
+                        ) -> RouteResult:
+    """Single-chip step (mesh of one): the compile-checked `entry()` path."""
+    return routing_step(state, batch, jnp.int32(0), axis_name=None)
+
+
+def make_mesh_routing_step(mesh: Mesh):
+    """Build the multi-chip step: state+batch sharded over the broker axis,
+    one jitted shard_map program (SURVEY.md §7 stage 7: broker shards ↔
+    devices of a jax mesh)."""
+
+    def per_shard(state_leaves, batch_leaves):
+        state = RouterState(CrdtState(*state_leaves[:3]), state_leaves[3])
+        batch = IngressBatch(*batch_leaves)
+        # shard_map gives each shard its [1, ...] block; drop the outer axis
+        state = jax.tree.map(lambda x: x[0], state)
+        batch = jax.tree.map(lambda x: x[0], batch)
+        my = jax.lax.axis_index(BROKER_AXIS).astype(jnp.int32)
+        result = routing_step(state, batch, my, axis_name=BROKER_AXIS)
+        # re-add the sharded leading axis for the outputs
+        return jax.tree.map(lambda x: x[None], tuple(result))
+
+    sharded = jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(BROKER_AXIS), P(BROKER_AXIS)),
+        out_specs=P(BROKER_AXIS),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(state_stacked: RouterState, batch_stacked: IngressBatch):
+        """``state_stacked``/``batch_stacked`` carry a leading [B] axis
+        sharded over the mesh; returns a stacked RouteResult."""
+        out = sharded(tuple((*state_stacked.crdt, state_stacked.topic_masks)),
+                      tuple(batch_stacked))
+        return RouteResult(
+            gathered_bytes=out[0], gathered_length=out[1], deliver=out[2],
+            state=out[3], evictions=out[4])
+
+    return step

@@ -9,11 +9,18 @@ a TPU pod with virtual CPU devices (SURVEY.md §4).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin's sitecustomize overwrites jax_platforms to
+# "axon,cpu" regardless of the env var; force CPU before any backend
+# initializes so tests run on the virtual 8-device mesh, not the tunnel.
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

@@ -1,0 +1,66 @@
+"""MeshDiscovery: membership from mesh topology, durable state delegated
+(SURVEY.md §2e: device-mesh topology replaces the broker registry)."""
+
+import os
+import tempfile
+
+from pushcdn_tpu.parallel.mesh import (
+    MeshDiscovery,
+    broker_identifier_for_device,
+    make_broker_mesh,
+)
+
+
+def _db():
+    return os.path.join(tempfile.mkdtemp(prefix="pushcdn-mesh-"), "d.sqlite")
+
+
+async def test_membership_from_topology():
+    mesh = make_broker_mesh()
+    me = broker_identifier_for_device(mesh, 0)
+    disc = await MeshDiscovery.new(_db(), identity=me, mesh=mesh)
+    others = await disc.get_other_brokers()
+    assert len(others) == mesh.devices.size - 1
+    assert me not in others
+    await disc.close()
+
+
+async def test_least_connections_uses_host_load_and_liveness():
+    mesh = make_broker_mesh()
+    disc = await MeshDiscovery.new(
+        _db(), identity=broker_identifier_for_device(mesh, 0), mesh=mesh)
+    # shard 0 reports load 5; everyone else 0 -> pick shard 1 (lowest index
+    # among zero-load shards)
+    await disc.perform_heartbeat(5, 60.0)
+    pick = await disc.get_with_least_connections()
+    assert pick == broker_identifier_for_device(mesh, 1)
+    # mark shards dead: they leave membership and placement
+    for i in range(1, mesh.devices.size):
+        disc.mark_dead(i)
+    pick = await disc.get_with_least_connections()
+    assert pick == broker_identifier_for_device(mesh, 0)
+    assert await disc.get_other_brokers() == []
+    await disc.close()
+
+
+async def test_permits_and_whitelist_delegate():
+    mesh = make_broker_mesh()
+    b0 = broker_identifier_for_device(mesh, 0)
+    disc = await MeshDiscovery.new(_db(), identity=b0, mesh=mesh)
+    permit = await disc.issue_permit(b0, 30.0, b"user-key")
+    assert permit > 1
+    assert await disc.validate_permit(b0, permit) == b"user-key"
+    assert await disc.validate_permit(b0, permit) is None  # single-use
+    await disc.set_whitelist([b"a"])
+    assert await disc.check_whitelist(b"a")
+    assert not await disc.check_whitelist(b"b")
+    await disc.close()
+
+
+def test_identifier_order_matches_mesh_order():
+    """CRDT tie-breaks must agree between host (string order) and device
+    (index order)."""
+    mesh = make_broker_mesh()
+    idents = [str(broker_identifier_for_device(mesh, i))
+              for i in range(mesh.devices.size)]
+    assert idents == sorted(idents)

@@ -1,0 +1,214 @@
+"""Device-router tests: single-chip semantics, 8-shard mesh routing,
+eviction propagation, and Pallas-kernel equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pushcdn_tpu.ops.delivery_kernel import (
+    delivery_matrix_pallas,
+    delivery_matrix_reference,
+)
+from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState, local_claim
+from pushcdn_tpu.parallel.frames import FrameRing
+from pushcdn_tpu.parallel.mesh import make_broker_mesh
+from pushcdn_tpu.parallel.router import (
+    BROKER_AXIS,
+    IngressBatch,
+    RouterState,
+    empty_router_state,
+    make_mesh_routing_step,
+    routing_step_single,
+)
+from pushcdn_tpu.proto.message import KIND_BROADCAST, KIND_DIRECT
+
+U, S, F = 16, 8, 64
+
+
+def _batch_from_ring(ring: FrameRing) -> IngressBatch:
+    b = ring.take_batch()
+    return IngressBatch(
+        jnp.asarray(b.bytes_), jnp.asarray(b.kind), jnp.asarray(b.length),
+        jnp.asarray(b.topic_mask.astype(np.uint32)), jnp.asarray(b.dest),
+        jnp.asarray(b.valid))
+
+
+def _claim(state: RouterState, slot: int, broker: int,
+           topic_mask: int) -> RouterState:
+    mask = jnp.zeros(U, bool).at[slot].set(True)
+    return RouterState(
+        local_claim(state.crdt, mask, jnp.int32(broker)),
+        state.topic_masks.at[slot].set(topic_mask))
+
+
+def test_single_chip_broadcast_and_direct():
+    state = empty_router_state(U)
+    state = _claim(state, 0, 0, 0b01)   # user 0: topic 0
+    state = _claim(state, 1, 0, 0b10)   # user 1: topic 1
+    ring = FrameRing(slots=S, frame_bytes=F)
+    assert ring.push_broadcast(b"topic0 msg", topic_mask=0b01)
+    assert ring.push_direct(b"direct to 1", dest_slot=1)
+    res = routing_step_single(state, _batch_from_ring(ring))
+    d = np.asarray(res.deliver)
+    assert d[0, 0] and not d[0, 1]      # user0 gets the broadcast only
+    assert d[1, 1] and not d[1, 0]      # user1 gets the direct only
+    assert not np.asarray(res.evictions).any()
+    # frame bytes surfaced for the egress pump
+    assert bytes(np.asarray(res.gathered_bytes)[0][:10]) == b"topic0 msg"
+
+
+def test_single_chip_unowned_user_gets_nothing():
+    state = empty_router_state(U)
+    state = _claim(state, 0, 3, 0b01)   # owned by broker 3, we are broker 0
+    ring = FrameRing(slots=S, frame_bytes=F)
+    ring.push_broadcast(b"x", topic_mask=0b01)
+    ring.push_direct(b"y", dest_slot=0)
+    res = routing_step_single(state, _batch_from_ring(ring))
+    assert not np.asarray(res.deliver).any()  # delivery-iff-owner
+
+
+def test_invalid_slots_never_deliver():
+    state = _claim(empty_router_state(U), 0, 0, 0xFFFFFFFF)
+    ring = FrameRing(slots=S, frame_bytes=F)
+    ring.push_broadcast(b"real", topic_mask=0b1)
+    batch = _batch_from_ring(ring)
+    # poison the metadata of an EMPTY slot: must still not deliver
+    batch = batch._replace(
+        topic_mask=batch.topic_mask.at[5].set(0xFFFFFFFF),
+        kind=batch.kind.at[5].set(KIND_BROADCAST))
+    res = routing_step_single(state, batch)
+    assert np.asarray(res.deliver)[0].sum() == 1  # only the real frame
+
+
+def test_mesh_routing_8_shards():
+    """Each of 8 broker shards owns one user on topic 0; a broadcast from
+    every shard reaches every user exactly once; a direct lands only at its
+    owner (the multichip fan-out path over the virtual CPU mesh)."""
+    mesh = make_broker_mesh()
+    B = mesh.devices.size
+    assert B == 8, "conftest must provide 8 virtual CPU devices"
+    step = make_mesh_routing_step(mesh)
+
+    owners = np.full((B, U), ABSENT, np.int32)
+    versions = np.zeros((B, U), np.uint32)
+    ids = np.full((B, U), ABSENT, np.int32)
+    masks = np.zeros((B, U), np.uint32)
+    for i in range(B):
+        owners[i, i] = i; versions[i, i] = 1; ids[i, i] = i; masks[i, i] = 0b1
+    state = RouterState(
+        CrdtState(jnp.asarray(owners), jnp.asarray(versions), jnp.asarray(ids)),
+        jnp.asarray(masks))
+
+    parts = []
+    for i in range(B):
+        ring = FrameRing(slots=S, frame_bytes=F)
+        ring.push_broadcast(f"from-{i}".encode(), topic_mask=0b1)
+        if i == 2:
+            ring.push_direct(b"direct to user 5", dest_slot=5)
+        parts.append(ring.take_batch())
+    batch = IngressBatch(
+        jnp.asarray(np.stack([x.bytes_ for x in parts])),
+        jnp.asarray(np.stack([x.kind for x in parts])),
+        jnp.asarray(np.stack([x.length for x in parts])),
+        jnp.asarray(np.stack([x.topic_mask for x in parts]).astype(np.uint32)),
+        jnp.asarray(np.stack([x.dest for x in parts])),
+        jnp.asarray(np.stack([x.valid for x in parts])))
+
+    out = step(state, batch)
+    d = np.asarray(out.deliver)  # [B, U, B*S]
+    for b in range(B):
+        expected = B + (1 if b == 5 else 0)  # all broadcasts (+1 direct)
+        assert d[b, b].sum() == expected, (b, int(d[b, b].sum()))
+        # no shard delivers to users it doesn't own
+        others = [u for u in range(U) if u != b]
+        assert d[b][others].sum() == 0
+
+
+def test_mesh_eviction_on_ownership_change():
+    """Shard 0 and shard 1 both claim user 0; shard 1's claim dominates
+    (higher version) → shard 0 reports the eviction, parity with
+    apply_user_sync's kick (connections/mod.rs:154-162)."""
+    mesh = make_broker_mesh()
+    B = mesh.devices.size
+    step = make_mesh_routing_step(mesh)
+
+    owners = np.full((B, U), ABSENT, np.int32)
+    versions = np.zeros((B, U), np.uint32)
+    ids = np.full((B, U), ABSENT, np.int32)
+    masks = np.zeros((B, U), np.uint32)
+    owners[0, 0], versions[0, 0], ids[0, 0] = 0, 1, 0   # shard0 claim v1
+    owners[1, 0], versions[1, 0], ids[1, 0] = 1, 2, 1   # shard1 claim v2
+    state = RouterState(
+        CrdtState(jnp.asarray(owners), jnp.asarray(versions), jnp.asarray(ids)),
+        jnp.asarray(masks))
+    empty = FrameRing(slots=S, frame_bytes=F).take_batch()
+    batch = IngressBatch(*[jnp.asarray(np.stack([getattr(empty, f)] * B))
+                           for f in ("bytes_", "kind", "length")],
+                         jnp.asarray(np.stack([empty.topic_mask] * B).astype(np.uint32)),
+                         jnp.asarray(np.stack([empty.dest] * B)),
+                         jnp.asarray(np.stack([empty.valid] * B)))
+    out = step(state, batch)
+    ev = np.asarray(out.evictions)   # [B, U]
+    assert ev[0, 0]                  # shard 0 must kick its local session
+    assert not ev[1:, :].any()
+    merged_owners = np.asarray(out.state.crdt.owners)
+    assert (merged_owners[:, 0] == 1).all()  # everyone converged on shard 1
+
+
+def test_mask_rides_ownership_handoff():
+    """When a dominating ownership claim is adopted, the claimant's topic
+    mask is adopted with it — stale masks after a handoff would misroute
+    broadcasts (merge_all_gathered_with_payload's whole purpose)."""
+    mesh = make_broker_mesh()
+    B = mesh.devices.size
+    step = make_mesh_routing_step(mesh)
+
+    owners = np.full((B, U), ABSENT, np.int32)
+    versions = np.zeros((B, U), np.uint32)
+    ids = np.full((B, U), ABSENT, np.int32)
+    masks = np.zeros((B, U), np.uint32)
+    # every shard has a STALE view: user 0 owned by shard 0 with mask 0b01
+    owners[:, 0] = 0; versions[:, 0] = 1; ids[:, 0] = 0; masks[:, 0] = 0b01
+    # shard 1 takes user 0 over with a NEW mask 0b10 (version 2 dominates)
+    owners[1, 0], versions[1, 0], ids[1, 0], masks[1, 0] = 1, 2, 1, 0b10
+    state = RouterState(
+        CrdtState(jnp.asarray(owners), jnp.asarray(versions), jnp.asarray(ids)),
+        jnp.asarray(masks))
+
+    # a broadcast on topic 1 (mask 0b10) from shard 3
+    parts = []
+    for i in range(B):
+        ring = FrameRing(slots=S, frame_bytes=F)
+        if i == 3:
+            ring.push_broadcast(b"new-topic msg", topic_mask=0b10)
+        parts.append(ring.take_batch())
+    batch = IngressBatch(
+        jnp.asarray(np.stack([x.bytes_ for x in parts])),
+        jnp.asarray(np.stack([x.kind for x in parts])),
+        jnp.asarray(np.stack([x.length for x in parts])),
+        jnp.asarray(np.stack([x.topic_mask for x in parts]).astype(np.uint32)),
+        jnp.asarray(np.stack([x.dest for x in parts])),
+        jnp.asarray(np.stack([x.valid for x in parts])))
+    out = step(state, batch)
+    # every shard converged on the new mask...
+    np.testing.assert_array_equal(np.asarray(out.state.topic_masks)[:, 0],
+                                  np.full(B, 0b10, np.uint32))
+    # ...and the new owner (shard 1) delivered the topic-1 broadcast using
+    # the adopted mask, in the SAME step as the handoff
+    d = np.asarray(out.deliver)
+    assert d[1, 0].sum() == 1
+    assert d[0, 0].sum() == 0  # the old owner no longer delivers
+
+
+def test_pallas_kernel_matches_reference():
+    rng = np.random.default_rng(0)
+    Uk, Nk = 64, 256
+    user_masks = jnp.asarray(rng.integers(0, 2**16, Uk).astype(np.uint32))
+    local = jnp.asarray(rng.random(Uk) < 0.5)
+    tmask = jnp.asarray(rng.integers(0, 2**16, Nk).astype(np.uint32))
+    kind = jnp.asarray(rng.choice([0, KIND_BROADCAST, KIND_DIRECT], Nk).astype(np.int32))
+    dest = jnp.asarray(rng.integers(-1, Uk, Nk).astype(np.int32))
+    ref = delivery_matrix_reference(user_masks, local, tmask, kind, dest)
+    pal = delivery_matrix_pallas(user_masks, local, tmask, kind, dest,
+                                 interpret=True)
+    np.testing.assert_array_equal(np.asarray(pal), np.asarray(ref))
