@@ -1,0 +1,96 @@
+// Native frame plumbing: the socket⇄HBM pump's hot loops.
+//
+// The reference's native-performance-critical layer is its Rust transport +
+// framing stack (cdn-proto/src/connection/protocols/mod.rs:309-394 —
+// length-delimited u32 frames — and the per-message buffer handling). Here
+// the equivalent C++ sits at exactly that seam (SURVEY.md §7 design stance,
+// seam (a)): batch packing of variable-length payloads into the fixed-shape
+// frame tensors the device router consumes, and batch scanning/encoding of
+// length-delimited byte streams for the TCP edge.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+//
+// Build: g++ -O3 -march=native -shared -fPIC framing.cpp -o libpushcdn_framing.so
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Pack n variable-length payloads (concatenated in `blob`, located by
+// offsets/lengths) into a [capacity, frame_bytes] frame tensor + aligned
+// metadata columns. Returns the number of frames packed (stops at capacity
+// or at a payload that exceeds frame_bytes — the host path handles those).
+int32_t pushcdn_pack_frames(
+    const uint8_t* blob, const int64_t* offsets, const int32_t* lengths,
+    const int32_t* kinds, const uint32_t* tmasks, const int32_t* dests,
+    int32_t n, int32_t capacity, int32_t frame_bytes,
+    uint8_t* out_frames, int32_t* out_kind, int32_t* out_len,
+    uint32_t* out_tmask, int32_t* out_dest, uint8_t* out_valid) {
+  int32_t packed = 0;
+  for (int32_t i = 0; i < n && packed < capacity; ++i) {
+    const int32_t len = lengths[i];
+    if (len < 0 || len > frame_bytes) return packed;  // caller handles
+    uint8_t* slot = out_frames + (int64_t)packed * frame_bytes;
+    std::memcpy(slot, blob + offsets[i], (size_t)len);
+    if (len < frame_bytes) std::memset(slot + len, 0, (size_t)(frame_bytes - len));
+    out_kind[packed] = kinds[i];
+    out_len[packed] = len;
+    out_tmask[packed] = tmasks[i];
+    out_dest[packed] = dests[i];
+    out_valid[packed] = 1;
+    ++packed;
+  }
+  return packed;
+}
+
+// Scan a received byte stream for complete length-delimited frames
+// (u32 big-endian length prefix; parity protocols/mod.rs:309-351).
+// Writes (offset, length) of each complete frame; returns the number of
+// bytes consumed (start of the first incomplete frame). Frames longer than
+// max_frame_len abort the scan with *error = 1 (peer violation).
+int64_t pushcdn_scan_frames(
+    const uint8_t* buf, int64_t len, uint32_t max_frame_len,
+    int64_t* out_offsets, int32_t* out_lengths, int32_t max_frames,
+    int32_t* num_frames, int32_t* error) {
+  int64_t pos = 0;
+  int32_t count = 0;
+  *error = 0;
+  while (count < max_frames && len - pos >= 4) {
+    const uint32_t flen = ((uint32_t)buf[pos] << 24) | ((uint32_t)buf[pos + 1] << 16) |
+                          ((uint32_t)buf[pos + 2] << 8) | (uint32_t)buf[pos + 3];
+    if (flen > max_frame_len) {
+      *error = 1;
+      break;
+    }
+    if (len - pos - 4 < (int64_t)flen) break;  // incomplete
+    out_offsets[count] = pos + 4;
+    out_lengths[count] = (int32_t)flen;
+    ++count;
+    pos += 4 + (int64_t)flen;
+  }
+  *num_frames = count;
+  return pos;
+}
+
+// Encode n payloads into one contiguous length-delimited byte stream
+// (u32 BE prefix per frame) — the writer-side batch: one buffer, one
+// syscall. Returns total bytes written, or -1 if out_capacity is too small.
+int64_t pushcdn_encode_frames(
+    const uint8_t* blob, const int64_t* offsets, const int32_t* lengths,
+    int32_t n, uint8_t* out, int64_t out_capacity) {
+  int64_t pos = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    const int32_t len = lengths[i];
+    if (pos + 4 + (int64_t)len > out_capacity) return -1;
+    out[pos] = (uint8_t)((uint32_t)len >> 24);
+    out[pos + 1] = (uint8_t)((uint32_t)len >> 16);
+    out[pos + 2] = (uint8_t)((uint32_t)len >> 8);
+    out[pos + 3] = (uint8_t)len;
+    std::memcpy(out + pos + 4, blob + offsets[i], (size_t)len);
+    pos += 4 + (int64_t)len;
+  }
+  return pos;
+}
+
+}  // extern "C"

@@ -1,0 +1,5 @@
+"""Deployment binaries (reference L10, SURVEY.md §1): ``broker``,
+``marshal``, ``client`` plus the chaos generators ``bad-broker``,
+``bad-connector``, ``bad-sender``. Run as ``python -m pushcdn_tpu.bin.broker``
+etc.; ``scripts/local_cluster.py`` wires a full local deployment
+(process-compose parity)."""

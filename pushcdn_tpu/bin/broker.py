@@ -1,0 +1,70 @@
+"""Broker binary (parity cdn-broker/src/binaries/broker.rs:21-131).
+
+    python -m pushcdn_tpu.bin.broker \
+        --discovery-endpoint /tmp/cdn.sqlite \
+        --public-advertise-endpoint local_ip:1738 --public-bind-endpoint 0.0.0.0:1738 \
+        --private-advertise-endpoint local_ip:1739 --private-bind-endpoint 0.0.0.0:1739
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from pushcdn_tpu.bin.common import init_logging, keypair_from_seed, run_def_from_args
+from pushcdn_tpu.broker.broker import GIB, Broker, BrokerConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="pushcdn-broker", description=__doc__)
+    p.add_argument("--discovery-endpoint", required=True,
+                   help="sqlite path or redis:// URL")
+    p.add_argument("--public-advertise-endpoint", default="local_ip:1738")
+    p.add_argument("--public-bind-endpoint", default="0.0.0.0:1738")
+    p.add_argument("--private-advertise-endpoint", default="local_ip:1739")
+    p.add_argument("--private-bind-endpoint", default="0.0.0.0:1739")
+    p.add_argument("--metrics-bind-endpoint", default=None)
+    p.add_argument("--broker-transport", default="tcp")
+    p.add_argument("--user-transport", default="tcp+tls")
+    p.add_argument("--num-topics", type=int, default=256)
+    p.add_argument("--key-seed", type=int, default=0,
+                   help="deployment broker key seed (all brokers must match)")
+    p.add_argument("--ca-cert-path", default=None)
+    p.add_argument("--ca-key-path", default=None)
+    p.add_argument("--global-memory-pool-size", type=int, default=GIB,
+                   help="bytes (default 1 GiB, parity broker.rs:67-72)")
+    p.add_argument("--global-permits", action="store_true")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    return p
+
+
+async def amain(args: argparse.Namespace) -> None:
+    run_def = run_def_from_args(args.broker_transport, args.user_transport,
+                                args.discovery_endpoint, args.num_topics,
+                                args.global_permits)
+    broker = await Broker.new(BrokerConfig(
+        run_def=run_def,
+        keypair=keypair_from_seed(args.key_seed),
+        discovery_endpoint=args.discovery_endpoint,
+        public_advertise_endpoint=args.public_advertise_endpoint,
+        public_bind_endpoint=args.public_bind_endpoint,
+        private_advertise_endpoint=args.private_advertise_endpoint,
+        private_bind_endpoint=args.private_bind_endpoint,
+        metrics_bind_endpoint=args.metrics_bind_endpoint,
+        ca_cert_path=args.ca_cert_path, ca_key_path=args.ca_key_path,
+        global_memory_pool_size=args.global_memory_pool_size,
+    ))
+    await broker.run_until_failure()
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    init_logging(args.verbose)
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Example client binary (parity cdn-client/src/binaries/client.rs:36-123):
+every 5 s, send a direct message to ourselves and a broadcast, and log
+everything received."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from pushcdn_tpu.bin.common import init_logging, keypair_from_seed, transport_by_name
+from pushcdn_tpu.client import Client, ClientConfig
+from pushcdn_tpu.proto.message import Broadcast, Direct
+
+logger = logging.getLogger("pushcdn.client-bin")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="pushcdn-client", description=__doc__)
+    p.add_argument("--marshal-endpoint", required=True)
+    p.add_argument("--transport", default="tcp+tls")
+    p.add_argument("--key-seed", type=int, default=None)
+    p.add_argument("--topic", type=int, action="append", default=None)
+    p.add_argument("--interval", type=float, default=5.0)
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    return p
+
+
+async def amain(args: argparse.Namespace) -> None:
+    topics = args.topic if args.topic is not None else [0]
+    client = Client(ClientConfig(
+        marshal_endpoint=args.marshal_endpoint,
+        keypair=keypair_from_seed(args.key_seed),
+        protocol=transport_by_name(args.transport),
+        subscribed_topics=set(topics),
+    ))
+    await client.ensure_initialized()
+    logger.info("connected; sending every %.1fs on topics %s",
+                args.interval, topics)
+
+    async def receiver():
+        while True:
+            message = await client.receive_message()
+            if isinstance(message, Direct):
+                logger.info("recv direct: %r", bytes(message.message)[:64])
+            elif isinstance(message, Broadcast):
+                logger.info("recv broadcast %s: %r", message.topics,
+                            bytes(message.message)[:64])
+
+    recv_task = asyncio.create_task(receiver())
+    n = 0
+    try:
+        while True:
+            await client.send_direct_message(client.public_key,
+                                             f"echo {n}".encode())
+            await client.send_broadcast_message(topics, f"hello {n}".encode())
+            n += 1
+            await asyncio.sleep(args.interval)
+    finally:
+        recv_task.cancel()
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    init_logging(args.verbose)
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

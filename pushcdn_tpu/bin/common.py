@@ -1,0 +1,70 @@
+"""Shared CLI plumbing: logging init (JSON opt-in via env, parity with the
+reference's RUST_LOG_FORMAT=json switch, cdn-broker/src/binaries/broker.rs:80-91),
+transport/scheme lookup by name, seeded keys."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+from typing import Optional, Type
+
+from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME, KeyPair
+from pushcdn_tpu.proto.def_ import RunDef, ConnectionDef
+from pushcdn_tpu.proto.discovery.embedded import Embedded
+from pushcdn_tpu.proto.discovery.redis import Redis
+from pushcdn_tpu.proto.topic import TopicSpace
+from pushcdn_tpu.proto.transport import Memory, Tcp, TcpTls
+from pushcdn_tpu.proto.transport.base import Protocol
+from pushcdn_tpu.proto.transport.quic import Quic
+
+TRANSPORTS = {"tcp": Tcp, "tcp+tls": TcpTls, "quic": Quic, "memory": Memory}
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        return json.dumps({
+            "ts": self.formatTime(record),
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        })
+
+
+def init_logging(verbosity: int = 0) -> None:
+    """Env-driven log format: ``PUSHCDN_LOG_FORMAT=json`` switches to
+    structured JSON lines (reference: RUST_LOG_FORMAT=json)."""
+    level = [logging.INFO, logging.DEBUG][min(verbosity, 1)]
+    handler = logging.StreamHandler(sys.stderr)
+    if os.environ.get("PUSHCDN_LOG_FORMAT") == "json":
+        handler.setFormatter(_JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)-5s %(name)s: %(message)s"))
+    logging.basicConfig(level=level, handlers=[handler], force=True)
+
+
+def transport_by_name(name: str) -> Type[Protocol]:
+    try:
+        return TRANSPORTS[name]
+    except KeyError:
+        raise SystemExit(
+            f"unknown transport {name!r}; pick from {sorted(TRANSPORTS)}")
+
+
+def run_def_from_args(broker_transport: str, user_transport: str,
+                      discovery_endpoint: str, num_topics: int,
+                      global_permits: bool = False) -> RunDef:
+    discovery = Redis if discovery_endpoint.startswith("redis://") else Embedded
+    return RunDef(
+        broker_def=ConnectionDef(protocol=transport_by_name(broker_transport)),
+        user_def=ConnectionDef(protocol=transport_by_name(user_transport)),
+        discovery=discovery,
+        topics=TopicSpace.range(num_topics),
+        global_permits=global_permits,
+    )
+
+
+def keypair_from_seed(seed: Optional[int]) -> KeyPair:
+    return DEFAULT_SCHEME.generate_keypair(seed=seed)

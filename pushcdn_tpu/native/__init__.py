@@ -1,0 +1,173 @@
+"""ctypes bindings for the C++ framing hot loops (native/framing.cpp).
+
+The library is compiled on first use (g++ is in the image; pybind11 is
+not, so the ABI is plain C via ctypes) and cached under ``.build/``.
+Everything degrades gracefully: ``available()`` is False if compilation
+fails and callers fall back to the numpy/Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "native", "framing.cpp")
+_BUILD_DIR = os.path.join(_REPO, ".build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libpushcdn_framing.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> Optional[ctypes.CDLL]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if not os.path.exists(_LIB_PATH) or \
+            os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH):
+        cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB_PATH]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, OSError):
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+
+    lib.pushcdn_pack_frames.restype = ctypes.c_int32
+    lib.pushcdn_pack_frames.argtypes = [
+        u8p, i64p, i32p, i32p, u32p, i32p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        u8p, i32p, i32p, u32p, i32p, u8p]
+    lib.pushcdn_scan_frames.restype = ctypes.c_int64
+    lib.pushcdn_scan_frames.argtypes = [
+        u8p, ctypes.c_int64, ctypes.c_uint32,
+        i64p, i32p, ctypes.c_int32, i32p, i32p]
+    lib.pushcdn_encode_frames.restype = ctypes.c_int64
+    lib.pushcdn_encode_frames.argtypes = [
+        u8p, i64p, i32p, ctypes.c_int32, u8p, ctypes.c_int64]
+    return lib
+
+
+def _get() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is None and not _tried:
+        with _lock:
+            if _lib is None and not _tried:
+                _lib = _compile()
+                _tried = True
+    return _lib
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def pack_frames_into(payloads: list[bytes], kinds: np.ndarray,
+                     tmasks: np.ndarray, dests: np.ndarray,
+                     out_frames: np.ndarray, out_kind: np.ndarray,
+                     out_len: np.ndarray, out_tmask: np.ndarray,
+                     out_dest: np.ndarray, out_valid: np.ndarray
+                     ) -> Optional[int]:
+    """Batch-pack payloads directly into caller-owned frame arrays via the
+    C++ kernel (zero extra allocation on the pump path). Returns the number
+    packed, or None if the native library is unavailable.
+
+    Preconditions (validated): metadata arrays as long as ``payloads``; no
+    payload longer than a frame slot; out arrays contiguous with matching
+    dtypes. ``out_valid`` must be uint8 (written 0/1).
+    """
+    lib = _get()
+    if lib is None:
+        return None
+    n_in = len(payloads)
+    if not (len(kinds) == len(tmasks) == len(dests) == n_in):
+        raise ValueError("payloads/kinds/tmasks/dests length mismatch")
+    capacity, frame_bytes = out_frames.shape
+    offsets = np.zeros(n_in, np.int64)
+    lengths = np.zeros(n_in, np.int32)
+    off = 0
+    for i, p in enumerate(payloads):
+        if len(p) > frame_bytes:
+            raise ValueError(
+                f"payload {i} is {len(p)} B > frame slot {frame_bytes} B; "
+                "pre-filter oversized payloads to the host path")
+        offsets[i] = off
+        lengths[i] = len(p)
+        off += len(p)
+    blob = b"".join(payloads)
+    blob_arr = np.frombuffer(blob, np.uint8) if blob else np.zeros(1, np.uint8)
+
+    n = lib.pushcdn_pack_frames(
+        _ptr(blob_arr, ctypes.c_uint8), _ptr(offsets, ctypes.c_int64),
+        _ptr(lengths, ctypes.c_int32),
+        _ptr(np.ascontiguousarray(kinds, np.int32), ctypes.c_int32),
+        _ptr(np.ascontiguousarray(tmasks, np.uint32), ctypes.c_uint32),
+        _ptr(np.ascontiguousarray(dests, np.int32), ctypes.c_int32),
+        n_in, capacity, frame_bytes,
+        _ptr(out_frames, ctypes.c_uint8), _ptr(out_kind, ctypes.c_int32),
+        _ptr(out_len, ctypes.c_int32), _ptr(out_tmask, ctypes.c_uint32),
+        _ptr(out_dest, ctypes.c_int32), _ptr(out_valid, ctypes.c_uint8))
+    return int(n)
+
+
+def scan_frames(buf: bytes, max_frame_len: int, max_frames: int = 4096
+                ) -> Optional[Tuple[list[Tuple[int, int]], int, bool]]:
+    """Find complete length-delimited frames in ``buf`` via the C++
+    scanner. Returns ([(offset, length)...], consumed_bytes, error) or
+    None if unavailable."""
+    lib = _get()
+    if lib is None:
+        return None
+    arr = np.frombuffer(buf, np.uint8) if buf else np.zeros(1, np.uint8)
+    out_off = np.zeros(max_frames, np.int64)
+    out_len = np.zeros(max_frames, np.int32)
+    nframes = ctypes.c_int32(0)
+    error = ctypes.c_int32(0)
+    consumed = lib.pushcdn_scan_frames(
+        _ptr(arr, ctypes.c_uint8), len(buf), max_frame_len,
+        _ptr(out_off, ctypes.c_int64), _ptr(out_len, ctypes.c_int32),
+        max_frames, ctypes.byref(nframes), ctypes.byref(error))
+    frames = [(int(out_off[i]), int(out_len[i])) for i in range(nframes.value)]
+    return frames, int(consumed), bool(error.value)
+
+
+def encode_frames(payloads: list[bytes]) -> Optional[bytes]:
+    """Batch-encode payloads as one length-delimited stream (writer-side
+    batching: one buffer → one syscall). None if unavailable."""
+    lib = _get()
+    if lib is None:
+        return None
+    blob = b"".join(payloads)
+    offsets = np.zeros(len(payloads), np.int64)
+    lengths = np.zeros(len(payloads), np.int32)
+    off = 0
+    for i, p in enumerate(payloads):
+        offsets[i] = off
+        lengths[i] = len(p)
+        off += len(p)
+    blob_arr = np.frombuffer(blob, np.uint8) if blob else np.zeros(1, np.uint8)
+    cap = len(blob) + 4 * len(payloads)
+    out = np.zeros(cap, np.uint8)
+    n = lib.pushcdn_encode_frames(
+        _ptr(blob_arr, ctypes.c_uint8), _ptr(offsets, ctypes.c_int64),
+        _ptr(lengths, ctypes.c_int32), len(payloads),
+        _ptr(out, ctypes.c_uint8), cap)
+    if n < 0:
+        return None
+    return out[:n].tobytes()

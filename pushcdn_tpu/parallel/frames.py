@@ -163,6 +163,51 @@ class FrameRing:
         self._put(i, payload, KIND_DIRECT, 0, dest_slot)
         return True
 
+    def push_batch(self, payloads: Sequence[bytes], kinds: Sequence[int],
+                   tmasks: Sequence[int], dests: Sequence[int]) -> int:
+        """Pack many messages in one call via the C++ framing kernel
+        (native/framing.cpp, writing straight into the ring's buffers;
+        falls back to the Python loop). Requires an empty ring (the batch
+        pump drains per step anyway).
+
+        Returns the number packed; fewer than ``len(payloads)`` means
+        exactly "ring full — re-queue the rest". Oversized payloads raise
+        ``ValueError`` up front (pre-filter them to the host path), so the
+        return value is never ambiguous between full and unroutable.
+        """
+        if self._used != 0:
+            raise ValueError("push_batch requires an empty ring")
+        if not (len(kinds) == len(tmasks) == len(dests) == len(payloads)):
+            raise ValueError("payloads/kinds/tmasks/dests length mismatch")
+        for i, p in enumerate(payloads):
+            if len(p) > self.frame_bytes:
+                raise ValueError(
+                    f"payload {i} is {len(p)} B > frame slot "
+                    f"{self.frame_bytes} B; pre-filter to the host path")
+        from pushcdn_tpu import native
+        kinds_a = np.asarray(kinds, np.int32)
+        tmasks_a = np.asarray(tmasks, np.uint32)
+        dests_a = np.asarray(dests, np.int32)
+        valid_u8 = np.zeros(self.slots, np.uint8)
+        n = native.pack_frames_into(
+            list(payloads), kinds_a, tmasks_a, dests_a,
+            self._bytes, self._kind, self._length, self._topic_mask,
+            self._dest, valid_u8)
+        if n is not None:
+            self._valid = valid_u8.astype(bool)
+            self._used = n
+            self._next = n % self.slots
+            return n
+        # Python fallback (identical semantics)
+        n = 0
+        for payload, k, tm, d in zip(payloads, kinds_a, tmasks_a, dests_a):
+            i = self._alloc()
+            if i is None:
+                break
+            self._put(i, payload, int(k), int(tm), int(d))
+            n += 1
+        return n
+
     def take_batch(self) -> FrameBatch:
         """Snapshot the ring as one step's batch and clear it (slot credits
         return to the host pump)."""

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Local cluster runner (parity with the reference's process-compose.yaml:
+discovery store + marshal + 2 brokers + an echo client, each a real OS
+process over TCP; SQLite stands in for KeyDB).
+
+    python scripts/local_cluster.py [--duration 30]
+
+Exits nonzero if any component dies early or the client fails to echo.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn(name: str, *args: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH", REPO)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", f"pushcdn_tpu.bin.{name}", *args],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    print(f"[cluster] {name} up (pid {proc.pid})")
+    return proc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--duration", type=float, default=20.0)
+    ap.add_argument("--base-port", type=int, default=21700)
+    args = ap.parse_args()
+
+    db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-cluster-"), "cdn.sqlite")
+    bp = args.base_port
+    procs: list[tuple[str, subprocess.Popen]] = []
+    try:
+        for i in range(2):
+            procs.append((f"broker{i}", spawn(
+                "broker",
+                "--discovery-endpoint", db,
+                "--public-advertise-endpoint", f"127.0.0.1:{bp + i * 2}",
+                "--public-bind-endpoint", f"127.0.0.1:{bp + i * 2}",
+                "--private-advertise-endpoint", f"127.0.0.1:{bp + i * 2 + 1}",
+                "--private-bind-endpoint", f"127.0.0.1:{bp + i * 2 + 1}",
+                "--user-transport", "tcp",   # plain tcp for the local demo
+                "--metrics-bind-endpoint", f"127.0.0.1:{bp + 100 + i}",
+            )))
+        time.sleep(1.5)  # brokers register + mesh up
+        procs.append(("marshal", spawn(
+            "marshal",
+            "--discovery-endpoint", db,
+            "--bind-endpoint", f"127.0.0.1:{bp + 50}",
+            "--user-transport", "tcp",
+        )))
+        time.sleep(1.0)
+        procs.append(("client", spawn(
+            "client",
+            "--marshal-endpoint", f"127.0.0.1:{bp + 50}",
+            "--transport", "tcp",
+            "--interval", "1.0", "--key-seed", "7",
+        )))
+
+        deadline = time.time() + args.duration
+        echoed = False
+        client = procs[-1][1]
+        while time.time() < deadline:
+            for name, proc in procs[:-1]:
+                if proc.poll() is not None:
+                    print(f"[cluster] FAIL: {name} died early")
+                    print(proc.stdout.read()[-2000:])
+                    return 1
+            line = client.stdout.readline()
+            if line:
+                sys.stdout.write(f"[client] {line}")
+                if "recv direct" in line:
+                    echoed = True
+                    break
+        if not echoed:
+            print("[cluster] FAIL: client never echoed")
+            return 1
+        print("[cluster] OK: end-to-end echo through real processes")
+        return 0
+    finally:
+        for _name, proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGINT)
+        time.sleep(0.5)
+        for _name, proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
