@@ -13,7 +13,7 @@ import asyncio
 import logging
 import socket
 from dataclasses import dataclass
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
 from pushcdn_tpu.broker import metrics as broker_metrics
 from pushcdn_tpu.broker.connections import Connections
@@ -28,6 +28,9 @@ from pushcdn_tpu.proto.def_ import RunDef
 from pushcdn_tpu.proto.discovery.base import BrokerIdentifier
 from pushcdn_tpu.proto.error import Error, ErrorKind, bail
 from pushcdn_tpu.proto.limiter import Limiter
+
+if TYPE_CHECKING:  # import only for annotations (runtime import would cycle)
+    from pushcdn_tpu.broker.device_plane import DevicePlaneConfig
 
 logger = logging.getLogger("pushcdn.broker")
 
@@ -64,6 +67,9 @@ class BrokerConfig:
     metrics_bind_endpoint: Optional[str] = None
     ca_cert_path: Optional[str] = None
     ca_key_path: Optional[str] = None
+    # attach the TPU device plane: eligible messages route on-device in
+    # batched jitted steps (broker/device_plane.py); None = host-only
+    device_plane: Optional["DevicePlaneConfig"] = None
     # 1 GiB default pool (binaries/broker.rs:67-72)
     global_memory_pool_size: int = GIB
     # operational cadences (heartbeat.rs:39,107; sync.rs:142; whitelist.rs)
@@ -90,6 +96,7 @@ class Broker:
         self._tasks: list[asyncio.Task] = []
         self._stopped = asyncio.Event()
         self._metrics_server = None
+        self.device_plane = None
         self.seen_dialing: set[str] = set()  # peers we're currently dialing
 
     @classmethod
@@ -120,6 +127,11 @@ class Broker:
             _substitute_local_ip(c.private_bind_endpoint),
             certificate=self.certificate)
 
+        if c.device_plane is not None:
+            from pushcdn_tpu.broker.device_plane import DevicePlane
+            self.device_plane = DevicePlane(self, c.device_plane)
+            self.connections.observer = self.device_plane
+
         if c.metrics_bind_endpoint:
             self._metrics_server = await metrics_mod.serve_metrics(
                 c.metrics_bind_endpoint)
@@ -131,6 +143,8 @@ class Broker:
 
     async def start(self) -> None:
         """Spawn the five supervised tasks (lib.rs:269-318)."""
+        if self.device_plane is not None:
+            await self.device_plane.start()
         spawn = asyncio.create_task
         self._tasks = [
             spawn(heartbeat_task.run_heartbeat_task(self), name="heartbeat"),
@@ -156,6 +170,8 @@ class Broker:
 
     async def stop(self) -> None:
         self._stopped.set()
+        if self.device_plane is not None:
+            await self.device_plane.stop()
         for t in self._tasks:
             t.cancel()
         if self._tasks:

@@ -68,6 +68,10 @@ class Connections:
     def __init__(self, identity: str):
         # identity = our BrokerIdentifier in canonical string form
         self.identity = identity
+        # optional observer (the broker's DevicePlane mirrors user slots /
+        # topic masks on device); duck-typed: on_user_added(key, topics),
+        # on_user_removed(key), on_subscription_changed(key, topics)
+        self.observer = None
         self.users: Dict[UserPublicKey, UserHandle] = {}
         self.brokers: Dict[str, BrokerHandle] = {}
         # user → owning-broker CRDT (DirectMap, connections/direct/mod.rs:14)
@@ -97,6 +101,8 @@ class Connections:
         if topics:
             self.user_topics.associate_key_with_values(public_key, topics)
         self.direct_map.insert(public_key, self.identity)
+        if self.observer is not None:
+            self.observer.on_user_added(public_key, topics)
         logger.info("user %s connected (topics=%s)", mnemonic(public_key), topics)
 
     def remove_user(self, public_key: UserPublicKey,
@@ -109,6 +115,8 @@ class Connections:
         # Release our DirectMap claim only if we still hold it — a newer
         # claim by another broker must not be clobbered.
         self.direct_map.remove_if_equals(public_key, self.identity)
+        if self.observer is not None:
+            self.observer.on_user_removed(public_key)
         logger.info("user %s removed: %s", mnemonic(public_key), reason)
 
     def has_user(self, public_key: UserPublicKey) -> bool:
@@ -169,11 +177,17 @@ class Connections:
                           topics: List[Topic]) -> None:
         if public_key in self.users and topics:
             self.user_topics.associate_key_with_values(public_key, topics)
+            if self.observer is not None:
+                self.observer.on_subscription_changed(
+                    public_key, self.user_topics.get_values_of_key(public_key))
 
     def unsubscribe_user_from(self, public_key: UserPublicKey,
                               topics: List[Topic]) -> None:
         if topics:
             self.user_topics.dissociate_key_from_values(public_key, topics)
+            if self.observer is not None:
+                self.observer.on_subscription_changed(
+                    public_key, self.user_topics.get_values_of_key(public_key))
 
     def subscribe_broker_to(self, identifier: str, topics: List[Topic]) -> None:
         if identifier in self.brokers and topics:

@@ -1,0 +1,282 @@
+"""DevicePlane — the bridge that puts the TPU router in the broker's hot
+path.
+
+The host broker (tasks/handlers.py) routes per-message with dict lookups;
+with a ``DevicePlane`` attached, eligible messages (wire frames that fit a
+frame slot) are instead **staged into the frame ring, routed in batched
+jitted steps on the attached device, and delivered from the resulting
+delivery matrix** (SURVEY.md §7 stage 7 → stage 8 "edge": the socket⇄HBM
+pump). The wire frame travels verbatim through HBM, so receivers are
+byte-identical with the host path. Oversized messages and control traffic
+keep the host path.
+
+Scope (round 1): one broker = one device shard (``routing_step_single``).
+The host CRDT stays authoritative for cross-broker ownership; the device
+plane handles the local fan-out — which is where the per-message Python
+cost lives. Multi-shard meshes route via parallel.router's shard_map step.
+
+Consistency design (single-writer, snapshot-per-step):
+
+- The **host mirrors** (``_owned`` bool[U], ``_masks`` u32[U]) are the
+  source of truth, mutated only on the event loop by the Connections
+  observer hooks. Each step SNAPSHOTS them together with ``take_batch()``
+  (same event-loop tick), and the device ``RouterState`` is rebuilt from
+  that snapshot — a registration or subscription racing the in-flight step
+  simply lands in the next snapshot, never lost.
+- **Slot quarantine**: a released user slot is not reusable until the step
+  that might still carry frames addressed to it has completed — prevents a
+  recycled slot from leaking one user's messages to another.
+- **Failure = host fallback**: if a step raises, its staged frames are
+  re-routed on the host path (users-only, matching what the device would
+  have delivered) and the plane disables itself; staging then always
+  returns False and the broker is a plain host broker again.
+
+Flow per step:
+  ingress: user_receive_loop → try_stage() → FrameRing (slot credits)
+  compute: snapshot + take_batch → routing_step_single (jitted)
+  egress:  deliver[u, f] → per-user non-blocking send of the frame bytes
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, List, Optional, Tuple
+
+import numpy as np
+
+from pushcdn_tpu.broker.tasks.senders import try_send_to_user_nowait
+from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
+from pushcdn_tpu.parallel.frames import FrameRing, UserSlots
+from pushcdn_tpu.parallel.router import (
+    IngressBatch,
+    RouterState,
+    routing_step_single,
+)
+from pushcdn_tpu.proto.error import Error
+from pushcdn_tpu.proto.limiter import Bytes
+from pushcdn_tpu.proto.message import Broadcast, Direct
+
+if TYPE_CHECKING:
+    from pushcdn_tpu.broker.broker import Broker
+
+logger = logging.getLogger("pushcdn.broker.device")
+
+
+@dataclass
+class DevicePlaneConfig:
+    num_user_slots: int = 1024
+    ring_slots: int = 1024
+    frame_bytes: int = 2048
+    # batch window: how long the pump waits to coalesce ingress into one
+    # step (the latency ↔ step-efficiency knob)
+    batch_window_s: float = 0.001
+
+
+class DevicePlane:
+    def __init__(self, broker: "Broker", config: DevicePlaneConfig = None):
+        self.broker = broker
+        self.config = config or DevicePlaneConfig()
+        c = self.config
+        self.slots = UserSlots(c.num_user_slots)
+        self.ring = FrameRing(slots=c.ring_slots, frame_bytes=c.frame_bytes)
+        # host mirrors — the single source of truth for device state
+        self._owned = np.zeros(c.num_user_slots, bool)
+        self._masks = np.zeros(c.num_user_slots, np.uint32)
+        self._quarantine: List[int] = []   # slots awaiting step completion
+        # users the slot table couldn't hold: broadcasts must stay on the
+        # host path while any exist (they'd miss device-only fan-out)
+        self._unmirrored: set[bytes] = set()
+        self.disabled = False
+        self._kick = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self.steps = 0
+        self.messages_routed = 0
+
+    # ---- user lifecycle (Connections observer; event-loop only) ----------
+
+    def on_user_added(self, public_key: bytes, topics) -> None:
+        try:
+            slot = self.slots.assign(public_key)
+        except Error:
+            # table full: this user is host-routed only; never fail the
+            # registration over the mirror
+            self._unmirrored.add(public_key)
+            logger.warning("device user-slot table full; %d unmirrored users",
+                           len(self._unmirrored))
+            return
+        self._owned[slot] = True
+        self._masks[slot] = self._mask_of(topics)
+
+    def on_user_removed(self, public_key: bytes) -> None:
+        self._unmirrored.discard(public_key)
+        slot = self.slots.unmap(public_key)
+        if slot is None:
+            return
+        self._owned[slot] = False
+        self._masks[slot] = 0
+        # the slot index stays quarantined until the next step completes —
+        # in-flight frames may still address it
+        self._quarantine.append(slot)
+
+    def on_subscription_changed(self, public_key: bytes, topics) -> None:
+        slot = self.slots.slot_of(public_key)
+        if slot is None:
+            return
+        self._masks[slot] = self._mask_of(topics)
+
+    @staticmethod
+    def _mask_of(topics) -> int:
+        mask = 0
+        for t in topics:
+            if int(t) < 32:  # the device mask covers topics 0..31
+                mask |= 1 << int(t)
+        return mask
+
+    # ---- ingress ----------------------------------------------------------
+
+    def try_stage(self, message, raw: Bytes) -> bool:
+        """Stage a decoded message's WIRE FRAME for device routing. Returns
+        False if it must take the host path (too big, unknown recipient,
+        unmirrored users present, ring full — slot-credit backpressure)."""
+        if self.disabled:
+            return False
+        frame = bytes(raw.data)
+        if len(frame) > self.config.frame_bytes:
+            return False
+        if isinstance(message, Broadcast):
+            if self._unmirrored:
+                return False  # device fan-out would miss unmirrored users
+            if any(int(t) >= 32 for t in message.topics):
+                return False  # beyond the u32 device topic mask
+            mask = self._mask_of(message.topics)
+            if mask == 0:
+                return False
+            ok = self.ring.push_broadcast(frame, mask)
+        elif isinstance(message, Direct):
+            slot = self.slots.slot_of(bytes(message.recipient))
+            if slot is None:
+                return False  # not a mirrored local user (cross-broker etc.)
+            ok = self.ring.push_direct(frame, slot)
+        else:
+            return False
+        if ok:
+            self._kick.set()
+        return ok
+
+    # ---- the pump ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._pump(), name="device-pump")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                logger.exception("device pump died during stop")
+
+    async def _pump(self) -> None:
+        while True:
+            await self._kick.wait()
+            self._kick.clear()
+            await asyncio.sleep(self.config.batch_window_s)  # coalesce
+            if self.ring.free_slots == self.ring.slots:
+                continue
+            # snapshot mirrors + batch in ONE event-loop tick: consistent
+            batch_np = self.ring.take_batch()
+            owned = self._owned.copy()
+            masks = self._masks.copy()
+            quarantined, self._quarantine = self._quarantine, []
+            try:
+                deliver, lengths, frames = await asyncio.to_thread(
+                    self._run_step, batch_np, owned, masks)
+                self._egress(deliver, lengths, frames)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception(
+                    "device routing step failed; re-routing the batch on "
+                    "the host path and disabling the device plane")
+                self.disabled = True
+                await self._host_fallback(batch_np)
+                return
+            finally:
+                for slot in quarantined:  # safe to recycle now
+                    self.slots.free_slot(slot)
+
+    def _run_step(self, b, owned: np.ndarray, masks: np.ndarray):
+        """Blocking device step (runs in a worker thread) against the
+        snapshotted mirrors."""
+        import jax.numpy as jnp
+        U = self.config.num_user_slots
+        state = RouterState(
+            crdt=CrdtState(
+                owners=jnp.asarray(np.where(owned, 0, ABSENT).astype(np.int32)),
+                versions=jnp.asarray(owned.astype(np.uint32)),
+                identities=jnp.asarray(
+                    np.where(owned, 0, ABSENT).astype(np.int32)),
+            ),
+            topic_masks=jnp.asarray(masks))
+        batch = IngressBatch(
+            jnp.asarray(b.bytes_), jnp.asarray(b.kind),
+            jnp.asarray(b.length), jnp.asarray(b.topic_mask),
+            jnp.asarray(b.dest), jnp.asarray(b.valid))
+        result = routing_step_single(state, batch)
+        deliver = np.asarray(result.deliver)       # [U, S]
+        lengths = np.asarray(result.gathered_length)
+        frames = np.asarray(result.gathered_bytes)
+        self.steps += 1
+        return deliver, lengths, frames
+
+    def _egress(self, deliver, lengths, frames) -> None:
+        """Walk the delivery matrix and queue the original wire frames to
+        local user connections — non-blocking per user, so one slow
+        consumer cannot stall the pump (its overflow is handled by the
+        failure-is-removal policy in the sender)."""
+        users, frame_idx = np.nonzero(deliver)
+        cache: dict[int, Bytes] = {}
+        for u, f in zip(users.tolist(), frame_idx.tolist()):
+            key = self.slots.key_of(u)
+            if key is None:
+                continue  # released while the step ran: drop (user is gone)
+            raw = cache.get(f)
+            if raw is None:
+                raw = Bytes(frames[f, :lengths[f]].tobytes())
+                cache[f] = raw
+            if try_send_to_user_nowait(self.broker, key, raw):
+                self.messages_routed += 1
+        for raw in cache.values():
+            raw.release()
+
+    async def _host_fallback(self, b) -> None:
+        """Deliver a batch the device failed to route, via the host path.
+        Users-only on purpose: any broker-bound fan-out for these messages
+        already ran on the host at staging time."""
+        from pushcdn_tpu.broker.tasks.handlers import (
+            handle_broadcast_message,
+            handle_direct_message,
+        )
+        from pushcdn_tpu.proto.message import deserialize
+        for i in range(self.ring.slots):
+            if not b.valid[i]:
+                continue
+            raw = Bytes(b.bytes_[i, :b.length[i]].tobytes())
+            try:
+                message = deserialize(raw.data)
+                if isinstance(message, Direct):
+                    await handle_direct_message(
+                        self.broker, bytes(message.recipient), raw,
+                        to_user_only=True)
+                elif isinstance(message, Broadcast):
+                    await handle_broadcast_message(
+                        self.broker, list(message.topics), raw,
+                        to_users_only=True)
+            except Error:
+                pass
+            finally:
+                raw.release()

@@ -73,14 +73,20 @@ async def handle_direct_message(broker: "Broker", recipient: bytes,
 
 
 async def handle_broadcast_message(broker: "Broker", topics: Sequence[int],
-                                   raw: Bytes, to_users_only: bool) -> None:
-    """Interest-driven fan-out (broker/handler.rs:240-272)."""
+                                   raw: Bytes, to_users_only: bool,
+                                   users_via_device: bool = False) -> None:
+    """Interest-driven fan-out (broker/handler.rs:240-272).
+
+    ``users_via_device=True`` means the local-user fan-out was staged onto
+    the device plane; only the inter-broker forwarding runs on the host.
+    """
     users, brokers = broker.connections.get_interested_by_topic(
         list(topics), to_users_only)
     for ident in brokers:
         await try_send_to_broker(broker, ident, raw)
-    for user in users:
-        await try_send_to_user(broker, user, raw)
+    if not users_via_device:
+        for user in users:
+            await try_send_to_user(broker, user, raw)
 
 
 # ---------------------------------------------------------------------------
@@ -110,14 +116,22 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                 if result == HookResult.DISCONNECT:
                     break
 
+                device = broker.device_plane
                 if isinstance(message, Direct):
+                    # device path covers local-recipient delivery; host path
+                    # covers cross-broker forwards and oversized frames
+                    if device is not None and device.try_stage(message, raw):
+                        continue
                     await handle_direct_message(
                         broker, message.recipient, raw, to_user_only=False)
                 elif isinstance(message, Broadcast):
                     pruned, _bad = topics.prune(message.topics)
                     if pruned:
+                        staged = (device is not None
+                                  and device.try_stage(message, raw))
                         await handle_broadcast_message(
-                            broker, pruned, raw, to_users_only=False)
+                            broker, pruned, raw, to_users_only=False,
+                            users_via_device=staged)
                 elif isinstance(message, Subscribe):
                     pruned, bad = topics.prune(message.topics)
                     if bad:
@@ -172,9 +186,13 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                 if result == HookResult.DISCONNECT:
                     break
 
+                device = broker.device_plane
                 if isinstance(message, Direct):
                     # deliver to our own user only — never re-forward
-                    # (broker/handler.rs:148-153)
+                    # (broker/handler.rs:148-153); the device path's
+                    # delivery-iff-owner rule enforces the same invariant
+                    if device is not None and device.try_stage(message, raw):
+                        continue
                     await handle_direct_message(
                         broker, message.recipient, raw, to_user_only=True)
                 elif isinstance(message, Broadcast):
@@ -182,6 +200,8 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                     # (broker/handler.rs:156-161)
                     pruned, _bad = topics.prune(message.topics)
                     if pruned:
+                        if device is not None and device.try_stage(message, raw):
+                            continue
                         await handle_broadcast_message(
                             broker, pruned, raw, to_users_only=True)
                 elif isinstance(message, UserSync):

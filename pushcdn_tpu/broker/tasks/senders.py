@@ -42,6 +42,27 @@ async def try_send_to_user(broker: "Broker", public_key: bytes,
         return False
 
 
+def try_send_to_user_nowait(broker: "Broker", public_key: bytes,
+                            raw: Bytes) -> bool:
+    """Non-blocking variant for the device-plane egress: a full queue is a
+    failed send (⇒ removal), so one slow consumer can't head-of-line block
+    the pump."""
+    connection = broker.connections.get_user_connection(public_key)
+    if connection is None:
+        return False
+    clone = raw.clone()
+    try:
+        connection.send_raw_nowait(clone)
+        return True
+    except Exception as exc:
+        clone.release()
+        logger.info("nowait send to user %s failed (%r); removing",
+                    mnemonic(public_key), exc)
+        broker.connections.remove_user(public_key, reason="send failed")
+        broker.update_metrics()
+        return False
+
+
 async def try_send_to_broker(broker: "Broker", identifier: str,
                              raw: Bytes) -> bool:
     connection = broker.connections.get_broker_connection(identifier)

@@ -62,9 +62,22 @@ class UserSlots:
         return slot
 
     def release(self, public_key: bytes) -> None:
+        slot = self.unmap(public_key)
+        if slot is not None:
+            self.free_slot(slot)
+
+    def unmap(self, public_key: bytes) -> Optional[int]:
+        """Drop the key↔slot mapping WITHOUT recycling the slot index —
+        callers that may still have in-flight frames addressed to the slot
+        quarantine it and call :meth:`free_slot` later."""
         slot = self._key_to_slot.pop(public_key, None)
         if slot is not None:
             self._slot_to_key[slot] = None
+        return slot
+
+    def free_slot(self, slot: int) -> None:
+        """Return a previously :meth:`unmap`-ed slot index to the free list."""
+        if self._slot_to_key[slot] is None and slot not in self._free:
             self._free.append(slot)
 
     def slot_of(self, public_key: bytes) -> Optional[int]:

@@ -233,6 +233,16 @@ class Connection:
         if done is not None:
             await done
 
+    def send_raw_nowait(self, raw) -> None:
+        """Queue a frame without awaiting; raises ``asyncio.QueueFull`` when
+        the per-connection queue bound is hit (callers treat that as a
+        failed send). Used by the device-plane egress so one backpressured
+        peer can't stall the pump."""
+        self._check()
+        self._send_q.put_nowait((raw, None))
+        if self._error is not None:
+            raise self._error
+
     async def recv_message(self) -> Message:
         """Receive + decode one message, copying payload views out of the
         receive buffer so the pool permit can be released immediately. Hot
