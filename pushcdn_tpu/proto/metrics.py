@@ -116,6 +116,23 @@ def render_all() -> str:
     return "".join(m.render() for m in _REGISTRY.values())
 
 
+def render_tasks() -> str:
+    """One line per live asyncio task: name, state, and where it is
+    suspended — the poor man's tokio-console (`GET /tasks`)."""
+    lines = []
+    for task in sorted(asyncio.all_tasks(), key=lambda t: t.get_name()):
+        state = "done" if task.done() else (
+            "cancelling" if task.cancelling() else "pending")
+        where = ""
+        if not task.done():
+            stack = task.get_stack(limit=1)
+            if stack:
+                frame = stack[-1]
+                where = f" @ {frame.f_code.co_filename.rsplit('/', 1)[-1]}:{frame.f_lineno}"
+        lines.append(f"{task.get_name()}  [{state}]{where}")
+    return f"{len(lines)} tasks\n" + "\n".join(lines) + "\n"
+
+
 async def _running_latency_calculator(interval_s: float = 30.0) -> None:
     """Recompute RUNNING_LATENCY from histogram deltas every ``interval_s``
     (parity metrics.rs:43-78)."""
@@ -143,6 +160,13 @@ async def serve_metrics(bind_endpoint: str) -> asyncio.AbstractServer:
             if b"/metrics" in request:
                 body = render_all().encode()
                 writer.write(b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
+                             + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+            elif b"/tasks" in request:
+                # async-runtime introspection (the reference wires
+                # tokio-console behind tokio_unstable; here a plain dump of
+                # every live asyncio task: name, state, current frame)
+                body = render_tasks().encode()
+                writer.write(b"HTTP/1.1 200 OK\r\nContent-Type: text/plain\r\n"
                              + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
             else:
                 writer.write(b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n\r\n")

@@ -115,29 +115,94 @@ class Connection:
 
     # -- actor loops --------------------------------------------------------
 
+    # Batch small frames into one buffer per flush: per-frame event-loop +
+    # syscall overhead dominates ≤1 KB frames otherwise (BASELINE.md soft
+    # spot). Each flush unit stays under this size so the per-flush 5 s
+    # timeout keeps the same granularity the old per-frame timeout had;
+    # frames above the limit are written directly, no extra copy.
+    _BATCH_COALESCE_LIMIT = 64 * 1024
+
+    async def _flush(self, buf: bytearray) -> None:
+        """One bounded write under its own timeout; BYTES_SENT counts only
+        bytes that actually flushed."""
+        async with asyncio.timeout(WRITE_TIMEOUT_S):
+            await self._stream.write(buf)
+        metrics_mod.BYTES_SENT.inc(len(buf))
+
     async def _writer_loop(self) -> None:
+        batch: list = []
         try:
             while True:
                 item = await self._send_q.get()
                 if item is _CLOSE:
                     await self._stream.close()
                     return
-                payload, done = item
+                # Drain everything queued right now into one write batch.
+                batch = [item]
+                while len(batch) < 512:
+                    try:
+                        nxt = self._send_q.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    batch.append(nxt)
+                    if nxt is _CLOSE:
+                        break
+
+                buf = bytearray()
+                dones = []
+                close_after = False
                 try:
-                    async with asyncio.timeout(WRITE_TIMEOUT_S):
-                        await self._stream.write(_LEN.pack(len(payload)))
-                        await self._stream.write(
-                            payload.data if isinstance(payload, Bytes) else payload)
-                    metrics_mod.BYTES_SENT.inc(len(payload) + 4)
+                    for entry in batch:
+                        if entry is _CLOSE:
+                            close_after = True
+                            break
+                        payload, done = entry
+                        data = payload.data if isinstance(payload, Bytes) else payload
+                        n = len(data)
+                        if n <= self._BATCH_COALESCE_LIMIT:
+                            buf += _LEN.pack(n)
+                            buf += data
+                            if len(buf) >= self._BATCH_COALESCE_LIMIT:
+                                await self._flush(buf)
+                                buf = bytearray()
+                        else:
+                            if buf:
+                                await self._flush(buf)
+                                buf = bytearray()
+                            await self._flush(bytearray(_LEN.pack(n)))
+                            # large frames flush in bounded chunks so slow
+                            # links get a timeout window per chunk, not one
+                            # window for the whole payload
+                            view = memoryview(data)
+                            chunk = 4 * self._BATCH_COALESCE_LIMIT
+                            for off in range(0, n, chunk):
+                                await self._flush(bytearray(view[off:off + chunk]))
+                        if done is not None:
+                            dones.append(done)
+                    if buf:
+                        await self._flush(buf)
                 finally:
-                    if isinstance(payload, Bytes):
-                        payload.release()
-                if done is not None and not done.done():
-                    done.set_result(None)
+                    for entry in batch:
+                        if entry is not _CLOSE and isinstance(entry[0], Bytes):
+                            entry[0].release()
+                batch = []
+                for done in dones:
+                    if not done.done():
+                        done.set_result(None)
+                if close_after:
+                    await self._stream.close()
+                    return
         except asyncio.CancelledError:
             raise
         except Exception as exc:
-            self._poison(Error(ErrorKind.CONNECTION, f"write failed: {exc!r}", exc))
+            err = Error(ErrorKind.CONNECTION, f"write failed: {exc!r}", exc)
+            # flush=True senders whose entries we already dequeued must see
+            # the failure (they are beyond _poison's queue drain)
+            for entry in batch:
+                if entry is not _CLOSE and entry[1] is not None \
+                        and not entry[1].done():
+                    entry[1].set_exception(err)
+            self._poison(err)
 
     async def _reader_loop(self) -> None:
         try:
