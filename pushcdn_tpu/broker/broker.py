@@ -78,6 +78,9 @@ class BrokerConfig:
     whitelist_interval_s: float = 60.0
     membership_ttl_s: float = 60.0
     auth_timeout_s: float = 5.0
+    # False = register in discovery but never dial host broker links
+    # (deployments whose inter-broker plane is the device mesh only)
+    form_mesh: bool = True
 
 
 class Broker:

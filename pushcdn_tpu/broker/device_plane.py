@@ -46,6 +46,7 @@ from typing import TYPE_CHECKING, List, Optional, Tuple
 
 import numpy as np
 
+from pushcdn_tpu.broker.staging import StageResult
 from pushcdn_tpu.broker.tasks.senders import try_send_to_user_nowait
 from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
 from pushcdn_tpu.parallel.frames import FrameRing, UserSlots
@@ -75,6 +76,10 @@ class DevicePlaneConfig:
 
 
 class DevicePlane:
+    # single-shard plane: inter-broker fan-out stays on the host links
+    # (the mesh-group plane overrides this — peers ride ICI)
+    covers_brokers = False
+
     def __init__(self, broker: "Broker", config: DevicePlaneConfig = None):
         self.broker = broker
         self.config = config or DevicePlaneConfig()
@@ -136,39 +141,56 @@ class DevicePlane:
 
     # ---- ingress ----------------------------------------------------------
 
-    def try_stage(self, message, raw: Bytes) -> bool:
-        """Stage a decoded message's WIRE FRAME for device routing. Returns
-        False if it must take the host path (too big, unknown recipient,
-        unmirrored users present, ring full — slot-credit backpressure)."""
+    def try_stage(self, message, raw: Bytes) -> StageResult:
+        """Stage a decoded message's WIRE FRAME for device routing.
+        INELIGIBLE ⇒ host path (too big, unknown recipient, unmirrored
+        users present); FULL ⇒ slot-credit backpressure, caller retries."""
         if self.disabled:
-            return False
+            return StageResult.INELIGIBLE
         frame = bytes(raw.data)
         if len(frame) > self.config.frame_bytes:
-            return False
+            return StageResult.INELIGIBLE
         if isinstance(message, Broadcast):
             if self._unmirrored:
-                return False  # device fan-out would miss unmirrored users
+                return StageResult.INELIGIBLE  # would miss unmirrored users
             if any(int(t) >= 32 for t in message.topics):
-                return False  # beyond the u32 device topic mask
+                return StageResult.INELIGIBLE  # beyond the u32 device mask
             mask = self._mask_of(message.topics)
             if mask == 0:
-                return False
+                return StageResult.INELIGIBLE
             ok = self.ring.push_broadcast(frame, mask)
         elif isinstance(message, Direct):
             slot = self.slots.slot_of(bytes(message.recipient))
             if slot is None:
-                return False  # not a mirrored local user (cross-broker etc.)
+                return StageResult.INELIGIBLE  # not mirrored (cross-broker)
             ok = self.ring.push_direct(frame, slot)
         else:
-            return False
+            return StageResult.INELIGIBLE
         if ok:
             self._kick.set()
-        return ok
+            return StageResult.STAGED
+        return StageResult.FULL
+
+    def covered_broker_idents(self) -> set:
+        """Broker identifiers whose delivery this plane covers — none for
+        the single-shard plane (host links handle all peers)."""
+        return set()
 
     # ---- the pump ---------------------------------------------------------
 
     async def start(self) -> None:
+        # compile the step off the hot path (first jit can take seconds)
+        await asyncio.to_thread(self._warmup)
         self._task = asyncio.create_task(self._pump(), name="device-pump")
+
+    def _warmup(self) -> None:
+        empty = self.ring.take_batch()
+        try:
+            self._run_step(empty, self._owned.copy(), self._masks.copy())
+            self.steps -= 1  # warmup doesn't count
+        except Exception:
+            logger.exception("device-plane warmup step failed")
+            self.disabled = True
 
     async def stop(self) -> None:
         if self._task is not None:

@@ -1,0 +1,384 @@
+"""MeshBrokerGroup — N broker shards whose inter-broker traffic rides the
+device mesh instead of host links.
+
+This is the BASELINE.json north star wired into the broker runtime: each
+broker in the group is one shard of a ``jax.sharding.Mesh`` over the
+``"brokers"`` axis; the group pump coalesces every shard's staged frames
+and runs ONE jitted ``shard_map`` routing step per tick, in which
+
+- the inter-broker hop is the step's ``all_gather`` over ICI (replacing
+  the reference's per-peer TCP writes, SURVEY.md §2e row 1-2),
+- cross-shard direct routing is delivery-iff-owner (one hop, loop-free by
+  construction),
+- broadcast interest is the topic-bitmask kernel against the global user
+  table.
+
+Host TCP/memory broker links remain as the **fallback plane**: brokers in
+a group still heartbeat/dial each other, and if a device step ever fails
+the staged batches are re-routed over those links and the group disables
+itself (fail-open to the reference's architecture).
+
+Consistency: one process = one source of truth. The group owns the GLOBAL
+user-slot table and mirrors (owner shard, claim version, topic mask per
+slot), mutated only on the event loop via each shard's observer facade
+(:class:`MeshShardPlane`). Steps snapshot mirrors + all rings in one tick
+(same discipline as the single-shard DevicePlane). In-group double
+connects are authoritative at claim time: the previous owning shard's
+session is kicked immediately ("user connected elsewhere"). On a real
+multi-host pod each host would hold only its shard's claims and the
+in-step CRDT merge would do the convergence — the device program is the
+same either way (it already property-matches the host VersionedMap).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Dict, List, Optional
+
+import numpy as np
+
+from pushcdn_tpu.broker.tasks.senders import try_send_to_user_nowait
+from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
+from pushcdn_tpu.parallel.frames import FrameRing, UserSlots
+from pushcdn_tpu.parallel.router import (
+    BROKER_AXIS,
+    IngressBatch,
+    RouterState,
+    make_mesh_routing_step,
+)
+from pushcdn_tpu.proto.error import Error
+from pushcdn_tpu.proto.limiter import Bytes
+from pushcdn_tpu.proto.message import Broadcast, Direct
+
+if TYPE_CHECKING:
+    from pushcdn_tpu.broker.broker import Broker
+
+logger = logging.getLogger("pushcdn.broker.meshgroup")
+
+
+@dataclass
+class MeshGroupConfig:
+    num_user_slots: int = 1024
+    ring_slots: int = 256          # per shard per step
+    frame_bytes: int = 2048
+    batch_window_s: float = 0.001
+
+
+class MeshShardPlane:
+    """Per-broker facade: the Connections observer + staging interface for
+    one shard. Duck-compatible with DevicePlane where handlers.py cares."""
+
+    covers_brokers = True  # staged broadcasts reach mesh peers over ICI
+
+    def __init__(self, group: "MeshBrokerGroup", shard: int):
+        self.group = group
+        self.shard = shard
+
+    # Connections observer protocol --------------------------------------
+    def on_user_added(self, public_key: bytes, topics) -> None:
+        self.group.claim_user(self.shard, public_key, topics)
+
+    def on_user_removed(self, public_key: bytes) -> None:
+        self.group.release_user(self.shard, public_key)
+
+    def on_subscription_changed(self, public_key: bytes, topics) -> None:
+        self.group.update_mask(self.shard, public_key, topics)
+
+    # staging -------------------------------------------------------------
+    def try_stage(self, message, raw: Bytes):
+        return self.group.try_stage(self.shard, message, raw)
+
+    def covered_broker_idents(self) -> set:
+        """Identifiers of the group's member brokers — the mesh step covers
+        delivery to them, so the host path must not also forward (but MUST
+        still forward to interested OUT-of-group brokers)."""
+        return self.group.member_idents()
+
+    # lifecycle (driven by the owning broker's start/stop)
+    async def start(self) -> None:
+        await self.group.ensure_started()
+
+    async def stop(self) -> None:
+        await self.group.on_shard_stopped(self.shard)
+
+    @property
+    def disabled(self) -> bool:
+        return self.group.disabled
+
+    @property
+    def steps(self) -> int:
+        return self.group.steps
+
+    @property
+    def messages_routed(self) -> int:
+        return self.group.messages_routed
+
+
+class MeshBrokerGroup:
+    def __init__(self, mesh, config: MeshGroupConfig = None):
+        self.mesh = mesh
+        self.config = config or MeshGroupConfig()
+        c = self.config
+        self.num_shards = mesh.devices.size
+        self.step_fn = make_mesh_routing_step(mesh)
+        self.brokers: List[Optional["Broker"]] = [None] * self.num_shards
+        self.rings = [FrameRing(slots=c.ring_slots, frame_bytes=c.frame_bytes)
+                      for _ in range(self.num_shards)]
+        # global user table + mirrors (single source of truth)
+        self.slots = UserSlots(c.num_user_slots)
+        self._owner = np.full(c.num_user_slots, ABSENT, np.int32)
+        self._claim_version = np.zeros(c.num_user_slots, np.uint32)
+        self._masks = np.zeros(c.num_user_slots, np.uint32)
+        self._quarantine: List[int] = []
+        self._unmirrored: set[bytes] = set()
+        self.disabled = False
+        self._kick = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._started = False
+        self.steps = 0
+        self.messages_routed = 0
+
+    # ---- wiring ----------------------------------------------------------
+
+    def attach(self, broker: "Broker", shard: int) -> MeshShardPlane:
+        """Make ``broker`` shard ``shard`` of this group (call after
+        Broker.new, before Broker.start)."""
+        plane = MeshShardPlane(self, shard)
+        self.brokers[shard] = broker
+        broker.device_plane = plane
+        broker.connections.observer = plane
+        self._member_idents = None  # recompute lazily
+        return plane
+
+    def member_idents(self) -> set:
+        idents = getattr(self, "_member_idents", None)
+        if idents is None:
+            idents = {str(b.identity) for b in self.brokers if b is not None}
+            self._member_idents = idents
+        return idents
+
+    async def ensure_started(self) -> None:
+        if not self._started:
+            self._started = True
+            # compile the step off the hot path: the first jitted shard_map
+            # trace can take seconds; rings must not saturate behind it
+            await asyncio.to_thread(self._warmup)
+            self._task = asyncio.create_task(self._pump(), name="mesh-group-pump")
+
+    def _warmup(self) -> None:
+        batches = [r.take_batch() for r in self.rings]  # empty, right shapes
+        try:
+            self._run_step(batches, self._owner.copy(),
+                           self._claim_version.copy(), self._masks.copy())
+            self.steps -= 1  # warmup doesn't count
+        except Exception:
+            logger.exception("mesh-group warmup step failed")
+            self.disabled = True
+
+    async def on_shard_stopped(self, shard: int) -> None:
+        self.brokers[shard] = None
+        if all(b is None for b in self.brokers) and self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                logger.exception("mesh-group pump died during stop")
+            self._task = None
+            self._started = False
+
+    # ---- mirrors (event-loop only) ---------------------------------------
+
+    def claim_user(self, shard: int, public_key: bytes, topics) -> None:
+        try:
+            slot = self.slots.assign(public_key)
+        except Error:
+            self._unmirrored.add(public_key)
+            logger.warning("mesh-group slot table full; %d unmirrored",
+                           len(self._unmirrored))
+            return
+        prev = int(self._owner[slot])
+        if prev != ABSENT and prev != shard:
+            # in-group double connect: kick the old session immediately
+            # (the host CRDT handles out-of-group brokers)
+            old = self.brokers[prev]
+            if old is not None and old.connections.has_user(public_key):
+                logger.info("user connected elsewhere in group (shard %d -> %d)",
+                            prev, shard)
+                old.connections.remove_user(
+                    public_key, reason="user connected elsewhere")
+                # removal via the old shard's observer released the slot;
+                # re-assign for the new owner
+                slot = self.slots.assign(public_key)
+        self._owner[slot] = shard
+        self._claim_version[slot] += 1
+        self._masks[slot] = _mask_of(topics)
+
+    def release_user(self, shard: int, public_key: bytes) -> None:
+        self._unmirrored.discard(public_key)
+        slot = self.slots.slot_of(public_key)
+        if slot is None or int(self._owner[slot]) != shard:
+            return  # not ours (already taken over by another shard)
+        self.slots.unmap(public_key)
+        self._owner[slot] = ABSENT
+        self._claim_version[slot] += 1
+        self._masks[slot] = 0
+        self._quarantine.append(slot)
+
+    def update_mask(self, shard: int, public_key: bytes, topics) -> None:
+        slot = self.slots.slot_of(public_key)
+        if slot is not None and int(self._owner[slot]) == shard:
+            self._masks[slot] = _mask_of(topics)
+
+    # ---- staging ----------------------------------------------------------
+
+    def try_stage(self, shard: int, message, raw: Bytes):
+        from pushcdn_tpu.broker.staging import StageResult
+        if self.disabled:
+            return StageResult.INELIGIBLE
+        frame = bytes(raw.data)
+        if len(frame) > self.config.frame_bytes:
+            return StageResult.INELIGIBLE
+        ring = self.rings[shard]
+        if isinstance(message, Broadcast):
+            if self._unmirrored:
+                return StageResult.INELIGIBLE
+            if any(int(t) >= 32 for t in message.topics):
+                return StageResult.INELIGIBLE
+            mask = _mask_of(message.topics)
+            if mask == 0:
+                return StageResult.INELIGIBLE
+            ok = ring.push_broadcast(frame, mask)
+        elif isinstance(message, Direct):
+            slot = self.slots.slot_of(bytes(message.recipient))
+            if slot is None:
+                return StageResult.INELIGIBLE  # outside the group: host path
+            ok = ring.push_direct(frame, slot)
+        else:
+            return StageResult.INELIGIBLE
+        if ok:
+            self._kick.set()
+            return StageResult.STAGED
+        return StageResult.FULL
+
+    # ---- the pump ---------------------------------------------------------
+
+    async def _pump(self) -> None:
+        while True:
+            await self._kick.wait()
+            self._kick.clear()
+            await asyncio.sleep(self.config.batch_window_s)
+            if all(r.free_slots == r.slots for r in self.rings):
+                continue
+            # one-tick snapshot: all rings + mirrors together
+            batches = [r.take_batch() for r in self.rings]
+            owner = self._owner.copy()
+            versions = self._claim_version.copy()
+            masks = self._masks.copy()
+            quarantined, self._quarantine = self._quarantine, []
+            try:
+                deliver, lengths, frames = await asyncio.to_thread(
+                    self._run_step, batches, owner, versions, masks)
+                self._egress(deliver, lengths, frames)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception(
+                    "mesh-group step failed; re-routing batches over host "
+                    "links and disabling the group")
+                self.disabled = True
+                await self._host_fallback(batches)
+                return
+            finally:
+                for slot in quarantined:
+                    self.slots.free_slot(slot)
+
+    def _run_step(self, batches, owner, versions, masks):
+        """Blocking multi-shard device step (worker thread)."""
+        import jax.numpy as jnp
+        B = self.num_shards
+        # every shard's state row is the (shared) global view; on real
+        # multi-host pods these rows diverge and the in-step merge converges
+        # them — the device program is identical
+        owners_b = np.broadcast_to(owner, (B,) + owner.shape)
+        versions_b = np.broadcast_to(versions, (B,) + versions.shape)
+        ids_b = owners_b  # conflict identity = owning shard index
+        masks_b = np.broadcast_to(masks, (B,) + masks.shape)
+        state = RouterState(
+            crdt=CrdtState(jnp.asarray(owners_b), jnp.asarray(versions_b),
+                           jnp.asarray(ids_b)),
+            topic_masks=jnp.asarray(masks_b))
+        batch = IngressBatch(
+            jnp.asarray(np.stack([b.bytes_ for b in batches])),
+            jnp.asarray(np.stack([b.kind for b in batches])),
+            jnp.asarray(np.stack([b.length for b in batches])),
+            jnp.asarray(np.stack([b.topic_mask for b in batches])),
+            jnp.asarray(np.stack([b.dest for b in batches])),
+            jnp.asarray(np.stack([b.valid for b in batches])))
+        result = self.step_fn(state, batch)
+        self.steps += 1
+        return (np.asarray(result.deliver),          # [B, U, B*S]
+                np.asarray(result.gathered_length),  # [B, B*S]
+                np.asarray(result.gathered_bytes))   # [B, B*S, F]
+
+    def _egress(self, deliver, lengths, frames) -> None:
+        for shard in range(self.num_shards):
+            broker = self.brokers[shard]
+            if broker is None:
+                continue
+            users, frame_idx = np.nonzero(deliver[shard])
+            cache: Dict[int, Bytes] = {}
+            for u, f in zip(users.tolist(), frame_idx.tolist()):
+                key = self.slots.key_of(u)
+                if key is None:
+                    continue
+                raw = cache.get(f)
+                if raw is None:
+                    raw = Bytes(frames[shard, f, :lengths[shard, f]].tobytes())
+                    cache[f] = raw
+                if try_send_to_user_nowait(broker, key, raw):
+                    self.messages_routed += 1
+            for raw in cache.values():
+                raw.release()
+
+    async def _host_fallback(self, batches) -> None:
+        """Re-route every staged frame over the host plane (brokers keep
+        their TCP/memory mesh links as backup)."""
+        from pushcdn_tpu.broker.tasks.handlers import (
+            handle_broadcast_message,
+            handle_direct_message,
+        )
+        from pushcdn_tpu.proto.message import deserialize
+        for shard, b in enumerate(batches):
+            broker = self.brokers[shard]
+            if broker is None:
+                continue
+            for i in range(len(b.valid)):
+                if not b.valid[i]:
+                    continue
+                raw = Bytes(b.bytes_[i, :b.length[i]].tobytes())
+                try:
+                    message = deserialize(raw.data)
+                    if isinstance(message, Direct):
+                        await handle_direct_message(
+                            broker, bytes(message.recipient), raw,
+                            to_user_only=False)
+                    elif isinstance(message, Broadcast):
+                        await handle_broadcast_message(
+                            broker, list(message.topics), raw,
+                            to_users_only=False)
+                except Error:
+                    pass
+                finally:
+                    raw.release()
+
+
+def _mask_of(topics) -> int:
+    mask = 0
+    for t in topics:
+        if int(t) < 32:
+            mask |= 1 << int(t)
+    return mask

@@ -1,0 +1,13 @@
+"""Shared staging types for the device planes (dependency-light so the
+host-only broker path never imports jax)."""
+
+import enum
+
+
+class StageResult(enum.Enum):
+    """Outcome of try_stage — FULL is backpressure (retry), INELIGIBLE is
+    a host-path message (don't)."""
+
+    STAGED = "staged"
+    INELIGIBLE = "ineligible"
+    FULL = "full"

@@ -33,6 +33,7 @@ from pushcdn_tpu.broker.tasks.senders import (
     try_send_to_brokers,
     try_send_to_user,
 )
+from pushcdn_tpu.broker.staging import StageResult
 from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto.def_ import HookResult
 from pushcdn_tpu.proto.error import Error
@@ -74,19 +75,37 @@ async def handle_direct_message(broker: "Broker", recipient: bytes,
 
 async def handle_broadcast_message(broker: "Broker", topics: Sequence[int],
                                    raw: Bytes, to_users_only: bool,
-                                   users_via_device: bool = False) -> None:
+                                   users_via_device: bool = False,
+                                   exclude_brokers: frozenset = frozenset()
+                                   ) -> None:
     """Interest-driven fan-out (broker/handler.rs:240-272).
 
     ``users_via_device=True`` means the local-user fan-out was staged onto
     the device plane; only the inter-broker forwarding runs on the host.
+    ``exclude_brokers`` are peers already covered by the device mesh
+    (group members) — interested OUT-of-group brokers still get the frame.
     """
     users, brokers = broker.connections.get_interested_by_topic(
         list(topics), to_users_only)
     for ident in brokers:
-        await try_send_to_broker(broker, ident, raw)
+        if ident not in exclude_brokers:
+            await try_send_to_broker(broker, ident, raw)
     if not users_via_device:
         for user in users:
             await try_send_to_user(broker, user, raw)
+
+
+async def _stage_with_backpressure(device, message, raw: Bytes):
+    """Stage onto the device plane; FULL results block THIS sender's
+    receive loop and retry — the same "block the reader, not the router"
+    semantics the byte-pool gives the host path. The wait is unbounded on
+    purpose (so is the pool's): if the pump dies it flips ``disabled`` and
+    try_stage starts returning INELIGIBLE, which exits the loop."""
+    while True:
+        result = device.try_stage(message, raw)
+        if result != StageResult.FULL:
+            return result
+        await asyncio.sleep(0.002)
 
 
 # ---------------------------------------------------------------------------
@@ -118,20 +137,33 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
 
                 device = broker.device_plane
                 if isinstance(message, Direct):
-                    # device path covers local-recipient delivery; host path
-                    # covers cross-broker forwards and oversized frames
-                    if device is not None and device.try_stage(message, raw):
-                        continue
+                    # device path covers local-recipient delivery (and, for
+                    # a mesh-group plane, any recipient in the group); host
+                    # path covers the rest
+                    if device is not None:
+                        result = await _stage_with_backpressure(
+                            device, message, raw)
+                        if result == StageResult.STAGED:
+                            continue
                     await handle_direct_message(
                         broker, message.recipient, raw, to_user_only=False)
                 elif isinstance(message, Broadcast):
                     pruned, _bad = topics.prune(message.topics)
                     if pruned:
-                        staged = (device is not None
-                                  and device.try_stage(message, raw))
+                        staged = False
+                        if device is not None:
+                            result = await _stage_with_backpressure(
+                                device, message, raw)
+                            staged = result == StageResult.STAGED
+                        # host side: remaining fan-out — all of it when not
+                        # staged; only out-of-group/interest forwarding when
+                        # the device covers users (+ group peers over ICI)
                         await handle_broadcast_message(
                             broker, pruned, raw, to_users_only=False,
-                            users_via_device=staged)
+                            users_via_device=staged,
+                            exclude_brokers=(
+                                frozenset(device.covered_broker_idents())
+                                if staged else frozenset()))
                 elif isinstance(message, Subscribe):
                     pruned, bad = topics.prune(message.topics)
                     if bad:
@@ -187,12 +219,21 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                     break
 
                 device = broker.device_plane
+                # A covers_brokers (mesh-group) plane must NOT re-stage
+                # host-forwarded traffic: the origin couldn't stage it, and
+                # re-staging would all_gather it back to every shard —
+                # duplicate delivery. Host-forwarded frames are delivered
+                # locally only, exactly the reference's to_users_only rule.
+                single_shard = device is not None and not device.covers_brokers
                 if isinstance(message, Direct):
                     # deliver to our own user only — never re-forward
-                    # (broker/handler.rs:148-153); the device path's
-                    # delivery-iff-owner rule enforces the same invariant
-                    if device is not None and device.try_stage(message, raw):
-                        continue
+                    # (broker/handler.rs:148-153); the single-shard device
+                    # path's delivery-iff-owner rule keeps that invariant
+                    if single_shard:
+                        result = await _stage_with_backpressure(
+                            device, message, raw)
+                        if result == StageResult.STAGED:
+                            continue
                     await handle_direct_message(
                         broker, message.recipient, raw, to_user_only=True)
                 elif isinstance(message, Broadcast):
@@ -200,8 +241,11 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                     # (broker/handler.rs:156-161)
                     pruned, _bad = topics.prune(message.topics)
                     if pruned:
-                        if device is not None and device.try_stage(message, raw):
-                            continue
+                        if single_shard:
+                            result = await _stage_with_backpressure(
+                                device, message, raw)
+                            if result == StageResult.STAGED:
+                                continue
                         await handle_broadcast_message(
                             broker, pruned, raw, to_users_only=True)
                 elif isinstance(message, UserSync):

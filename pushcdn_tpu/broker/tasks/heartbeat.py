@@ -42,6 +42,14 @@ async def _dial(broker: "Broker", peer) -> None:
 async def heartbeat_once(broker: "Broker") -> None:
     await broker.discovery.perform_heartbeat(
         broker.connections.num_users, broker.config.membership_ttl_s)
+    if not broker.config.form_mesh:
+        # device-mesh-only inter-broker plane: no host dialing — UNLESS the
+        # device plane disabled itself, in which case the fail-open to host
+        # links must actually engage or the cluster stays partitioned
+        plane = broker.device_plane
+        if plane is None or not plane.disabled:
+            return
+        logger.warning("device plane disabled; enabling host mesh dialing")
     peers = await broker.discovery.get_other_brokers()
     me = str(broker.identity)
     candidates = [
