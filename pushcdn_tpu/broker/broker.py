@@ -98,6 +98,9 @@ class Broker:
         self.broker_listener = None
         self._tasks: list[asyncio.Task] = []
         self._stopped = asyncio.Event()
+        # set by the device plane when overflow traffic needs host links
+        # before the next scheduled heartbeat tick
+        self.host_links_kick = asyncio.Event()
         self._metrics_server = None
         self.device_plane = None
         self.seen_dialing: set[str] = set()  # peers we're currently dialing

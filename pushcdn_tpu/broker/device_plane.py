@@ -94,6 +94,10 @@ class DevicePlane:
         # host path while any exist (they'd miss device-only fan-out)
         self._unmirrored: set[bytes] = set()
         self.disabled = False
+        # single-shard planes keep inter-broker traffic on host links, so
+        # they never *need* overflow dialing — the attribute exists because
+        # heartbeat fail-open logic reads it off any plane uniformly
+        self.overflow_seen = False
         self._kick = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self.steps = 0

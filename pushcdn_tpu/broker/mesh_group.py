@@ -108,6 +108,10 @@ class MeshShardPlane:
         return self.group.disabled
 
     @property
+    def overflow_seen(self) -> bool:
+        return self.group.overflow_seen
+
+    @property
     def steps(self) -> int:
         return self.group.steps
 
@@ -134,6 +138,9 @@ class MeshBrokerGroup:
         self._quarantine: List[int] = []
         self._unmirrored: set[bytes] = set()
         self.disabled = False
+        # set when traffic falls outside what the mesh step can carry —
+        # heartbeats then form host links even in mesh-only deployments
+        self.overflow_seen = False
         self._kick = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._started = False
@@ -211,8 +218,16 @@ class MeshBrokerGroup:
                 old.connections.remove_user(
                     public_key, reason="user connected elsewhere")
                 # removal via the old shard's observer released the slot;
-                # re-assign for the new owner
-                slot = self.slots.assign(public_key)
+                # re-assign for the new owner (the freed slot is quarantined
+                # until the next step, so a full table can fail here too)
+                try:
+                    slot = self.slots.assign(public_key)
+                except Error:
+                    self._unmirrored.add(public_key)
+                    logger.warning(
+                        "mesh-group slot table full after in-group kick; "
+                        "%d unmirrored", len(self._unmirrored))
+                    return
         self._owner[slot] = shard
         self._claim_version[slot] += 1
         self._masks[slot] = _mask_of(topics)
@@ -235,27 +250,40 @@ class MeshBrokerGroup:
 
     # ---- staging ----------------------------------------------------------
 
+    def _overflow(self):
+        """Traffic the mesh step can't carry must ride host links: flag it
+        and wake every member's heartbeat so those links form promptly."""
+        from pushcdn_tpu.broker.staging import StageResult
+        if not self.overflow_seen:
+            self.overflow_seen = True
+            logger.info("mesh-group overflow traffic; host links requested")
+        for b in self.brokers:
+            if b is not None:
+                b.host_links_kick.set()
+        return StageResult.INELIGIBLE
+
     def try_stage(self, shard: int, message, raw: Bytes):
         from pushcdn_tpu.broker.staging import StageResult
         if self.disabled:
             return StageResult.INELIGIBLE
         frame = bytes(raw.data)
         if len(frame) > self.config.frame_bytes:
-            return StageResult.INELIGIBLE
+            return self._overflow()
         ring = self.rings[shard]
         if isinstance(message, Broadcast):
             if self._unmirrored:
-                return StageResult.INELIGIBLE
+                return self._overflow()
             if any(int(t) >= 32 for t in message.topics):
-                return StageResult.INELIGIBLE
+                return self._overflow()
             mask = _mask_of(message.topics)
             if mask == 0:
-                return StageResult.INELIGIBLE
+                return StageResult.INELIGIBLE  # no valid topics: no-op send
             ok = ring.push_broadcast(frame, mask)
         elif isinstance(message, Direct):
             slot = self.slots.slot_of(bytes(message.recipient))
             if slot is None:
-                return StageResult.INELIGIBLE  # outside the group: host path
+                # outside the group: legitimately the host path's job
+                return self._overflow()
             ok = ring.push_direct(frame, slot)
         else:
             return StageResult.INELIGIBLE
@@ -290,7 +318,12 @@ class MeshBrokerGroup:
                     "mesh-group step failed; re-routing batches over host "
                     "links and disabling the group")
                 self.disabled = True
+                # frames staged (and acked as STAGED) while the failing step
+                # ran in the worker thread sit in the fresh rings — drain
+                # them too, or they'd be lost with no fallback
+                late = [r.take_batch() for r in self.rings]
                 await self._host_fallback(batches)
+                await self._host_fallback(late)
                 return
             finally:
                 for slot in quarantined:
@@ -352,10 +385,19 @@ class MeshBrokerGroup:
             handle_direct_message,
         )
         from pushcdn_tpu.proto.message import deserialize
+        members = self.member_idents()
         for shard, b in enumerate(batches):
             broker = self.brokers[shard]
             if broker is None:
                 continue
+            # Staged broadcasts were ALREADY forwarded to interested
+            # out-of-group brokers at staging time (the stage-time exclude
+            # set covers only group members) — re-forwarding here would
+            # deliver those subscribers a second copy. The fallback only
+            # owes what the failed step owed: local users + group members.
+            out_of_group = frozenset(
+                ident for ident in broker.connections.all_broker_identifiers()
+                if ident not in members)
             for i in range(len(b.valid)):
                 if not b.valid[i]:
                     continue
@@ -369,7 +411,8 @@ class MeshBrokerGroup:
                     elif isinstance(message, Broadcast):
                         await handle_broadcast_message(
                             broker, list(message.topics), raw,
-                            to_users_only=False)
+                            to_users_only=False,
+                            exclude_brokers=out_of_group)
                 except Error:
                     pass
                 finally:

@@ -43,13 +43,21 @@ async def heartbeat_once(broker: "Broker") -> None:
     await broker.discovery.perform_heartbeat(
         broker.connections.num_users, broker.config.membership_ttl_s)
     if not broker.config.form_mesh:
-        # device-mesh-only inter-broker plane: no host dialing — UNLESS the
-        # device plane disabled itself, in which case the fail-open to host
-        # links must actually engage or the cluster stays partitioned
+        # device-mesh-only inter-broker plane: skip host dialing only while
+        # the mesh plane actually covers ALL inter-broker traffic. Fail open
+        # to host links when (a) there is no broker-covering plane, (b) the
+        # plane disabled itself, or (c) overflow traffic exists that the
+        # plane can't carry (oversized frames, out-of-range topics,
+        # unmirrored users, out-of-group recipients) — that traffic rides
+        # host links, so without them it would be silently lost.
         plane = broker.device_plane
-        if plane is None or not plane.disabled:
+        covers = plane is not None and getattr(plane, "covers_brokers", False)
+        if covers and not plane.disabled and not plane.overflow_seen:
             return
-        logger.warning("device plane disabled; enabling host mesh dialing")
+        if plane is not None and (plane.disabled or plane.overflow_seen):
+            logger.warning(
+                "device plane %s; enabling host mesh dialing",
+                "disabled" if plane.disabled else "has overflow traffic")
     peers = await broker.discovery.get_other_brokers()
     me = str(broker.identity)
     candidates = [
@@ -67,4 +75,11 @@ async def heartbeat_once(broker: "Broker") -> None:
 async def run_heartbeat_task(broker: "Broker") -> None:
     while True:
         await heartbeat_once(broker)
-        await asyncio.sleep(broker.config.heartbeat_interval_s)
+        # sleep until the next tick — or earlier, if the device plane sees
+        # overflow traffic and kicks us to form host links promptly
+        try:
+            async with asyncio.timeout(broker.config.heartbeat_interval_s):
+                await broker.host_links_kick.wait()
+            broker.host_links_kick.clear()
+        except asyncio.TimeoutError:
+            pass

@@ -9,8 +9,9 @@ cdn-proto/src/connection/protocols/mod.rs:85-306). Implementations:
 - ``tcp`` — plain TCP with TCP_NODELAY (parity protocols/tcp.rs)
 - ``tcp_tls`` — TLS over TCP with the local/prod CA scheme (parity
   protocols/tcp_tls.rs)
-- ``quic`` — gated: no QUIC stack in this environment; the class exists so
-  configs referencing it fail with a clear error (parity protocols/quic.rs)
+- ``quic`` — QUIC-class reliable stream over UDP: handshake, single
+  bootstrapped bidirectional stream, ACK/retransmit loss recovery, 5 s
+  keep-alive, 3 s graceful finish (parity protocols/quic.rs)
 
 The device data plane's inter-broker "transport" is NOT one of these: broker
 ↔ broker fan-out on TPU lowers to XLA collectives over ICI (see
@@ -24,5 +25,6 @@ from pushcdn_tpu.proto.transport.base import (  # noqa: F401
     UnfinalizedConnection,
 )
 from pushcdn_tpu.proto.transport.memory import Memory  # noqa: F401
+from pushcdn_tpu.proto.transport.quic import Quic  # noqa: F401
 from pushcdn_tpu.proto.transport.tcp import Tcp  # noqa: F401
 from pushcdn_tpu.proto.transport.tcp_tls import TcpTls  # noqa: F401

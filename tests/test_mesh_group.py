@@ -228,3 +228,40 @@ async def test_staged_broadcast_still_forwards_to_out_of_group_broker():
         alice.close()
     finally:
         await cluster.stop()
+
+
+async def test_overflow_traffic_triggers_host_links_in_mesh_only_mode():
+    """Mesh-only deployment (no host links formed up-front): traffic the
+    device plane can't carry — here an oversized frame — must flag
+    overflow, kick the heartbeat into dialing host links, and then flow
+    cross-shard over those links instead of being silently lost."""
+    cluster = await MeshCluster(num_shards=2).start(form_host_mesh=False)
+    try:
+        alice = await cluster.place_client(seed=600, shard=0, topics=[1])
+        bob = await cluster.place_client(seed=601, shard=1, topics=[1])
+        for b in cluster.brokers:
+            assert b.connections.num_brokers == 0
+
+        big = b"x" * 4096  # frame_bytes=1024 ⇒ ineligible for the mesh step
+        await alice.send_broadcast_message([1], big)
+        await wait_until(lambda: cluster.group.overflow_seen)
+        # the kicked heartbeat forms host links promptly
+        await wait_until(
+            lambda: all(b.connections.num_brokers >= 1
+                        for b in cluster.brokers))
+        # with links up, oversized traffic crosses shards on the host plane
+        await alice.send_broadcast_message([1], big + b"2")
+        got = await asyncio.wait_for(bob.receive_message(), 10)
+        assert bytes(got.message) == big + b"2"
+        # and eligible traffic still rides the device mesh, exactly once
+        await alice.send_broadcast_message([1], b"small still on mesh")
+        got2 = await asyncio.wait_for(bob.receive_message(), 10)
+        assert bytes(got2.message) == b"small still on mesh"
+        pending = asyncio.create_task(bob.receive_message())
+        await asyncio.sleep(0.3)
+        assert not pending.done()  # no duplicate via host + mesh
+        pending.cancel()
+        alice.close()
+        bob.close()
+    finally:
+        await cluster.stop()

@@ -13,13 +13,14 @@ import pytest
 from pushcdn_tpu.proto.error import Error
 from pushcdn_tpu.proto.limiter import Limiter
 from pushcdn_tpu.proto.message import Broadcast, Direct, deserialize
-from pushcdn_tpu.proto.transport import Memory, Tcp, TcpTls
+from pushcdn_tpu.proto.transport import Memory, Quic, Tcp, TcpTls
 from pushcdn_tpu.proto.transport.memory import gen_testing_connection_pair
 
 TRANSPORTS = [
     pytest.param(Memory, "test-conformance-mem", id="memory"),
     pytest.param(Tcp, "127.0.0.1:0", id="tcp"),
     pytest.param(TcpTls, "127.0.0.1:0", id="tcp_tls"),
+    pytest.param(Quic, "127.0.0.1:0", id="quic"),
 ]
 
 
