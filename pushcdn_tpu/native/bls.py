@@ -1,0 +1,118 @@
+"""ctypes bindings for the native BLS-over-BN254 library
+(native/bls_bn254.cpp).
+
+The reference's signature scheme is BLS over BN254 from jellyfish
+(cdn-proto/src/crypto/signature.rs:113-175); the pairing arithmetic is
+native there and native here. Compiled on first use with g++ (pybind11 is
+not in this image, so the ABI is plain C via ctypes) and cached under
+``.build/``. ``available()`` is False if compilation fails; callers fall
+back to the Ed25519 scheme — the ``SignatureScheme`` seam makes the swap
+invisible.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "native", "bls_bn254.cpp")
+_INC = os.path.join(_REPO, "native", "bls_generated.inc")
+_BUILD_DIR = os.path.join(_REPO, ".build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libpushcdn_bls.so")
+
+SK_LEN = 32
+PK_LEN = 128
+SIG_LEN = 64
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> Optional[ctypes.CDLL]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    src_mtime = max(os.path.getmtime(_SRC), os.path.getmtime(_INC))
+    if not os.path.exists(_LIB_PATH) or src_mtime > os.path.getmtime(_LIB_PATH):
+        cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB_PATH]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        except (subprocess.SubprocessError, OSError):
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.bls_keygen.restype = ctypes.c_int
+    lib.bls_keygen.argtypes = [u8p, u8p, u8p]
+    lib.bls_sign.restype = ctypes.c_int
+    lib.bls_sign.argtypes = [u8p, ctypes.c_char_p, ctypes.c_longlong, u8p]
+    lib.bls_verify.restype = ctypes.c_int
+    lib.bls_verify.argtypes = [u8p, ctypes.c_char_p, ctypes.c_longlong, u8p]
+    lib.bls_self_test.restype = ctypes.c_int
+    lib.bls_self_test.argtypes = []
+    return lib
+
+
+def _get() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if not _tried:
+            _tried = True
+            _lib = _compile()
+        return _lib
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def self_test() -> int:
+    """0 = all pairing/scheme invariants hold (see bls_self_test)."""
+    lib = _get()
+    if lib is None:
+        return -1
+    return lib.bls_self_test()
+
+
+def _buf(data: bytes):
+    return (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+
+
+def keygen(seed32: bytes) -> tuple[bytes, bytes]:
+    """Deterministic (private_key, public_key) from a 32-byte seed."""
+    lib = _get()
+    assert lib is not None, "native BLS unavailable"
+    assert len(seed32) == 32
+    sk = (ctypes.c_uint8 * SK_LEN)()
+    pk = (ctypes.c_uint8 * PK_LEN)()
+    rc = lib.bls_keygen(_buf(seed32), sk, pk)
+    if rc != 0:
+        raise ValueError(f"bls_keygen failed: {rc}")
+    return bytes(sk), bytes(pk)
+
+
+def sign(sk: bytes, message: bytes) -> bytes:
+    lib = _get()
+    assert lib is not None, "native BLS unavailable"
+    if len(sk) != SK_LEN:
+        raise ValueError("bad secret key length")
+    sig = (ctypes.c_uint8 * SIG_LEN)()
+    rc = lib.bls_sign(_buf(sk), bytes(message), len(message), sig)
+    if rc != 0:
+        raise ValueError(f"bls_sign failed: {rc}")
+    return bytes(sig)
+
+
+def verify(pk: bytes, message: bytes, signature: bytes) -> bool:
+    lib = _get()
+    assert lib is not None, "native BLS unavailable"
+    if len(pk) != PK_LEN or len(signature) != SIG_LEN:
+        return False
+    return lib.bls_verify(_buf(pk), bytes(message), len(message),
+                          _buf(signature)) == 1

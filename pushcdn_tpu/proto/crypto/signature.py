@@ -8,11 +8,15 @@ Capability parity with cdn-proto/src/crypto/signature.rs:19-175:
 - ``SignatureScheme`` trait (sign/verify over namespaced messages);
 - ``KeyPair`` with seeded deterministic generation (parity
   ``DeterministicRng``, crypto/rng.rs:15-42 — reproducible keys for tests);
-- Reference impl: the reference uses BLS over BN254 from jellyfish; here the
-  default scheme is **Ed25519** (native-speed via the ``cryptography``
-  package's OpenSSL backend). BLS-BN254 is pairing-heavy native math — the
-  seam lets a C++ implementation drop in without touching callers
-  (SURVEY.md §7 design stance, seam (b)).
+- Two schemes behind the seam:
+  - ``Ed25519Scheme`` — the default (native-speed via the ``cryptography``
+    package's OpenSSL backend); small keys, microsecond verify.
+  - ``BlsBn254Scheme`` — capability parity with the reference's BLS over
+    BN254 from jellyfish (signature.rs:113-175), implemented from scratch
+    in C++ (native/bls_bn254.cpp: Montgomery Fp, the Fp2/Fp6/Fp12 tower,
+    optimal-ate pairing, try-and-increment hash-to-G1) behind a ctypes
+    ABI. Gated on the native library compiling; verification includes the
+    G2 subgroup check.
 """
 
 from __future__ import annotations
@@ -116,6 +120,56 @@ class Ed25519Scheme(SignatureScheme):
             pub.verify(bytes(signature), _namespaced(namespace, message))
             return True
         except (InvalidSignature, ValueError, TypeError):
+            return False
+
+
+class BlsBn254Scheme(SignatureScheme):
+    """BLS over BN254 (alt_bn128), min-sig: 128-byte G2 public keys,
+    64-byte G1 signatures — the reference's production scheme shape
+    (signature.rs:113-175). Backed by the native C++ pairing library;
+    check :func:`available` (or ``pushcdn_tpu.native.bls.available``)
+    before selecting it in a run config."""
+
+    name = "bls-bn254"
+
+    @staticmethod
+    def available() -> bool:
+        from pushcdn_tpu.native import bls
+        return bls.available()
+
+    @classmethod
+    def generate_keypair(cls, seed: int | None = None) -> KeyPair:
+        from pushcdn_tpu.native import bls
+        if seed is None:
+            import os as _os
+            raw = _os.urandom(32)
+        else:
+            raw = hashlib.blake2b(seed.to_bytes(8, "little", signed=False),
+                                  digest_size=32).digest()
+        try:
+            sk, pk = bls.keygen(raw)
+        except (AssertionError, ValueError) as exc:
+            bail(ErrorKind.CRYPTO, "BLS keygen failed", exc)
+        return KeyPair(public_key=pk, private_key=sk)
+
+    @classmethod
+    def sign(cls, private_key: bytes, namespace: Namespace,
+             message: bytes) -> bytes:
+        from pushcdn_tpu.native import bls
+        try:
+            return bls.sign(private_key, _namespaced(namespace, message))
+        except (AssertionError, ValueError) as exc:
+            bail(ErrorKind.CRYPTO, "signing failed", exc)
+
+    @classmethod
+    def verify(cls, public_key: bytes, namespace: Namespace,
+               message: bytes, signature: bytes) -> bool:
+        from pushcdn_tpu.native import bls
+        try:
+            return bls.verify(bytes(public_key),
+                              _namespaced(namespace, message),
+                              bytes(signature))
+        except (AssertionError, TypeError):
             return False
 
 
