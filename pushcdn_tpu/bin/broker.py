@@ -34,6 +34,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--global-memory-pool-size", type=int, default=GIB,
                    help="bytes (default 1 GiB, parity broker.rs:67-72)")
     p.add_argument("--global-permits", action="store_true")
+    p.add_argument("--scheme", default="ed25519",
+                   help="signature scheme: ed25519 | bls-bn254")
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -41,10 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
 async def amain(args: argparse.Namespace) -> None:
     run_def = run_def_from_args(args.broker_transport, args.user_transport,
                                 args.discovery_endpoint, args.num_topics,
-                                args.global_permits)
+                                args.global_permits, scheme=args.scheme)
     broker = await Broker.new(BrokerConfig(
         run_def=run_def,
-        keypair=keypair_from_seed(args.key_seed),
+        keypair=keypair_from_seed(args.key_seed, args.scheme),
         discovery_endpoint=args.discovery_endpoint,
         public_advertise_endpoint=args.public_advertise_endpoint,
         public_bind_endpoint=args.public_bind_endpoint,

@@ -8,7 +8,12 @@ import argparse
 import asyncio
 import logging
 
-from pushcdn_tpu.bin.common import init_logging, keypair_from_seed, transport_by_name
+from pushcdn_tpu.bin.common import (
+    init_logging,
+    keypair_from_seed,
+    scheme_by_name,
+    transport_by_name,
+)
 from pushcdn_tpu.client import Client, ClientConfig
 from pushcdn_tpu.proto.message import Broadcast, Direct
 
@@ -22,6 +27,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--key-seed", type=int, default=None)
     p.add_argument("--topic", type=int, action="append", default=None)
     p.add_argument("--interval", type=float, default=5.0)
+    p.add_argument("--scheme", default="ed25519",
+                   help="signature scheme: ed25519 | bls-bn254")
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -30,9 +37,10 @@ async def amain(args: argparse.Namespace) -> None:
     topics = args.topic if args.topic is not None else [0]
     client = Client(ClientConfig(
         marshal_endpoint=args.marshal_endpoint,
-        keypair=keypair_from_seed(args.key_seed),
+        keypair=keypair_from_seed(args.key_seed, args.scheme),
         protocol=transport_by_name(args.transport),
         subscribed_topics=set(topics),
+        scheme=scheme_by_name(args.scheme),
     ))
     await client.ensure_initialized()
     logger.info("connected; sending every %.1fs on topics %s",

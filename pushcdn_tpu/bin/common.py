@@ -10,7 +10,13 @@ import os
 import sys
 from typing import Optional, Type
 
-from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME, KeyPair
+from pushcdn_tpu.proto.crypto.signature import (
+    DEFAULT_SCHEME,
+    BlsBn254Scheme,
+    Ed25519Scheme,
+    KeyPair,
+    SignatureScheme,
+)
 from pushcdn_tpu.proto.def_ import RunDef, ConnectionDef
 from pushcdn_tpu.proto.discovery.embedded import Embedded
 from pushcdn_tpu.proto.discovery.redis import Redis
@@ -20,6 +26,7 @@ from pushcdn_tpu.proto.transport.base import Protocol
 from pushcdn_tpu.proto.transport.quic import Quic
 
 TRANSPORTS = {"tcp": Tcp, "tcp+tls": TcpTls, "quic": Quic, "memory": Memory}
+SCHEMES = {"ed25519": Ed25519Scheme, "bls-bn254": BlsBn254Scheme}
 
 
 class _JsonFormatter(logging.Formatter):
@@ -53,18 +60,34 @@ def transport_by_name(name: str) -> Type[Protocol]:
             f"unknown transport {name!r}; pick from {sorted(TRANSPORTS)}")
 
 
+def scheme_by_name(name: str) -> Type[SignatureScheme]:
+    try:
+        scheme = SCHEMES[name]
+    except KeyError:
+        raise SystemExit(f"unknown scheme {name!r}; pick from {sorted(SCHEMES)}")
+    if scheme is BlsBn254Scheme and not BlsBn254Scheme.available():
+        raise SystemExit("bls-bn254 requested but the native BLS library "
+                         "failed to compile on this host")
+    return scheme
+
+
 def run_def_from_args(broker_transport: str, user_transport: str,
                       discovery_endpoint: str, num_topics: int,
-                      global_permits: bool = False) -> RunDef:
+                      global_permits: bool = False,
+                      scheme: str = "ed25519") -> RunDef:
     discovery = Redis if discovery_endpoint.startswith("redis://") else Embedded
+    sig = scheme_by_name(scheme)
     return RunDef(
-        broker_def=ConnectionDef(protocol=transport_by_name(broker_transport)),
-        user_def=ConnectionDef(protocol=transport_by_name(user_transport)),
+        broker_def=ConnectionDef(protocol=transport_by_name(broker_transport),
+                                 scheme=sig),
+        user_def=ConnectionDef(protocol=transport_by_name(user_transport),
+                               scheme=sig),
         discovery=discovery,
         topics=TopicSpace.range(num_topics),
         global_permits=global_permits,
     )
 
 
-def keypair_from_seed(seed: Optional[int]) -> KeyPair:
-    return DEFAULT_SCHEME.generate_keypair(seed=seed)
+def keypair_from_seed(seed: Optional[int],
+                      scheme: str = "ed25519") -> KeyPair:
+    return scheme_by_name(scheme).generate_keypair(seed=seed)

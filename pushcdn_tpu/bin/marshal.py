@@ -22,6 +22,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--global-memory-pool-size", type=int,
                    default=1024 * 1024 * 1024)
     p.add_argument("--global-permits", action="store_true")
+    p.add_argument("--scheme", default="ed25519",
+                   help="signature scheme: ed25519 | bls-bn254")
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -29,7 +31,7 @@ def build_parser() -> argparse.ArgumentParser:
 async def amain(args: argparse.Namespace) -> None:
     run_def = run_def_from_args("tcp", args.user_transport,
                                 args.discovery_endpoint, args.num_topics,
-                                args.global_permits)
+                                args.global_permits, scheme=args.scheme)
     marshal = await Marshal.new(MarshalConfig(
         run_def=run_def,
         discovery_endpoint=args.discovery_endpoint,

@@ -71,11 +71,15 @@ class RunDef:
 
 
 def production_run_def(topics: Optional[TopicSpace] = None) -> RunDef:
-    """Parity ``ProductionRunDef`` (def.rs:101-136): broker↔broker plain
-    TCP, user↔broker TCP+TLS, Redis/KeyDB discovery."""
+    """Parity ``ProductionRunDef`` (def.rs:101-136): BLS-over-BN254 keys,
+    broker↔broker plain TCP, user↔broker TCP+TLS, Redis/KeyDB discovery.
+    Falls back to Ed25519 if the native BLS library can't compile on this
+    host (the seam keeps callers agnostic)."""
+    from pushcdn_tpu.proto.crypto.signature import BlsBn254Scheme
+    scheme = BlsBn254Scheme if BlsBn254Scheme.available() else DEFAULT_SCHEME
     return RunDef(
-        broker_def=ConnectionDef(protocol=Tcp),
-        user_def=ConnectionDef(protocol=TcpTls),
+        broker_def=ConnectionDef(protocol=Tcp, scheme=scheme),
+        user_def=ConnectionDef(protocol=TcpTls, scheme=scheme),
         discovery=Redis,
         topics=topics or TopicSpace.range(256),
     )

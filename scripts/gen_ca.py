@@ -1,0 +1,37 @@
+"""Generate a CA certificate + key pair on disk.
+
+Parity with the reference's scripts/gen-ca.bash: multi-process deployments
+over TCP+TLS need every broker/marshal to present leaf certs derived from
+the SAME CA (a process-local auto-generated CA only works single-process).
+Run this once per deployment and pass the paths via --ca-cert-path /
+--ca-key-path to every binary.
+
+Usage: python scripts/gen_ca.py [outdir]    (default ./ca)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pushcdn_tpu.proto.crypto.tls import _generate_ca  # noqa: E402
+
+
+def main() -> None:
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "ca"
+    os.makedirs(outdir, exist_ok=True)
+    cert_pem, key_pem = _generate_ca()
+    cert_path = os.path.join(outdir, "ca_cert.pem")
+    key_path = os.path.join(outdir, "ca_key.pem")
+    with open(cert_path, "wb") as f:
+        f.write(cert_pem)
+    with open(key_path, "wb") as f:
+        f.write(key_pem)
+    os.chmod(key_path, 0o600)
+    print(f"wrote {cert_path} and {key_path}")
+    print("pass --ca-cert-path/--ca-key-path to pushcdn-broker and "
+          "pushcdn-marshal")
+
+
+if __name__ == "__main__":
+    main()
