@@ -41,9 +41,10 @@ import numpy as np
 
 from pushcdn_tpu.broker.tasks.senders import try_send_to_user_nowait
 from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
-from pushcdn_tpu.parallel.frames import FrameRing, UserSlots
+from pushcdn_tpu.parallel.frames import DirectBuckets, FrameRing, UserSlots
 from pushcdn_tpu.parallel.router import (
     BROKER_AXIS,
+    DirectIngress,
     IngressBatch,
     RouterState,
     make_mesh_routing_step,
@@ -61,7 +62,8 @@ logger = logging.getLogger("pushcdn.broker.meshgroup")
 @dataclass
 class MeshGroupConfig:
     num_user_slots: int = 1024
-    ring_slots: int = 256          # per shard per step
+    ring_slots: int = 256          # per shard per step (broadcast all_gather)
+    direct_bucket_slots: int = 64  # per shard per DESTINATION per step
     frame_bytes: int = 2048
     batch_window_s: float = 0.001
 
@@ -126,10 +128,17 @@ class MeshBrokerGroup:
         self.config = config or MeshGroupConfig()
         c = self.config
         self.num_shards = mesh.devices.size
-        self.step_fn = make_mesh_routing_step(mesh)
+        self.step_fn = make_mesh_routing_step(mesh, with_direct=True)
         self.brokers: List[Optional["Broker"]] = [None] * self.num_shards
         self.rings = [FrameRing(slots=c.ring_slots, frame_bytes=c.frame_bytes)
                       for _ in range(self.num_shards)]
+        # direct frames go into per-destination-shard buckets and cross the
+        # mesh with one all_to_all (router.DirectIngress) instead of riding
+        # the broadcast all_gather to every shard
+        self.direct_buckets = [
+            DirectBuckets(self.num_shards, capacity=c.direct_bucket_slots,
+                          frame_bytes=c.frame_bytes)
+            for _ in range(self.num_shards)]
         # global user table + mirrors (single source of truth)
         self.slots = UserSlots(c.num_user_slots)
         self._owner = np.full(c.num_user_slots, ABSENT, np.int32)
@@ -176,8 +185,9 @@ class MeshBrokerGroup:
 
     def _warmup(self) -> None:
         batches = [r.take_batch() for r in self.rings]  # empty, right shapes
+        directs = [b.take_batch() for b in self.direct_buckets]
         try:
-            self._run_step(batches, self._owner.copy(),
+            self._run_step(batches, directs, self._owner.copy(),
                            self._claim_version.copy(), self._masks.copy())
             self.steps -= 1  # warmup doesn't count
         except Exception:
@@ -284,7 +294,11 @@ class MeshBrokerGroup:
             if slot is None:
                 # outside the group: legitimately the host path's job
                 return self._overflow()
-            ok = ring.push_direct(frame, slot)
+            owner = int(self._owner[slot])
+            if owner == ABSENT:
+                return self._overflow()
+            # one-hop ICI path: bucket by owner shard for the all_to_all
+            ok = self.direct_buckets[shard].push(owner, frame, slot)
         else:
             return StageResult.INELIGIBLE
         if ok:
@@ -299,18 +313,23 @@ class MeshBrokerGroup:
             await self._kick.wait()
             self._kick.clear()
             await asyncio.sleep(self.config.batch_window_s)
-            if all(r.free_slots == r.slots for r in self.rings):
+            if all(r.free_slots == r.slots for r in self.rings) and \
+                    all(b.total_used == 0 for b in self.direct_buckets):
                 continue
-            # one-tick snapshot: all rings + mirrors together
+            # one-tick snapshot: all rings + buckets + mirrors together
             batches = [r.take_batch() for r in self.rings]
+            directs = [b.take_batch() for b in self.direct_buckets]
             owner = self._owner.copy()
             versions = self._claim_version.copy()
             masks = self._masks.copy()
             quarantined, self._quarantine = self._quarantine, []
             try:
-                deliver, lengths, frames = await asyncio.to_thread(
-                    self._run_step, batches, owner, versions, masks)
+                result = await asyncio.to_thread(
+                    self._run_step, batches, directs, owner, versions, masks)
+                (deliver, lengths, frames,
+                 d_deliver, d_lengths, d_frames) = result
                 self._egress(deliver, lengths, frames)
+                self._egress(d_deliver, d_lengths, d_frames)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -322,14 +341,17 @@ class MeshBrokerGroup:
                 # ran in the worker thread sit in the fresh rings — drain
                 # them too, or they'd be lost with no fallback
                 late = [r.take_batch() for r in self.rings]
+                late_d = [b.take_batch() for b in self.direct_buckets]
                 await self._host_fallback(batches)
                 await self._host_fallback(late)
+                await self._host_fallback_direct(directs)
+                await self._host_fallback_direct(late_d)
                 return
             finally:
                 for slot in quarantined:
                     self.slots.free_slot(slot)
 
-    def _run_step(self, batches, owner, versions, masks):
+    def _run_step(self, batches, directs, owner, versions, masks):
         """Blocking multi-shard device step (worker thread)."""
         import jax.numpy as jnp
         B = self.num_shards
@@ -351,11 +373,19 @@ class MeshBrokerGroup:
             jnp.asarray(np.stack([b.topic_mask for b in batches])),
             jnp.asarray(np.stack([b.dest for b in batches])),
             jnp.asarray(np.stack([b.valid for b in batches])))
-        result = self.step_fn(state, batch)
+        direct = DirectIngress(
+            jnp.asarray(np.stack([d.bytes_ for d in directs])),
+            jnp.asarray(np.stack([d.length for d in directs])),
+            jnp.asarray(np.stack([d.dest for d in directs])),
+            jnp.asarray(np.stack([d.valid for d in directs])))
+        result = self.step_fn(state, batch, direct)
         self.steps += 1
         return (np.asarray(result.deliver),          # [B, U, B*S]
                 np.asarray(result.gathered_length),  # [B, B*S]
-                np.asarray(result.gathered_bytes))   # [B, B*S, F]
+                np.asarray(result.gathered_bytes),   # [B, B*S, F]
+                np.asarray(result.direct_deliver),   # [B, U, B*C]
+                np.asarray(result.direct_length),    # [B, B*C]
+                np.asarray(result.direct_bytes))     # [B, B*C, F]
 
     def _egress(self, deliver, lengths, frames) -> None:
         for shard in range(self.num_shards):
@@ -413,6 +443,29 @@ class MeshBrokerGroup:
                             broker, list(message.topics), raw,
                             to_users_only=False,
                             exclude_brokers=out_of_group)
+                except Error:
+                    pass
+                finally:
+                    raw.release()
+
+    async def _host_fallback_direct(self, directs) -> None:
+        """Re-route staged direct-bucket frames over the host plane (the
+        recipient is in the wire frame; bucket geometry doesn't matter)."""
+        from pushcdn_tpu.broker.tasks.handlers import handle_direct_message
+        from pushcdn_tpu.proto.message import deserialize
+        for shard, d in enumerate(directs):
+            broker = self.brokers[shard]
+            if broker is None:
+                continue
+            dests, idx = np.nonzero(d.valid)
+            for b_dest, i in zip(dests.tolist(), idx.tolist()):
+                raw = Bytes(d.bytes_[b_dest, i, :d.length[b_dest, i]].tobytes())
+                try:
+                    message = deserialize(raw.data)
+                    if isinstance(message, Direct):
+                        await handle_direct_message(
+                            broker, bytes(message.recipient), raw,
+                            to_user_only=False)
                 except Error:
                     pass
                 finally:

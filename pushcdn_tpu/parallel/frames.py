@@ -236,6 +236,82 @@ class FrameRing:
         return batch
 
 
+@dataclass
+class DirectBatch:
+    """One step of per-destination-shard direct frames (axis 0 indexes the
+    DESTINATION shard). The router exchanges these with one ``all_to_all``
+    over the broker axis — each frame crosses ICI exactly once, to its
+    owner, instead of riding the broadcast ``all_gather`` to every shard
+    (SURVEY.md §2e: direct routing = point-to-point collective keyed by
+    owner-device index)."""
+
+    bytes_: np.ndarray   # uint8[B, C, F]
+    length: np.ndarray   # int32[B, C]
+    dest: np.ndarray     # int32[B, C] — user slot at the destination shard
+    valid: np.ndarray    # bool[B, C]
+
+
+class DirectBuckets:
+    """Host staging for direct frames, bucketed by owner shard. The host
+    knows the owner at staging time (the group's slot table), so bucketing
+    costs a list-append — no device-side sort. A full bucket is per-LINK
+    backpressure (only senders targeting that shard stall), the analog of
+    the reference's per-connection bounded channels."""
+
+    def __init__(self, num_shards: int, capacity: int = 64,
+                 frame_bytes: int = DEFAULT_FRAME_BYTES):
+        self.num_shards = num_shards
+        self.capacity = capacity
+        self.frame_bytes = frame_bytes
+        self._bytes = np.zeros((num_shards, capacity, frame_bytes), np.uint8)
+        self._length = np.zeros((num_shards, capacity), np.int32)
+        self._dest = np.full((num_shards, capacity), -1, np.int32)
+        self._valid = np.zeros((num_shards, capacity), bool)
+        self._used = np.zeros(num_shards, np.int64)
+
+    @property
+    def total_used(self) -> int:
+        return int(self._used.sum())
+
+    def push(self, dest_shard: int, payload: bytes, dest_slot: int) -> bool:
+        if len(payload) > self.frame_bytes:
+            bail(ErrorKind.EXCEEDED_SIZE,
+                 f"payload {len(payload)} B exceeds frame slot "
+                 f"{self.frame_bytes} B; use the host path")
+        i = int(self._used[dest_shard])
+        if i >= self.capacity:
+            return False  # this link is backpressured
+        n = len(payload)
+        self._bytes[dest_shard, i, :n] = np.frombuffer(payload, np.uint8)
+        if n < self.frame_bytes:
+            self._bytes[dest_shard, i, n:] = 0
+        self._length[dest_shard, i] = n
+        self._dest[dest_shard, i] = dest_slot
+        self._valid[dest_shard, i] = True
+        self._used[dest_shard] = i + 1
+        return True
+
+    def take_batch(self) -> DirectBatch:
+        batch = DirectBatch(
+            bytes_=self._bytes.copy(), length=self._length.copy(),
+            dest=self._dest.copy(), valid=self._valid.copy())
+        self._valid[:] = False
+        self._length[:] = 0
+        self._dest[:] = -1
+        self._used[:] = 0
+        return batch
+
+
+def empty_direct_batch(num_shards: int, capacity: int,
+                       frame_bytes: int) -> DirectBatch:
+    return DirectBatch(
+        bytes_=np.zeros((num_shards, capacity, frame_bytes), np.uint8),
+        length=np.zeros((num_shards, capacity), np.int32),
+        dest=np.full((num_shards, capacity), -1, np.int32),
+        valid=np.zeros((num_shards, capacity), bool),
+    )
+
+
 def empty_batch(slots: int, frame_bytes: int) -> FrameBatch:
     return FrameBatch(
         bytes_=np.zeros((slots, frame_bytes), np.uint8),

@@ -68,12 +68,29 @@ class IngressBatch(NamedTuple):
     valid: jax.Array        # bool[S]
 
 
+class DirectIngress(NamedTuple):
+    """Per-destination-shard direct frames (see frames.DirectBuckets):
+    axis 0 = destination shard. Exchanged with ONE ``all_to_all`` over the
+    broker axis — each direct frame crosses ICI exactly once, to its owner
+    (SURVEY.md §2e: the point-to-point collective keyed by owner-device
+    index), instead of being all-gathered to every shard."""
+
+    frame_bytes: jax.Array  # uint8[B, C, F]
+    length: jax.Array       # int32[B, C]
+    dest: jax.Array         # int32[B, C]
+    valid: jax.Array        # bool[B, C]
+
+
 class RouteResult(NamedTuple):
     gathered_bytes: jax.Array   # uint8[B*S, F] — every frame, post-ICI
     gathered_length: jax.Array  # int32[B*S]
     deliver: jax.Array          # bool[U, B*S] — local delivery matrix
     state: RouterState          # merged CRDT + masks
     evictions: jax.Array        # bool[U] — locally-owned users now owned elsewhere
+    # all_to_all direct path (None when no DirectIngress was passed):
+    direct_bytes: Optional[jax.Array] = None    # uint8[B*C, F] — received frames
+    direct_length: Optional[jax.Array] = None   # int32[B*C]
+    direct_deliver: Optional[jax.Array] = None  # bool[U, B*C]
 
 
 def empty_router_state(num_users: int) -> RouterState:
@@ -83,8 +100,37 @@ def empty_router_state(num_users: int) -> RouterState:
     )
 
 
+def _direct_route(direct: DirectIngress, now_local: jax.Array,
+                  axis_name: Optional[str]):
+    """Exchange per-destination buckets and build the local delivery mask.
+
+    ``all_to_all`` swaps the destination-shard axis for a source-shard
+    axis: received[j] = what shard j staged for *this* shard. Delivery is
+    iff the addressed slot is locally owned — ownership moves race exactly
+    like the reference's forward-to-old-owner during CRDT convergence, and
+    resolve the same way (deliver-iff-owner, never re-forward)."""
+    if axis_name is None:
+        r_bytes, r_length, r_dest, r_valid = (
+            direct.frame_bytes, direct.length, direct.dest, direct.valid)
+    else:
+        r_bytes = jax.lax.all_to_all(direct.frame_bytes, axis_name, 0, 0)
+        r_length = jax.lax.all_to_all(direct.length, axis_name, 0, 0)
+        r_dest = jax.lax.all_to_all(direct.dest, axis_name, 0, 0)
+        r_valid = jax.lax.all_to_all(direct.valid, axis_name, 0, 0)
+    B, C = r_dest.shape
+    dest_f = r_dest.reshape(B * C)
+    valid_f = r_valid.reshape(B * C)
+    U = now_local.shape[0]
+    slots = jnp.arange(U, dtype=jnp.int32)
+    deliver = (valid_f[None, :]
+               & (dest_f[None, :] == slots[:, None])
+               & now_local[:, None])
+    return r_bytes.reshape(B * C, -1), r_length.reshape(B * C), deliver
+
+
 def routing_step(state: RouterState, batch: IngressBatch,
-                 my_index: jax.Array, axis_name: Optional[str]
+                 my_index: jax.Array, axis_name: Optional[str],
+                 direct: Optional[DirectIngress] = None
                  ) -> RouteResult:
     """One routing step for one broker shard.
 
@@ -130,12 +176,21 @@ def routing_step(state: RouterState, batch: IngressBatch,
     deliver = delivery_matrix(masks, now_local, tmask_f, kind_f, dest_f,
                               use_pallas=USE_PALLAS_DELIVERY)
 
+    # ---- 4. the one-hop direct path: all_to_all by owner shard -----------
+    d_bytes = d_length = d_deliver = None
+    if direct is not None:
+        d_bytes, d_length, d_deliver = _direct_route(
+            direct, now_local, axis_name)
+
     return RouteResult(
         gathered_bytes=g_bytes.reshape(B * S, -1),
         gathered_length=g_length.reshape(B * S),
         deliver=deliver,
         state=RouterState(crdt=merged, topic_masks=masks),
         evictions=evictions,
+        direct_bytes=d_bytes,
+        direct_length=d_length,
+        direct_deliver=d_deliver,
     )
 
 
@@ -150,37 +205,56 @@ def routing_step_single(state: RouterState, batch: IngressBatch
     return routing_step(state, batch, jnp.int32(0), axis_name=None)
 
 
-def make_mesh_routing_step(mesh: Mesh):
+def make_mesh_routing_step(mesh: Mesh, with_direct: bool = False):
     """Build the multi-chip step: state+batch sharded over the broker axis,
     one jitted shard_map program (SURVEY.md §7 stage 7: broker shards ↔
-    devices of a jax mesh)."""
+    devices of a jax mesh). With ``with_direct`` the step also takes
+    stacked :class:`DirectIngress` buckets ([B_src, B_dest, C, F]) and runs
+    the one-hop ``all_to_all`` direct path inside the same program."""
 
-    def per_shard(state_leaves, batch_leaves):
+    def per_shard(state_leaves, batch_leaves, *direct_leaves):
         state = RouterState(CrdtState(*state_leaves[:3]), state_leaves[3])
         batch = IngressBatch(*batch_leaves)
         # shard_map gives each shard its [1, ...] block; drop the outer axis
         state = jax.tree.map(lambda x: x[0], state)
         batch = jax.tree.map(lambda x: x[0], batch)
+        direct = None
+        if direct_leaves:
+            direct = DirectIngress(*(x[0] for x in direct_leaves[0]))
         my = jax.lax.axis_index(BROKER_AXIS).astype(jnp.int32)
-        result = routing_step(state, batch, my, axis_name=BROKER_AXIS)
+        result = routing_step(state, batch, my, axis_name=BROKER_AXIS,
+                              direct=direct)
         # re-add the sharded leading axis for the outputs
         return jax.tree.map(lambda x: x[None], tuple(result))
 
+    n_in = 3 if with_direct else 2
     sharded = jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(BROKER_AXIS), P(BROKER_AXIS)),
+        in_specs=tuple(P(BROKER_AXIS) for _ in range(n_in)),
         out_specs=P(BROKER_AXIS),
         check_vma=False,
     )
 
-    @jax.jit
-    def step(state_stacked: RouterState, batch_stacked: IngressBatch):
-        """``state_stacked``/``batch_stacked`` carry a leading [B] axis
-        sharded over the mesh; returns a stacked RouteResult."""
-        out = sharded(tuple((*state_stacked.crdt, state_stacked.topic_masks)),
-                      tuple(batch_stacked))
+    def _unpack(out):
         return RouteResult(
             gathered_bytes=out[0], gathered_length=out[1], deliver=out[2],
-            state=out[3], evictions=out[4])
+            state=out[3], evictions=out[4],
+            direct_bytes=out[5], direct_length=out[6], direct_deliver=out[7])
+
+    if with_direct:
+        @jax.jit
+        def step(state_stacked: RouterState, batch_stacked: IngressBatch,
+                 direct_stacked: DirectIngress):
+            out = sharded(
+                tuple((*state_stacked.crdt, state_stacked.topic_masks)),
+                tuple(batch_stacked), tuple(direct_stacked))
+            return _unpack(out)
+    else:
+        @jax.jit
+        def step(state_stacked: RouterState, batch_stacked: IngressBatch):
+            out = sharded(
+                tuple((*state_stacked.crdt, state_stacked.topic_masks)),
+                tuple(batch_stacked))
+            return _unpack(out)
 
     return step

@@ -212,3 +212,72 @@ def test_pallas_kernel_matches_reference():
     pal = delivery_matrix_pallas(user_masks, local, tmask, kind, dest,
                                  interpret=True)
     np.testing.assert_array_equal(np.asarray(pal), np.asarray(ref))
+
+
+def test_mesh_direct_all_to_all():
+    """The one-hop direct path: frames staged into per-destination-shard
+    buckets cross the mesh with ONE all_to_all and deliver only at the
+    owner (SURVEY.md §2e: point-to-point collective keyed by owner shard),
+    never riding the broadcast all_gather."""
+    from pushcdn_tpu.parallel.frames import DirectBuckets
+    from pushcdn_tpu.parallel.router import DirectIngress
+
+    mesh = make_broker_mesh()
+    B = mesh.devices.size
+    C = 4
+    step = make_mesh_routing_step(mesh, with_direct=True)
+
+    # shard i owns user slot i, topic mask irrelevant here
+    owners = np.full((B, U), ABSENT, np.int32)
+    versions = np.zeros((B, U), np.uint32)
+    ids = np.full((B, U), ABSENT, np.int32)
+    masks = np.zeros((B, U), np.uint32)
+    for i in range(B):
+        owners[i, i] = i; versions[i, i] = 1; ids[i, i] = i
+    state = RouterState(
+        CrdtState(jnp.asarray(owners), jnp.asarray(versions), jnp.asarray(ids)),
+        jnp.asarray(masks))
+
+    # empty broadcast ingress; shard 2 sends directs to users 5 and 7
+    # (owned by shards 5 and 7), shard 6 sends to user 0
+    parts = [FrameRing(slots=S, frame_bytes=F).take_batch() for _ in range(B)]
+    batch = IngressBatch(
+        jnp.asarray(np.stack([x.bytes_ for x in parts])),
+        jnp.asarray(np.stack([x.kind for x in parts])),
+        jnp.asarray(np.stack([x.length for x in parts])),
+        jnp.asarray(np.stack([x.topic_mask for x in parts]).astype(np.uint32)),
+        jnp.asarray(np.stack([x.dest for x in parts])),
+        jnp.asarray(np.stack([x.valid for x in parts])))
+
+    buckets = [DirectBuckets(B, capacity=C, frame_bytes=F) for _ in range(B)]
+    assert buckets[2].push(5, b"to user 5", dest_slot=5)
+    assert buckets[2].push(7, b"to user 7", dest_slot=7)
+    assert buckets[6].push(0, b"to user 0", dest_slot=0)
+    parts_d = [b.take_batch() for b in buckets]
+    direct = DirectIngress(
+        jnp.asarray(np.stack([x.bytes_ for x in parts_d])),
+        jnp.asarray(np.stack([x.length for x in parts_d])),
+        jnp.asarray(np.stack([x.dest for x in parts_d])),
+        jnp.asarray(np.stack([x.valid for x in parts_d])))
+
+    out = step(state, batch, direct)
+    assert np.asarray(out.deliver).sum() == 0       # nothing on the broadcast path
+    dd = np.asarray(out.direct_deliver)             # [B, U, B*C]
+    db = np.asarray(out.direct_bytes)               # [B, B*C, F]
+    dl = np.asarray(out.direct_length)
+    # exactly the three deliveries, each at its owner shard only
+    assert dd.sum() == 3
+    for shard, user, payload in [(5, 5, b"to user 5"), (7, 7, b"to user 7"),
+                                 (0, 0, b"to user 0")]:
+        hits = np.nonzero(dd[shard, user])[0]
+        assert len(hits) == 1, (shard, user, hits)
+        f = hits[0]
+        assert db[shard, f, :dl[shard, f]].tobytes() == payload
+        # no other shard delivers this frame
+        assert dd[:, user].sum() == 1
+
+    # bucket overflow is per-link backpressure
+    small = DirectBuckets(B, capacity=1, frame_bytes=F)
+    assert small.push(3, b"x", 3)
+    assert not small.push(3, b"y", 3)   # that link is full
+    assert small.push(4, b"z", 4)       # other links unaffected
