@@ -40,7 +40,7 @@ from pushcdn_tpu.broker.tasks.heartbeat import heartbeat_once
 from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME
 from pushcdn_tpu.proto.def_ import testing_run_def
 from pushcdn_tpu.proto.message import Broadcast, Direct
-from pushcdn_tpu.proto.transport import Memory, Tcp
+from pushcdn_tpu.proto.transport import Memory, Quic, Tcp
 from pushcdn_tpu.proto.transport.memory import gen_testing_connection_pair
 
 RESULTS: list[dict] = []
@@ -232,6 +232,11 @@ async def amain(quick: bool):
     for size in sizes:
         await bench_transport(Tcp, "127.0.0.1:0", size,
                               min(budget, max(10 * size, floor)))
+    for size in sizes:
+        # QUIC-class UDP: parity with protocols.rs QUIC bench shapes; the
+        # ARQ window bounds throughput on the biggest frames
+        await bench_transport(Quic, "127.0.0.1:0", size,
+                              min(budget // 4, max(4 * size, floor // 2)))
     await bench_routing(iters=100 if quick else 500)
     await bench_e2e_echo(iters=200 if quick else 1000)
 
