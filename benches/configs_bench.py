@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""BASELINE.json configs[1..3] benches on the in-process cluster fixture.
+
+Covers the three driver configs between the loopback echo (configs[0],
+measured in ``host_bench.py``) and the HotShot replay (configs[4],
+``consensus_replay.py``):
+
+- configs[1]: 2-broker broadcast fan-out, 8 subscribed clients, BLS auth
+  (falls back to Ed25519 when the native pairing library is unavailable —
+  the emitted row records which scheme ran);
+- configs[2]: topic pub/sub, 4 topics x 64 subscribers, mixed broadcast +
+  direct traffic;
+- configs[3]: marshal-coordinated 8-broker mesh, clients load-balanced
+  2-per-broker, full-mesh broadcast fan-out.
+
+Like the reference's whole-system tests (tests/src/tests/mod.rs:62-143)
+everything runs in one process over the Memory transport + shared SQLite
+discovery, so numbers are routing-stack numbers, not NIC numbers.
+
+Usage: python benches/configs_bench.py [--quick]
+Prints one JSON object per bench line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pushcdn_tpu.proto.crypto.signature import (
+    BlsBn254Scheme,
+    DEFAULT_SCHEME,
+)
+from pushcdn_tpu.proto.topic import TopicSpace
+from pushcdn_tpu.testing import Cluster, wait_until
+
+RESULTS: list[dict] = []
+
+
+def emit(name: str, value: float, unit: str, **extra) -> None:
+    row = {"bench": name, "value": round(value, 3), "unit": unit, **extra}
+    RESULTS.append(row)
+    print(json.dumps(row), flush=True)
+
+
+def _p99(samples):
+    import math
+    return round(sorted(samples)[math.ceil(len(samples) * 0.99) - 1], 1)
+
+
+async def _drain(client, n: int):
+    """Receive exactly ``n`` messages on ``client``."""
+    for _ in range(n):
+        await asyncio.wait_for(client.receive_message(), 30)
+
+
+async def _wait_mesh_interest(cluster, topic: int, links: int,
+                              timeout: float = 60.0):
+    """Wait until every broker holds ``links`` mesh links AND sees all of
+    them as interested in ``topic`` (full interest propagation). BLS
+    broker↔broker auth takes hundreds of ms, so this must be explicit —
+    messages sent before a link exists are simply not forwarded (sender.rs
+    failure-is-removal semantics)."""
+    await wait_until(
+        lambda: all(b.connections.num_brokers == links
+                    for b in cluster.brokers), timeout)
+    await wait_until(
+        lambda: all(
+            len(b.connections.get_interested_by_topic([topic], False)[1])
+            == links
+            for b in cluster.brokers), timeout)
+
+
+async def _connect_all(clients, concurrency: int = 32):
+    """Authenticate clients through the marshal, bounded concurrency;
+    returns per-client connect latencies (seconds)."""
+    sem = asyncio.Semaphore(concurrency)
+    lat = [0.0] * len(clients)
+
+    async def one(i, c):
+        async with sem:
+            t0 = time.perf_counter()
+            await c.ensure_initialized()
+            lat[i] = time.perf_counter() - t0
+
+    await asyncio.gather(*(one(i, c) for i, c in enumerate(clients)))
+    return lat
+
+
+# ---------------------------------------------------------------------------
+# configs[1]: 2-broker fan-out, 8 subscribed clients, BLS auth
+# ---------------------------------------------------------------------------
+
+async def bench_two_broker_fanout(msgs: int):
+    scheme = BlsBn254Scheme if BlsBn254Scheme.available() else DEFAULT_SCHEME
+    cluster = await Cluster(num_brokers=2, scheme=scheme).start()
+    try:
+        clients = []
+        auth_lat = []
+        for i in range(8):
+            await cluster.place_on(i % 2)  # 4 clients per broker
+            c = cluster.client(seed=100 + i, topics=[0])
+            t0 = time.perf_counter()
+            await c.ensure_initialized()
+            auth_lat.append((time.perf_counter() - t0) * 1e3)
+            clients.append(c)
+        await wait_until(
+            lambda: sum(b.connections.num_users for b in cluster.brokers) == 8)
+        await _wait_mesh_interest(cluster, topic=0, links=1)
+
+        emit("configs1/auth_handshake", statistics.median(auth_lat),
+             "ms_median", scheme=scheme.name, p99=_p99(auth_lat))
+
+        payload = os.urandom(1024)
+        publisher = clients[0]
+        receivers = clients  # all 8 subscribe to topic 0, sender included
+
+        t0 = time.perf_counter()
+        drains = [asyncio.create_task(_drain(c, msgs)) for c in receivers]
+        for _ in range(msgs):
+            await publisher.send_broadcast_message([0], payload)
+        await asyncio.gather(*drains)
+        dt = time.perf_counter() - t0
+        emit("configs1/broadcast_fanout", msgs * len(receivers) / dt,
+             "deliveries/s", scheme=scheme.name, msgs=msgs,
+             publish_rate=round(msgs / dt, 1), frame=1024)
+        for c in clients:
+            c.close()
+    finally:
+        await cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# configs[2]: 4 topics x 64 subscribers, mixed broadcast + direct
+# ---------------------------------------------------------------------------
+
+async def bench_topic_pubsub(per_topic: int, rounds: int):
+    topics = list(range(4))
+    cluster = await Cluster(num_brokers=1,
+                            topics=TopicSpace.range(8)).start()
+    try:
+        clients = []
+        for t in topics:
+            for j in range(per_topic):
+                clients.append(cluster.client(seed=1000 + t * per_topic + j,
+                                              topics=[t]))
+        await _connect_all(clients)
+        await wait_until(
+            lambda: cluster.brokers[0].connections.num_users == len(clients),
+            timeout=30)
+
+        payload = os.urandom(1024)
+        publishers = [clients[t * per_topic] for t in topics]
+        # each round: 4 broadcasts (one per topic) + 4 directs to a peer on
+        # another topic -> deliveries = 4*per_topic + 4 per round
+        per_round = 4 * per_topic + 4
+
+        async def recv_counts(c, t_idx):
+            # subscriber on topic t receives `rounds` broadcasts; the 4
+            # direct targets get `rounds` more each
+            n = rounds
+            if c in direct_targets:
+                n += rounds
+            await _drain(c, n)
+
+        direct_targets = [clients[((t + 1) % 4) * per_topic + 1]
+                          for t in topics]
+        t0 = time.perf_counter()
+        drains = [asyncio.create_task(recv_counts(c, i // per_topic))
+                  for i, c in enumerate(clients)]
+        for _ in range(rounds):
+            for t, pub in enumerate(publishers):
+                await pub.send_broadcast_message([t], payload)
+                await pub.send_direct_message(
+                    direct_targets[t].public_key, payload)
+        await asyncio.gather(*drains)
+        dt = time.perf_counter() - t0
+        emit("configs2/topic_pubsub_mixed", rounds * per_round / dt,
+             "deliveries/s", subscribers=len(clients), topics=4,
+             per_topic=per_topic, rounds=rounds, frame=1024)
+        for c in clients:
+            c.close()
+    finally:
+        await cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# configs[3]: marshal-coordinated 8-broker mesh
+# ---------------------------------------------------------------------------
+
+async def bench_eight_broker_mesh(msgs: int):
+    cluster = await Cluster(num_brokers=8).start()
+    try:
+        # every broker dialed every peer (dedup rule: dial iff peer id >= own);
+        # wait for formation before sampling — 28 mutual handshakes in flight
+        await wait_until(
+            lambda: all(b.connections.num_brokers == 7
+                        for b in cluster.brokers), timeout=60)
+        links = [b.connections.num_brokers for b in cluster.brokers]
+        emit("configs3/mesh_links", sum(links) / len(links), "links/broker",
+             expect=7.0, per_broker=links)
+
+        clients = []
+        for i in range(16):
+            await cluster.place_on(i % 8)  # 2 clients per broker
+            c = cluster.client(seed=2000 + i, topics=[0])
+            await c.ensure_initialized()
+            clients.append(c)
+        await wait_until(
+            lambda: sum(b.connections.num_users for b in cluster.brokers) == 16,
+            timeout=30)
+        await _wait_mesh_interest(cluster, topic=0, links=7)
+
+        payload = os.urandom(1024)
+        publisher = clients[0]
+
+        # latency: sequential rounds, send -> all 16 received
+        lat = []
+        for _ in range(min(100, msgs)):
+            t0 = time.perf_counter()
+            await publisher.send_broadcast_message([0], payload)
+            await asyncio.gather(*(
+                asyncio.wait_for(c.receive_message(), 30) for c in clients))
+            lat.append((time.perf_counter() - t0) * 1e6)
+        emit("configs3/mesh_broadcast_latency", statistics.median(lat),
+             "us_median", p99=_p99(lat), receivers=16, brokers=8)
+
+        # throughput: pipelined
+        t0 = time.perf_counter()
+        drains = [asyncio.create_task(_drain(c, msgs)) for c in clients]
+        for _ in range(msgs):
+            await publisher.send_broadcast_message([0], payload)
+        await asyncio.gather(*drains)
+        dt = time.perf_counter() - t0
+        emit("configs3/mesh_broadcast_fanout", msgs * 16 / dt,
+             "deliveries/s", msgs=msgs, brokers=8,
+             publish_rate=round(msgs / dt, 1), frame=1024)
+        for c in clients:
+            c.close()
+    finally:
+        await cluster.stop()
+
+
+async def amain(quick: bool):
+    await bench_two_broker_fanout(msgs=100 if quick else 500)
+    await bench_topic_pubsub(per_topic=16 if quick else 64,
+                             rounds=20 if quick else 100)
+    await bench_eight_broker_mesh(msgs=100 if quick else 400)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    asyncio.run(amain(args.quick))
+
+
+if __name__ == "__main__":
+    main()

@@ -15,9 +15,11 @@ Capability parity with cdn-proto/src/connection/auth/broker.rs:36-301:
   deadlock.
 
 Wire note: ``AuthenticateWithKey.public_key`` is an opaque byte field; for
-broker↔broker auth it carries ``raw_public_key(32 B) || identity_utf8`` so
-the peer learns which broker connected, and the signature covers
-``timestamp || identity`` to bind the claimed identity.
+broker↔broker auth it carries ``u16 key_len || raw_public_key || identity_utf8``
+so the peer learns which broker connected (the length prefix keeps the
+split scheme-agnostic: Ed25519 keys are 32 B, BLS-BN254 keys 128 B), and
+the signature covers ``timestamp || identity`` to bind the claimed
+identity.
 """
 
 from __future__ import annotations
@@ -38,7 +40,7 @@ from pushcdn_tpu.proto.message import (
 from pushcdn_tpu.proto.transport.base import Connection
 
 _TS = struct.Struct("<Q")
-_RAW_KEY_LEN = 32  # ed25519 raw public key length
+_KEY_LEN = struct.Struct("<H")  # scheme-agnostic key-length prefix
 
 TIMESTAMP_TOLERANCE_S = 5
 
@@ -91,7 +93,8 @@ async def _send_auth(connection: Connection, scheme: Type[SignatureScheme],
     signature = scheme.sign(keypair.private_key, Namespace.BROKER_BROKER_AUTH,
                             _broker_signable(timestamp, ident))
     await connection.send_message(AuthenticateWithKey(
-        public_key=keypair.public_key + ident.encode("utf-8"),
+        public_key=_KEY_LEN.pack(len(keypair.public_key))
+        + keypair.public_key + ident.encode("utf-8"),
         timestamp=timestamp, signature=signature), flush=True)
     response = await connection.recv_message()
     if not isinstance(response, AuthenticateResponse) or response.permit != 1:
@@ -103,8 +106,14 @@ async def _recv_auth(connection: Connection, scheme: Type[SignatureScheme],
     message = await connection.recv_message()
     if not isinstance(message, AuthenticateWithKey):
         bail(ErrorKind.AUTHENTICATION, "expected broker AuthenticateWithKey")
-    raw_key = message.public_key[:_RAW_KEY_LEN]
-    ident = bytes(message.public_key[_RAW_KEY_LEN:]).decode("utf-8", "replace")
+    packed = bytes(message.public_key)
+    if len(packed) < _KEY_LEN.size:
+        await _reject(connection, "malformed broker key field")
+    (key_len,) = _KEY_LEN.unpack_from(packed)
+    if len(packed) < _KEY_LEN.size + key_len:
+        await _reject(connection, "malformed broker key field")
+    raw_key = packed[_KEY_LEN.size:_KEY_LEN.size + key_len]
+    ident = packed[_KEY_LEN.size + key_len:].decode("utf-8", "replace")
     # Same-key check: peer must hold OUR broker keypair (broker.rs:286-288).
     if raw_key != keypair.public_key:
         await _reject(connection, "broker key mismatch")

@@ -87,12 +87,13 @@ def production_run_def(topics: Optional[TopicSpace] = None) -> RunDef:
 
 def testing_run_def(broker_protocol: Type[Protocol] = Memory,
                     user_protocol: Type[Protocol] = Memory,
-                    topics: Optional[TopicSpace] = None) -> RunDef:
+                    topics: Optional[TopicSpace] = None,
+                    scheme: Type[SignatureScheme] = DEFAULT_SCHEME) -> RunDef:
     """Parity ``TestingRunDef<B,U>`` (def.rs:140-159): generic transports +
     Embedded (SQLite) discovery."""
     return RunDef(
-        broker_def=ConnectionDef(protocol=broker_protocol),
-        user_def=ConnectionDef(protocol=user_protocol),
+        broker_def=ConnectionDef(protocol=broker_protocol, scheme=scheme),
+        user_def=ConnectionDef(protocol=user_protocol, scheme=scheme),
         discovery=Embedded,
         topics=topics or TEST_TOPIC_SPACE,
     )

@@ -1,0 +1,11 @@
+"""In-process whole-system fixtures shared by tests *and* benches.
+
+The reference keeps its deterministic harness outside ``cfg(test)`` exactly
+so criterion benches can reuse it (cdn-broker/src/tests/mod.rs:7-9); this
+package plays the same role for the full-cluster fixture used by the
+integration tests and ``benches/configs_bench.py``.
+"""
+
+from pushcdn_tpu.testing.cluster import Cluster, wait_until
+
+__all__ = ["Cluster", "wait_until"]

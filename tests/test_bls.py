@@ -106,31 +106,12 @@ async def test_end_to_end_cluster_on_bls():
     user signature, broker↔broker mutual auth signs with BLS, and a
     direct-message echo completes (parity basic_connect.rs over the
     reference's production scheme shape)."""
-    from test_integration import Cluster
-
-    from pushcdn_tpu.client import Client, ClientConfig
-    from pushcdn_tpu.proto.def_ import ConnectionDef, RunDef, TEST_TOPIC_SPACE
-    from pushcdn_tpu.proto.discovery.embedded import Embedded
     from pushcdn_tpu.proto.message import Direct
-    from pushcdn_tpu.proto.transport.memory import Memory
+    from pushcdn_tpu.testing import Cluster
 
-    cluster = Cluster(num_brokers=2)
-    cluster.run_def = RunDef(
-        broker_def=ConnectionDef(protocol=Memory, scheme=BlsBn254Scheme),
-        user_def=ConnectionDef(protocol=Memory, scheme=BlsBn254Scheme),
-        discovery=Embedded,
-        topics=TEST_TOPIC_SPACE,
-    )
-    cluster.broker_keypair = BlsBn254Scheme.generate_keypair(seed=20_000)
-    await cluster.start()
+    cluster = await Cluster(num_brokers=2, scheme=BlsBn254Scheme).start()
     try:
-        client = Client(ClientConfig(
-            marshal_endpoint=cluster.marshal_endpoint,
-            keypair=BlsBn254Scheme.generate_keypair(seed=21_000),
-            protocol=Memory,
-            subscribed_topics={0},
-            scheme=BlsBn254Scheme,
-        ))
+        client = cluster.client(seed=21_000, topics=[0])
         await client.ensure_initialized()
         await client.send_direct_message(client.public_key, b"bls echo")
         got = await asyncio.wait_for(client.receive_message(), 10)

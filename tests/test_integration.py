@@ -10,114 +10,17 @@ on a laptop with no cluster (SURVEY.md §4 tier 3).
 """
 
 import asyncio
-import itertools
-import os
-import tempfile
 
 import pytest
 
-from pushcdn_tpu.broker.broker import Broker, BrokerConfig
-from pushcdn_tpu.broker.tasks.heartbeat import heartbeat_once
 from pushcdn_tpu.client import Client, ClientConfig
-from pushcdn_tpu.marshal import Marshal, MarshalConfig
 from pushcdn_tpu.proto.auth import user as user_auth
 from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME
-from pushcdn_tpu.proto.def_ import testing_run_def as make_testing_run_def
-from pushcdn_tpu.proto.discovery.base import BrokerIdentifier
 from pushcdn_tpu.proto.discovery.embedded import Embedded
 from pushcdn_tpu.proto.error import Error
 from pushcdn_tpu.proto.message import Broadcast, Direct, Subscribe
 from pushcdn_tpu.proto.transport.memory import Memory
-
-_UNIQUE = itertools.count()
-
-
-async def wait_until(predicate, timeout: float = 5.0, interval: float = 0.02):
-    """Poll until ``predicate()`` is truthy (handshake completion on the
-    broker side lags the client's return by a few event-loop ticks)."""
-    deadline = asyncio.get_running_loop().time() + timeout
-    while True:
-        if predicate():
-            return
-        if asyncio.get_running_loop().time() > deadline:
-            raise AssertionError(f"condition never became true: {predicate}")
-        await asyncio.sleep(interval)
-
-
-class Cluster:
-    """Marshal + N brokers + shared discovery, all in-process."""
-
-    def __init__(self, num_brokers: int = 1, device_plane=None):
-        self.uid = next(_UNIQUE)
-        self.num_brokers = num_brokers
-        self.device_plane = device_plane
-        self.db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-it-"),
-                               "discovery.sqlite")
-        self.run_def = make_testing_run_def()
-        self.broker_keypair = DEFAULT_SCHEME.generate_keypair(seed=10_000 + self.uid)
-        self.brokers: list[Broker] = []
-        self.marshal: Marshal = None
-
-    def broker_endpoints(self, i: int):
-        return (f"it{self.uid}-b{i}-pub", f"it{self.uid}-b{i}-priv")
-
-    @property
-    def marshal_endpoint(self) -> str:
-        return f"it{self.uid}-marshal"
-
-    async def start(self):
-        for i in range(self.num_brokers):
-            pub, priv = self.broker_endpoints(i)
-            broker = await Broker.new(BrokerConfig(
-                run_def=self.run_def,
-                keypair=self.broker_keypair,  # one deployment key (same-key check)
-                discovery_endpoint=self.db,
-                public_advertise_endpoint=pub, public_bind_endpoint=pub,
-                private_advertise_endpoint=priv, private_bind_endpoint=priv,
-                # deterministic: we drive heartbeats/syncs manually
-                heartbeat_interval_s=3600, sync_interval_s=3600,
-                whitelist_interval_s=3600,
-                device_plane=self.device_plane,
-            ))
-            await broker.start()
-            self.brokers.append(broker)
-        # two heartbeat rounds: all register, then dial each other
-        for b in self.brokers:
-            await heartbeat_once(b)
-        for b in self.brokers:
-            await heartbeat_once(b)
-        await asyncio.sleep(0.1)  # let mesh links finish auth + full sync
-
-        self.marshal = await Marshal.new(MarshalConfig(
-            run_def=self.run_def,
-            discovery_endpoint=self.db,
-            bind_endpoint=self.marshal_endpoint,
-        ))
-        await self.marshal.start()
-        return self
-
-    def client(self, seed: int, topics=()) -> Client:
-        return Client(ClientConfig(
-            marshal_endpoint=self.marshal_endpoint,
-            keypair=DEFAULT_SCHEME.generate_keypair(seed=seed),
-            protocol=Memory,
-            subscribed_topics=set(topics),
-        ))
-
-    async def steer_load(self, broker_index: int, load: int):
-        """Fake a broker's advertised load to steer marshal placement
-        (parity double_connect.rs:100-121)."""
-        pub, priv = self.broker_endpoints(broker_index)
-        handle = await Embedded.new(self.db,
-                                    identity=BrokerIdentifier(pub, priv))
-        await handle.perform_heartbeat(load, 60.0)
-        await handle.close()
-
-    async def stop(self):
-        if self.marshal:
-            await self.marshal.stop()
-        for b in self.brokers:
-            await b.stop()
+from pushcdn_tpu.testing import Cluster, wait_until
 
 
 async def test_end_to_end_echo():
@@ -347,6 +250,40 @@ async def test_client_reconnects_after_broker_drop():
         assert broker.connections.user_topics.get_values_of_key(
             alice.public_key) == {0}
         alice.close()
+    finally:
+        await cluster.stop()
+
+
+async def test_bls_mesh_and_cross_broker_delivery():
+    """Regression: broker↔broker mutual auth must be scheme-agnostic — the
+    wire field packs ``u16 len || key || identity``, so the 128-byte
+    BLS-BN254 keys (production scheme) pass the same-key check just like
+    32-byte Ed25519 keys."""
+    from pushcdn_tpu.proto.crypto.signature import BlsBn254Scheme
+
+    if not BlsBn254Scheme.available():
+        pytest.skip("native BLS library unavailable")
+    cluster = await Cluster(num_brokers=2, scheme=BlsBn254Scheme).start()
+    try:
+        await wait_until(
+            lambda: all(b.connections.num_brokers == 1
+                        for b in cluster.brokers), timeout=30)
+        await cluster.steer_load(0, 100)
+        await cluster.steer_load(1, 0)
+        alice = cluster.client(seed=71, topics=[0])
+        await alice.ensure_initialized()   # broker 1
+        await cluster.steer_load(0, 0)
+        await cluster.steer_load(1, 100)
+        bob = cluster.client(seed=72, topics=[0])
+        await bob.ensure_initialized()     # broker 0
+        await wait_until(
+            lambda: sum(b.connections.num_users for b in cluster.brokers) == 2)
+        await asyncio.sleep(0.3)           # interest propagates
+        await alice.send_broadcast_message([0], b"bls mesh works")
+        got = await asyncio.wait_for(bob.receive_message(), 10)
+        assert bytes(got.message) == b"bls mesh works"
+        alice.close()
+        bob.close()
     finally:
         await cluster.stop()
 
