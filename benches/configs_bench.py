@@ -38,7 +38,7 @@ from pushcdn_tpu.proto.crypto.signature import (
     DEFAULT_SCHEME,
 )
 from pushcdn_tpu.proto.topic import TopicSpace
-from pushcdn_tpu.testing import Cluster, wait_until
+from pushcdn_tpu.testing import Cluster, wait_mesh_interest, wait_until
 
 RESULTS: list[dict] = []
 
@@ -60,21 +60,7 @@ async def _drain(client, n: int):
         await asyncio.wait_for(client.receive_message(), 30)
 
 
-async def _wait_mesh_interest(cluster, topic: int, links: int,
-                              timeout: float = 60.0):
-    """Wait until every broker holds ``links`` mesh links AND sees all of
-    them as interested in ``topic`` (full interest propagation). BLS
-    broker↔broker auth takes hundreds of ms, so this must be explicit —
-    messages sent before a link exists are simply not forwarded (sender.rs
-    failure-is-removal semantics)."""
-    await wait_until(
-        lambda: all(b.connections.num_brokers == links
-                    for b in cluster.brokers), timeout)
-    await wait_until(
-        lambda: all(
-            len(b.connections.get_interested_by_topic([topic], False)[1])
-            == links
-            for b in cluster.brokers), timeout)
+_wait_mesh_interest = wait_mesh_interest
 
 
 async def _connect_all(clients, concurrency: int = 32):

@@ -11,8 +11,12 @@ Capability parity with cdn-proto/src/connection/protocols/mod.rs:
 - Length-delimited framing: u32 big-endian length prefix then payload, max
   ``MAX_MESSAGE_SIZE``, 5 s per-frame read/write timeouts
   (mod.rs:309-394; cdn-proto/src/lib.rs:25).
-- The reader acquires limiter byte-permits **before** buffering a frame
-  (mod.rs:328) — backpressure lands on the socket, not on the router.
+- Backpressure lands on the socket, not the router (mod.rs:328): frames
+  larger than the read chunk acquire their limiter byte-permit before the
+  payload is buffered; small frames parsed out of an already-read chunk
+  acquire theirs before entering the receive queue, so the unpermitted
+  overshoot is bounded by ``Connection._READ_CHUNK`` per connection and
+  a blocked permit still stops further socket reads.
 """
 
 from __future__ import annotations
@@ -45,6 +49,13 @@ class RawStream(abc.ABC):
     @abc.abstractmethod
     async def read_exactly(self, n: int) -> bytes: ...
 
+    async def read_some(self, max_n: int) -> bytes:
+        """Return at least 1 and at most ``max_n`` bytes; raise
+        ``IncompleteReadError`` at EOF. Transports override this with a
+        real bulk read — the reader loop uses it to parse many small
+        frames per wakeup instead of two awaits per frame."""
+        return await self.read_exactly(1)
+
     @abc.abstractmethod
     async def write(self, data) -> None:
         """Buffer ``data`` and flush (may await backpressure)."""
@@ -67,6 +78,12 @@ class AsyncioStream(RawStream):
 
     async def read_exactly(self, n: int) -> bytes:
         return await self.reader.readexactly(n)
+
+    async def read_some(self, max_n: int) -> bytes:
+        data = await self.reader.read(max_n)
+        if not data:
+            raise asyncio.IncompleteReadError(b"", 1)
+        return data
 
     async def write(self, data) -> None:
         self.writer.write(bytes(data) if isinstance(data, memoryview) else data)
@@ -204,26 +221,72 @@ class Connection:
                     entry[1].set_exception(err)
             self._poison(err)
 
+    # One bulk read per wakeup, then parse every complete frame out of the
+    # carry buffer — the old two-awaits-per-frame loop spent ~70% of small-
+    # frame time in per-frame asyncio machinery (timeout contexts, wakeups).
+    _READ_CHUNK = 256 * 1024
+
     async def _reader_loop(self) -> None:
+        buf = bytearray()
         try:
             while True:
-                async with asyncio.timeout(None):
-                    header = await self._stream.read_exactly(4)
-                (length,) = _LEN.unpack(header)
-                if length > MAX_MESSAGE_SIZE:
-                    raise Error(ErrorKind.EXCEEDED_SIZE,
-                                f"peer announced {length} B frame")
-                # Backpressure BEFORE allocating the buffer (mod.rs:328).
-                permit = await self._limiter.allocate_message_bytes(length)
-                try:
+                # The per-frame 5 s read timeout (mod.rs:336) now applies to
+                # "progress while a partial frame is pending": a blocked
+                # empty buffer waits forever, a half-received frame doesn't.
+                if buf:
                     async with asyncio.timeout(READ_TIMEOUT_S):
-                        payload = await self._stream.read_exactly(length)
-                except BaseException:
-                    if permit is not None:
-                        permit.release()
-                    raise
-                metrics_mod.BYTES_RECV.inc(length + 4)
-                await self._recv_q.put(Bytes(payload, permit))
+                        chunk = await self._stream.read_some(self._READ_CHUNK)
+                else:
+                    chunk = await self._stream.read_some(self._READ_CHUNK)
+                buf += chunk
+                off = 0
+                blen = len(buf)
+                while blen - off >= 4:
+                    (length,) = _LEN.unpack_from(buf, off)
+                    if length > MAX_MESSAGE_SIZE:
+                        raise Error(ErrorKind.EXCEEDED_SIZE,
+                                    f"peer announced {length} B frame")
+                    if blen - off - 4 < length:
+                        # Incomplete frame: acquire the pool permit BEFORE
+                        # buffering the remainder (mod.rs:328 — backpressure
+                        # lands on the socket), then stream straight into
+                        # one preallocated buffer (no reassembly copy), one
+                        # progress-timeout window per chunk rather than one
+                        # for the whole payload.
+                        permit = await self._limiter.allocate_message_bytes(
+                            length)
+                        try:
+                            out = bytearray(length)
+                            pos = blen - off - 4
+                            out[:pos] = buf[off + 4:blen]
+                            del buf[:]
+                            off = 0
+                            blen = 0
+                            mv = memoryview(out)
+                            while pos < length:
+                                async with asyncio.timeout(READ_TIMEOUT_S):
+                                    chunk = await self._stream.read_some(
+                                        min(length - pos, 4 * self._READ_CHUNK))
+                                mv[pos:pos + len(chunk)] = chunk
+                                pos += len(chunk)
+                        except BaseException:
+                            if permit is not None:
+                                permit.release()
+                            raise
+                        metrics_mod.BYTES_RECV.inc(length + 4)
+                        await self._recv_q.put(Bytes(out, permit))
+                        continue
+                    # Complete frame in the buffer. The permit is acquired
+                    # after the bytes were read — the overshoot is bounded
+                    # by _READ_CHUNK, and a blocked permit still stops the
+                    # socket (no further read_some until the put succeeds).
+                    payload = bytes(buf[off + 4:off + 4 + length])
+                    off += 4 + length
+                    permit = await self._limiter.allocate_message_bytes(length)
+                    metrics_mod.BYTES_RECV.inc(length + 4)
+                    await self._recv_q.put(Bytes(payload, permit))
+                if off:
+                    del buf[:off]
         except asyncio.CancelledError:
             raise
         except asyncio.IncompleteReadError as exc:

@@ -67,6 +67,18 @@ class _BoundedBuffer:
                 self._cond.notify_all()
             return bytes(out)
 
+    async def read_some(self, max_n: int) -> bytes:
+        async with self._cond:
+            while not self._buf:
+                if self._eof:
+                    raise asyncio.IncompleteReadError(b"", 1)
+                await self._cond.wait()
+            take = min(max_n, len(self._buf))
+            out = bytes(self._buf[:take])
+            del self._buf[:take]
+            self._cond.notify_all()
+            return out
+
     def set_eof(self) -> None:
         self._eof = True
         # May be called from sync context (abort); schedule the wakeup.
@@ -89,6 +101,9 @@ class _PipeStream(RawStream):
 
     async def read_exactly(self, n: int) -> bytes:
         return await self._rx.read_exactly(n)
+
+    async def read_some(self, max_n: int) -> bytes:
+        return await self._rx.read_some(max_n)
 
     async def write(self, data) -> None:
         if self._closed:

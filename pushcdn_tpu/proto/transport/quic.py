@@ -259,6 +259,19 @@ class _UdpStream(RawStream):
         del self._rbuf[:n]
         return out
 
+    async def read_some(self, max_n: int) -> bytes:
+        while not self._rbuf:
+            if self._error is not None:
+                raise self._error
+            if self._eof:
+                raise asyncio.IncompleteReadError(b"", 1)
+            self._rbuf_wake.clear()
+            await self._rbuf_wake.wait()
+        take = min(max_n, len(self._rbuf))
+        out = bytes(self._rbuf[:take])
+        del self._rbuf[:take]
+        return out
+
     async def write(self, data) -> None:
         if self._error is not None:
             raise self._error

@@ -6,6 +6,6 @@ package plays the same role for the full-cluster fixture used by the
 integration tests and ``benches/configs_bench.py``.
 """
 
-from pushcdn_tpu.testing.cluster import Cluster, wait_until
+from pushcdn_tpu.testing.cluster import Cluster, wait_mesh_interest, wait_until
 
-__all__ = ["Cluster", "wait_until"]
+__all__ = ["Cluster", "wait_mesh_interest", "wait_until"]

@@ -41,6 +41,24 @@ async def wait_until(predicate, timeout: float = 5.0, interval: float = 0.02):
         await asyncio.sleep(interval)
 
 
+async def wait_mesh_interest(cluster: "Cluster", topic: int, links: int,
+                             timeout: float = 60.0):
+    """Wait until every broker holds ``links`` mesh links AND sees all of
+    them as interested in ``topic`` (full interest propagation). Messages
+    sent before a link exists are simply not forwarded (sender.rs
+    failure-is-removal semantics), and BLS broker↔broker auth takes
+    hundreds of ms — so tests and benches must wait explicitly, never
+    sleep."""
+    await wait_until(
+        lambda: all(b.connections.num_brokers == links
+                    for b in cluster.brokers), timeout)
+    await wait_until(
+        lambda: all(
+            len(b.connections.get_interested_by_topic([topic], False)[1])
+            == links
+            for b in cluster.brokers), timeout)
+
+
 class Cluster:
     """Marshal + N brokers + shared discovery, all in-process."""
 

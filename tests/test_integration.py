@@ -278,7 +278,8 @@ async def test_bls_mesh_and_cross_broker_delivery():
         await bob.ensure_initialized()     # broker 0
         await wait_until(
             lambda: sum(b.connections.num_users for b in cluster.brokers) == 2)
-        await asyncio.sleep(0.3)           # interest propagates
+        from pushcdn_tpu.testing import wait_mesh_interest
+        await wait_mesh_interest(cluster, topic=0, links=1, timeout=30)
         await alice.send_broadcast_message([0], b"bls mesh works")
         got = await asyncio.wait_for(bob.receive_message(), 10)
         assert bytes(got.message) == b"bls mesh works"
