@@ -241,9 +241,14 @@ class Connection:
                 buf += chunk
                 off = 0
                 blen = len(buf)
+                # one exported view per chunk: slicing it yields bytes in a
+                # single copy (a bytearray slice + bytes() would be two);
+                # must be released before the bytearray is resized
+                mv = memoryview(buf)
                 while blen - off >= 4:
                     (length,) = _LEN.unpack_from(buf, off)
                     if length > MAX_MESSAGE_SIZE:
+                        mv.release()
                         raise Error(ErrorKind.EXCEEDED_SIZE,
                                     f"peer announced {length} B frame")
                     if blen - off - 4 < length:
@@ -258,7 +263,8 @@ class Connection:
                         try:
                             out = bytearray(length)
                             pos = blen - off - 4
-                            out[:pos] = buf[off + 4:blen]
+                            out[:pos] = mv[off + 4:blen]
+                            mv.release()
                             del buf[:]
                             off = 0
                             blen = 0
@@ -280,11 +286,15 @@ class Connection:
                     # after the bytes were read — the overshoot is bounded
                     # by _READ_CHUNK, and a blocked permit still stops the
                     # socket (no further read_some until the put succeeds).
-                    payload = bytes(buf[off + 4:off + 4 + length])
+                    payload = bytes(mv[off + 4:off + 4 + length])
                     off += 4 + length
                     permit = await self._limiter.allocate_message_bytes(length)
                     metrics_mod.BYTES_RECV.inc(length + 4)
                     await self._recv_q.put(Bytes(payload, permit))
+                else:
+                    # loop fell through (≤3 leftover bytes): release the
+                    # view so the carry buffer can be resized
+                    mv.release()
                 if off:
                     del buf[:off]
         except asyncio.CancelledError:
