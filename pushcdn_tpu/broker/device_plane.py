@@ -10,7 +10,7 @@ pump). The wire frame travels verbatim through HBM, so receivers are
 byte-identical with the host path. Oversized messages and control traffic
 keep the host path.
 
-Scope (round 1): one broker = one device shard (``routing_step_single``).
+Scope (round 1): one broker = one device shard (``routing_step_lanes_single``).
 The host CRDT stays authoritative for cross-broker ownership; the device
 plane handles the local fan-out — which is where the per-message Python
 cost lives. Multi-shard meshes route via parallel.router's shard_map step.
@@ -33,7 +33,7 @@ Consistency design (single-writer, snapshot-per-step):
 
 Flow per step:
   ingress: user_receive_loop → try_stage() → FrameRing (slot credits)
-  compute: snapshot + take_batch → routing_step_single (jitted)
+  compute: snapshot + take_batch → routing_step_lanes_single (jitted)
   egress:  deliver[u, f] → per-user non-blocking send of the frame bytes
 """
 
@@ -53,7 +53,7 @@ from pushcdn_tpu.parallel.frames import FrameRing, UserSlots
 from pushcdn_tpu.parallel.router import (
     IngressBatch,
     RouterState,
-    routing_step_single,
+    routing_step_lanes_single,
 )
 from pushcdn_tpu.proto.error import Error
 from pushcdn_tpu.proto.limiter import Bytes
@@ -70,9 +70,20 @@ class DevicePlaneConfig:
     num_user_slots: int = 1024
     ring_slots: int = 1024
     frame_bytes: int = 2048
+    # Size-bucketed lanes beyond the base (ring_slots × frame_bytes) ring
+    # (SURVEY.md §7 hard-part #1): each entry is (frame_bytes, ring_slots).
+    # A frame is staged into the smallest lane it fits, so 100 B acks don't
+    # ride 32 KB-padded slots and 16 KB proposals still stay on device.
+    extra_lanes: tuple = ((16384, 64),)
     # batch window: how long the pump waits to coalesce ingress into one
     # step (the latency ↔ step-efficiency knob)
     batch_window_s: float = 0.001
+
+    def lane_shapes(self):
+        """All lanes as (frame_bytes, ring_slots), sorted ascending by
+        frame width (best-fit staging walks this order)."""
+        return sorted(((self.frame_bytes, self.ring_slots),)
+                      + tuple(self.extra_lanes))
 
 
 class DevicePlane:
@@ -85,7 +96,8 @@ class DevicePlane:
         self.config = config or DevicePlaneConfig()
         c = self.config
         self.slots = UserSlots(c.num_user_slots)
-        self.ring = FrameRing(slots=c.ring_slots, frame_bytes=c.frame_bytes)
+        self.rings = [FrameRing(slots=s, frame_bytes=f)
+                      for f, s in c.lane_shapes()]
         # host mirrors — the single source of truth for device state
         self._owned = np.zeros(c.num_user_slots, bool)
         self._masks = np.zeros(c.num_user_slots, np.uint32)
@@ -152,7 +164,7 @@ class DevicePlane:
         if self.disabled:
             return StageResult.INELIGIBLE
         frame = bytes(raw.data)
-        if len(frame) > self.config.frame_bytes:
+        if len(frame) > self.rings[-1].frame_bytes:
             return StageResult.INELIGIBLE
         if isinstance(message, Broadcast):
             if self._unmirrored:
@@ -162,18 +174,27 @@ class DevicePlane:
             mask = self._mask_of(message.topics)
             if mask == 0:
                 return StageResult.INELIGIBLE
-            ok = self.ring.push_broadcast(frame, mask)
+            ok = self._push(frame, lambda r: r.push_broadcast(frame, mask))
         elif isinstance(message, Direct):
             slot = self.slots.slot_of(bytes(message.recipient))
             if slot is None:
                 return StageResult.INELIGIBLE  # not mirrored (cross-broker)
-            ok = self.ring.push_direct(frame, slot)
+            ok = self._push(frame, lambda r: r.push_direct(frame, slot))
         else:
             return StageResult.INELIGIBLE
         if ok:
             self._kick.set()
             return StageResult.STAGED
         return StageResult.FULL
+
+    def _push(self, frame: bytes, push) -> bool:
+        """Best-fit lane staging: the smallest lane the frame fits, spilling
+        upward when it's full (a wider slot just pads more); False only when
+        every eligible lane is full (slot-credit backpressure)."""
+        for ring in self.rings:
+            if len(frame) <= ring.frame_bytes and push(ring):
+                return True
+        return False
 
     def covered_broker_idents(self) -> set:
         """Broker identifiers whose delivery this plane covers — none for
@@ -188,7 +209,7 @@ class DevicePlane:
         self._task = asyncio.create_task(self._pump(), name="device-pump")
 
     def _warmup(self) -> None:
-        empty = self.ring.take_batch()
+        empty = [r.take_batch() for r in self.rings]
         try:
             self._run_step(empty, self._owned.copy(), self._masks.copy())
             self.steps -= 1  # warmup doesn't count
@@ -211,17 +232,18 @@ class DevicePlane:
             await self._kick.wait()
             self._kick.clear()
             await asyncio.sleep(self.config.batch_window_s)  # coalesce
-            if self.ring.free_slots == self.ring.slots:
+            if all(r.free_slots == r.slots for r in self.rings):
                 continue
-            # snapshot mirrors + batch in ONE event-loop tick: consistent
-            batch_np = self.ring.take_batch()
+            # snapshot mirrors + all lane rings in ONE event-loop tick
+            batches_np = [r.take_batch() for r in self.rings]
             owned = self._owned.copy()
             masks = self._masks.copy()
             quarantined, self._quarantine = self._quarantine, []
             try:
-                deliver, lengths, frames = await asyncio.to_thread(
-                    self._run_step, batch_np, owned, masks)
-                self._egress(deliver, lengths, frames)
+                lane_results = await asyncio.to_thread(
+                    self._run_step, batches_np, owned, masks)
+                for deliver, lengths, frames in lane_results:
+                    self._egress(deliver, lengths, frames)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -229,17 +251,21 @@ class DevicePlane:
                     "device routing step failed; re-routing the batch on "
                     "the host path and disabling the device plane")
                 self.disabled = True
-                await self._host_fallback(batch_np)
+                # frames staged (and acked STAGED) while the failing step
+                # ran in the worker thread sit in the fresh rings — drain
+                # them too, or they'd be lost with no fallback
+                late = [r.take_batch() for r in self.rings]
+                await self._host_fallback(batches_np)
+                await self._host_fallback(late)
                 return
             finally:
                 for slot in quarantined:  # safe to recycle now
                     self.slots.free_slot(slot)
 
-    def _run_step(self, b, owned: np.ndarray, masks: np.ndarray):
+    def _run_step(self, lane_batches, owned: np.ndarray, masks: np.ndarray):
         """Blocking device step (runs in a worker thread) against the
-        snapshotted mirrors."""
+        snapshotted mirrors. All lanes ride one jitted program."""
         import jax.numpy as jnp
-        U = self.config.num_user_slots
         state = RouterState(
             crdt=CrdtState(
                 owners=jnp.asarray(np.where(owned, 0, ABSENT).astype(np.int32)),
@@ -248,16 +274,16 @@ class DevicePlane:
                     np.where(owned, 0, ABSENT).astype(np.int32)),
             ),
             topic_masks=jnp.asarray(masks))
-        batch = IngressBatch(
-            jnp.asarray(b.bytes_), jnp.asarray(b.kind),
-            jnp.asarray(b.length), jnp.asarray(b.topic_mask),
-            jnp.asarray(b.dest), jnp.asarray(b.valid))
-        result = routing_step_single(state, batch)
-        deliver = np.asarray(result.deliver)       # [U, S]
-        lengths = np.asarray(result.gathered_length)
-        frames = np.asarray(result.gathered_bytes)
+        batches = tuple(
+            IngressBatch(
+                jnp.asarray(b.bytes_), jnp.asarray(b.kind),
+                jnp.asarray(b.length), jnp.asarray(b.topic_mask),
+                jnp.asarray(b.dest), jnp.asarray(b.valid))
+            for b in lane_batches)
+        result = routing_step_lanes_single(state, batches)
         self.steps += 1
-        return deliver, lengths, frames
+        return [(np.asarray(lane.deliver), np.asarray(lane.gathered_length),
+                 np.asarray(lane.gathered_bytes)) for lane in result.lanes]
 
     def _egress(self, deliver, lengths, frames) -> None:
         """Walk the delivery matrix and queue the original wire frames to
@@ -279,8 +305,8 @@ class DevicePlane:
         for raw in cache.values():
             raw.release()
 
-    async def _host_fallback(self, b) -> None:
-        """Deliver a batch the device failed to route, via the host path.
+    async def _host_fallback(self, lane_batches) -> None:
+        """Deliver batches the device failed to route, via the host path.
         Users-only on purpose: any broker-bound fan-out for these messages
         already ran on the host at staging time."""
         from pushcdn_tpu.broker.tasks.handlers import (
@@ -288,21 +314,22 @@ class DevicePlane:
             handle_direct_message,
         )
         from pushcdn_tpu.proto.message import deserialize
-        for i in range(self.ring.slots):
-            if not b.valid[i]:
-                continue
-            raw = Bytes(b.bytes_[i, :b.length[i]].tobytes())
-            try:
-                message = deserialize(raw.data)
-                if isinstance(message, Direct):
-                    await handle_direct_message(
-                        self.broker, bytes(message.recipient), raw,
-                        to_user_only=True)
-                elif isinstance(message, Broadcast):
-                    await handle_broadcast_message(
-                        self.broker, list(message.topics), raw,
-                        to_users_only=True)
-            except Error:
-                pass
-            finally:
-                raw.release()
+        for b in lane_batches:
+            for i in range(len(b.valid)):
+                if not b.valid[i]:
+                    continue
+                raw = Bytes(b.bytes_[i, :b.length[i]].tobytes())
+                try:
+                    message = deserialize(raw.data)
+                    if isinstance(message, Direct):
+                        await handle_direct_message(
+                            self.broker, bytes(message.recipient), raw,
+                            to_user_only=True)
+                    elif isinstance(message, Broadcast):
+                        await handle_broadcast_message(
+                            self.broker, list(message.topics), raw,
+                            to_users_only=True)
+                except Error:
+                    pass
+                finally:
+                    raw.release()

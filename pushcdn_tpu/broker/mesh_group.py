@@ -47,7 +47,7 @@ from pushcdn_tpu.parallel.router import (
     DirectIngress,
     IngressBatch,
     RouterState,
-    make_mesh_routing_step,
+    make_mesh_lane_step,
 )
 from pushcdn_tpu.proto.error import Error
 from pushcdn_tpu.proto.limiter import Bytes
@@ -65,7 +65,19 @@ class MeshGroupConfig:
     ring_slots: int = 256          # per shard per step (broadcast all_gather)
     direct_bucket_slots: int = 64  # per shard per DESTINATION per step
     frame_bytes: int = 2048
+    # Size-bucketed lanes beyond the base lane (SURVEY.md §7 hard-part #1):
+    # (frame_bytes, ring_slots, direct_bucket_slots) per entry. Frames stage
+    # into the smallest lane they fit, so big proposals ride ICI without
+    # padding every small ack to the widest slot.
+    extra_lanes: tuple = ((16384, 32, 8),)
     batch_window_s: float = 0.001
+
+    def lane_shapes(self):
+        """All lanes as (frame_bytes, ring_slots, direct_bucket_slots),
+        ascending by frame width."""
+        return sorted(
+            ((self.frame_bytes, self.ring_slots, self.direct_bucket_slots),)
+            + tuple(self.extra_lanes))
 
 
 class MeshShardPlane:
@@ -128,17 +140,20 @@ class MeshBrokerGroup:
         self.config = config or MeshGroupConfig()
         c = self.config
         self.num_shards = mesh.devices.size
-        self.step_fn = make_mesh_routing_step(mesh, with_direct=True)
+        self.step_fn = make_mesh_lane_step(mesh)
         self.brokers: List[Optional["Broker"]] = [None] * self.num_shards
-        self.rings = [FrameRing(slots=c.ring_slots, frame_bytes=c.frame_bytes)
-                      for _ in range(self.num_shards)]
+        # lane_rings[lane][shard] — size-bucketed broadcast staging
+        self.lane_rings = [
+            [FrameRing(slots=s, frame_bytes=f)
+             for _ in range(self.num_shards)]
+            for f, s, _d in c.lane_shapes()]
         # direct frames go into per-destination-shard buckets and cross the
-        # mesh with one all_to_all (router.DirectIngress) instead of riding
-        # the broadcast all_gather to every shard
-        self.direct_buckets = [
-            DirectBuckets(self.num_shards, capacity=c.direct_bucket_slots,
-                          frame_bytes=c.frame_bytes)
-            for _ in range(self.num_shards)]
+        # mesh with one all_to_all per lane (router.DirectIngress) instead
+        # of riding the broadcast all_gather to every shard
+        self.lane_buckets = [
+            [DirectBuckets(self.num_shards, capacity=d, frame_bytes=f)
+             for _ in range(self.num_shards)]
+            for f, _s, d in c.lane_shapes()]
         # global user table + mirrors (single source of truth)
         self.slots = UserSlots(c.num_user_slots)
         self._owner = np.full(c.num_user_slots, ABSENT, np.int32)
@@ -184,8 +199,9 @@ class MeshBrokerGroup:
             self._task = asyncio.create_task(self._pump(), name="mesh-group-pump")
 
     def _warmup(self) -> None:
-        batches = [r.take_batch() for r in self.rings]  # empty, right shapes
-        directs = [b.take_batch() for b in self.direct_buckets]
+        # empty, right shapes: [lane][shard]
+        batches = [[r.take_batch() for r in rings] for rings in self.lane_rings]
+        directs = [[b.take_batch() for b in bkts] for bkts in self.lane_buckets]
         try:
             self._run_step(batches, directs, self._owner.copy(),
                            self._claim_version.copy(), self._masks.copy())
@@ -277,9 +293,8 @@ class MeshBrokerGroup:
         if self.disabled:
             return StageResult.INELIGIBLE
         frame = bytes(raw.data)
-        if len(frame) > self.config.frame_bytes:
+        if len(frame) > self.lane_rings[-1][shard].frame_bytes:
             return self._overflow()
-        ring = self.rings[shard]
         if isinstance(message, Broadcast):
             if self._unmirrored:
                 return self._overflow()
@@ -288,7 +303,10 @@ class MeshBrokerGroup:
             mask = _mask_of(message.topics)
             if mask == 0:
                 return StageResult.INELIGIBLE  # no valid topics: no-op send
-            ok = ring.push_broadcast(frame, mask)
+            # best-fit lane, spilling to wider lanes when full
+            ok = any(len(frame) <= rings[shard].frame_bytes
+                     and rings[shard].push_broadcast(frame, mask)
+                     for rings in self.lane_rings)
         elif isinstance(message, Direct):
             slot = self.slots.slot_of(bytes(message.recipient))
             if slot is None:
@@ -298,7 +316,9 @@ class MeshBrokerGroup:
             if owner == ABSENT:
                 return self._overflow()
             # one-hop ICI path: bucket by owner shard for the all_to_all
-            ok = self.direct_buckets[shard].push(owner, frame, slot)
+            ok = any(len(frame) <= bkts[shard].frame_bytes
+                     and bkts[shard].push(owner, frame, slot)
+                     for bkts in self.lane_buckets)
         else:
             return StageResult.INELIGIBLE
         if ok:
@@ -313,23 +333,27 @@ class MeshBrokerGroup:
             await self._kick.wait()
             self._kick.clear()
             await asyncio.sleep(self.config.batch_window_s)
-            if all(r.free_slots == r.slots for r in self.rings) and \
-                    all(b.total_used == 0 for b in self.direct_buckets):
+            if all(r.free_slots == r.slots
+                   for rings in self.lane_rings for r in rings) and \
+                    all(b.total_used == 0
+                        for bkts in self.lane_buckets for b in bkts):
                 continue
-            # one-tick snapshot: all rings + buckets + mirrors together
-            batches = [r.take_batch() for r in self.rings]
-            directs = [b.take_batch() for b in self.direct_buckets]
+            # one-tick snapshot: all lanes' rings + buckets + mirrors
+            batches = [[r.take_batch() for r in rings]
+                       for rings in self.lane_rings]
+            directs = [[b.take_batch() for b in bkts]
+                       for bkts in self.lane_buckets]
             owner = self._owner.copy()
             versions = self._claim_version.copy()
             masks = self._masks.copy()
             quarantined, self._quarantine = self._quarantine, []
             try:
-                result = await asyncio.to_thread(
+                lanes, direct_lanes = await asyncio.to_thread(
                     self._run_step, batches, directs, owner, versions, masks)
-                (deliver, lengths, frames,
-                 d_deliver, d_lengths, d_frames) = result
-                self._egress(deliver, lengths, frames)
-                self._egress(d_deliver, d_lengths, d_frames)
+                for deliver, lengths, frames in lanes:
+                    self._egress(deliver, lengths, frames)
+                for deliver, lengths, frames in direct_lanes:
+                    self._egress(deliver, lengths, frames)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -340,19 +364,23 @@ class MeshBrokerGroup:
                 # frames staged (and acked as STAGED) while the failing step
                 # ran in the worker thread sit in the fresh rings — drain
                 # them too, or they'd be lost with no fallback
-                late = [r.take_batch() for r in self.rings]
-                late_d = [b.take_batch() for b in self.direct_buckets]
-                await self._host_fallback(batches)
-                await self._host_fallback(late)
-                await self._host_fallback_direct(directs)
-                await self._host_fallback_direct(late_d)
+                late = [[r.take_batch() for r in rings]
+                        for rings in self.lane_rings]
+                late_d = [[b.take_batch() for b in bkts]
+                          for bkts in self.lane_buckets]
+                for lane in batches + late:
+                    await self._host_fallback(lane)
+                for lane in directs + late_d:
+                    await self._host_fallback_direct(lane)
                 return
             finally:
                 for slot in quarantined:
                     self.slots.free_slot(slot)
 
     def _run_step(self, batches, directs, owner, versions, masks):
-        """Blocking multi-shard device step (worker thread)."""
+        """Blocking multi-shard device step (worker thread). ``batches`` and
+        ``directs`` are [lane][shard] host snapshots; all lanes ride ONE
+        jitted shard_map program with one shared CRDT merge."""
         import jax.numpy as jnp
         B = self.num_shards
         # every shard's state row is the (shared) global view; on real
@@ -366,26 +394,30 @@ class MeshBrokerGroup:
             crdt=CrdtState(jnp.asarray(owners_b), jnp.asarray(versions_b),
                            jnp.asarray(ids_b)),
             topic_masks=jnp.asarray(masks_b))
-        batch = IngressBatch(
-            jnp.asarray(np.stack([b.bytes_ for b in batches])),
-            jnp.asarray(np.stack([b.kind for b in batches])),
-            jnp.asarray(np.stack([b.length for b in batches])),
-            jnp.asarray(np.stack([b.topic_mask for b in batches])),
-            jnp.asarray(np.stack([b.dest for b in batches])),
-            jnp.asarray(np.stack([b.valid for b in batches])))
-        direct = DirectIngress(
-            jnp.asarray(np.stack([d.bytes_ for d in directs])),
-            jnp.asarray(np.stack([d.length for d in directs])),
-            jnp.asarray(np.stack([d.dest for d in directs])),
-            jnp.asarray(np.stack([d.valid for d in directs])))
-        result = self.step_fn(state, batch, direct)
+        lane_batches = tuple(
+            IngressBatch(
+                jnp.asarray(np.stack([b.bytes_ for b in lane])),
+                jnp.asarray(np.stack([b.kind for b in lane])),
+                jnp.asarray(np.stack([b.length for b in lane])),
+                jnp.asarray(np.stack([b.topic_mask for b in lane])),
+                jnp.asarray(np.stack([b.dest for b in lane])),
+                jnp.asarray(np.stack([b.valid for b in lane])))
+            for lane in batches)
+        lane_directs = tuple(
+            DirectIngress(
+                jnp.asarray(np.stack([d.bytes_ for d in lane])),
+                jnp.asarray(np.stack([d.length for d in lane])),
+                jnp.asarray(np.stack([d.dest for d in lane])),
+                jnp.asarray(np.stack([d.valid for d in lane])))
+            for lane in directs)
+        result = self.step_fn(state, lane_batches, lane_directs)
         self.steps += 1
-        return (np.asarray(result.deliver),          # [B, U, B*S]
-                np.asarray(result.gathered_length),  # [B, B*S]
-                np.asarray(result.gathered_bytes),   # [B, B*S, F]
-                np.asarray(result.direct_deliver),   # [B, U, B*C]
-                np.asarray(result.direct_length),    # [B, B*C]
-                np.asarray(result.direct_bytes))     # [B, B*C, F]
+        lanes = [(np.asarray(l.deliver), np.asarray(l.gathered_length),
+                  np.asarray(l.gathered_bytes)) for l in result.lanes]
+        direct_lanes = [(np.asarray(l.deliver), np.asarray(l.gathered_length),
+                         np.asarray(l.gathered_bytes))
+                        for l in result.direct_lanes]
+        return lanes, direct_lanes
 
     def _egress(self, deliver, lengths, frames) -> None:
         for shard in range(self.num_shards):

@@ -132,29 +132,79 @@ def routing_step(state: RouterState, batch: IngressBatch,
                  my_index: jax.Array, axis_name: Optional[str],
                  direct: Optional[DirectIngress] = None
                  ) -> RouteResult:
-    """One routing step for one broker shard.
+    """One routing step for one broker shard — the single-lane special case
+    of :func:`routing_step_lanes` (one copy of the collective/merge logic).
 
     With ``axis_name=None`` this is the single-broker fast path (no
     collectives — the degenerate mesh). Under ``shard_map`` the gathers run
     over ICI.
     """
-    U = state.topic_masks.shape[0]
+    r = routing_step_lanes(state, (batch,), my_index, axis_name,
+                           directs=() if direct is None else (direct,))
+    lane = r.lanes[0]
+    d = r.direct_lanes[0] if r.direct_lanes else None
+    return RouteResult(
+        gathered_bytes=lane.gathered_bytes,
+        gathered_length=lane.gathered_length,
+        deliver=lane.deliver,
+        state=r.state,
+        evictions=r.evictions,
+        direct_bytes=None if d is None else d.gathered_bytes,
+        direct_length=None if d is None else d.gathered_length,
+        direct_deliver=None if d is None else d.deliver,
+    )
 
+
+# ---------------------------------------------------------------------------
+# size-bucketed lanes (SURVEY.md §7 hard-part #1)
+# ---------------------------------------------------------------------------
+#
+# One fixed frame size can't serve 100 B acks and 32 KB proposals at once:
+# sizing slots for the big ones wastes HBM and ICI bandwidth on padding,
+# sizing for the small ones bounces everything else to the host path. A
+# *lane* is an independently-shaped FrameRing (slots × frame_bytes); the
+# lane step routes any number of lanes in ONE jitted program with ONE CRDT
+# merge — per-lane all_gathers over the broker axis, per-lane delivery
+# matrices against the same merged ownership/mask state.
+
+
+class LaneDelivery(NamedTuple):
+    """Per-lane router output: the gathered frames + delivery matrix."""
+
+    gathered_bytes: jax.Array   # uint8[B*S_l, F_l]
+    gathered_length: jax.Array  # int32[B*S_l]
+    deliver: jax.Array          # bool[U, B*S_l]
+
+
+class MultiRouteResult(NamedTuple):
+    lanes: tuple                # Tuple[LaneDelivery, ...] (broadcast lanes)
+    direct_lanes: tuple         # Tuple[LaneDelivery, ...] (all_to_all lanes)
+    state: RouterState
+    evictions: jax.Array        # bool[U]
+
+
+def routing_step_lanes(state: RouterState,
+                       batches: tuple,
+                       my_index: jax.Array,
+                       axis_name: Optional[str],
+                       directs: tuple = (),
+                       ) -> MultiRouteResult:
+    """One routing step over any number of size-bucketed lanes.
+
+    ``batches`` is a tuple of :class:`IngressBatch` (one per broadcast
+    lane, any slot counts / frame widths); ``directs`` a tuple of
+    :class:`DirectIngress` (one per direct lane). The CRDT/topic-mask
+    merge runs ONCE; every lane's delivery matrix is computed against the
+    same merged state, so cross-lane semantics are identical to a single
+    ring — a lane is purely a shape bucket.
+    """
     def gather(x):
         if axis_name is None:
-            return x[None]  # [1, ...]
+            return x[None]
         return jax.lax.all_gather(x, axis_name)
 
-    # ---- 1. the inter-broker hop: one all_gather over ICI ----------------
-    g_bytes = gather(batch.frame_bytes)     # [B, S, F]
-    g_kind = gather(batch.kind)             # [B, S]
-    g_length = gather(batch.length)
-    g_tmask = gather(batch.topic_mask)
-    g_dest = gather(batch.dest)
-    g_valid = gather(batch.valid)
-
-    # ---- 2. CRDT anti-entropy rides the same step ------------------------
-    g_owners = gather(state.crdt.owners)         # [B, U]
+    # ---- CRDT anti-entropy: once, shared by every lane -------------------
+    g_owners = gather(state.crdt.owners)
     g_versions = gather(state.crdt.versions)
     g_ids = gather(state.crdt.identities)
     g_masks = gather(state.topic_masks)
@@ -163,35 +213,40 @@ def routing_step(state: RouterState, batch: IngressBatch,
         state.crdt, state.topic_masks,
         CrdtState(g_owners, g_versions, g_ids), g_masks)
     now_local = merged.owners == my_index
-    evictions = was_local & ~now_local  # "user connected elsewhere" kick
+    evictions = was_local & ~now_local
 
-    # ---- 3. delivery matrix for locally-owned users ----------------------
-    # (fused Pallas kernel on TPU; jnp reference elsewhere)
-    B, S = g_kind.shape
-    valid_f = g_valid.reshape(B * S)
-    kind_f = jnp.where(valid_f, g_kind.reshape(B * S), 0)  # invalid ⇒ kind 0
-    tmask_f = g_tmask.reshape(B * S)
-    dest_f = g_dest.reshape(B * S)
+    # ---- per-lane inter-broker hop + delivery matrix ---------------------
+    lanes = []
+    for batch in batches:
+        g_bytes = gather(batch.frame_bytes)
+        g_kind = gather(batch.kind)
+        g_length = gather(batch.length)
+        g_tmask = gather(batch.topic_mask)
+        g_dest = gather(batch.dest)
+        g_valid = gather(batch.valid)
+        B, S = g_kind.shape
+        valid_f = g_valid.reshape(B * S)
+        kind_f = jnp.where(valid_f, g_kind.reshape(B * S), 0)
+        deliver = delivery_matrix(
+            masks, now_local, g_tmask.reshape(B * S), kind_f,
+            g_dest.reshape(B * S), use_pallas=USE_PALLAS_DELIVERY)
+        lanes.append(LaneDelivery(
+            gathered_bytes=g_bytes.reshape(B * S, -1),
+            gathered_length=g_length.reshape(B * S),
+            deliver=deliver))
 
-    deliver = delivery_matrix(masks, now_local, tmask_f, kind_f, dest_f,
-                              use_pallas=USE_PALLAS_DELIVERY)
-
-    # ---- 4. the one-hop direct path: all_to_all by owner shard -----------
-    d_bytes = d_length = d_deliver = None
-    if direct is not None:
+    direct_lanes = []
+    for direct in directs:
         d_bytes, d_length, d_deliver = _direct_route(
             direct, now_local, axis_name)
+        direct_lanes.append(LaneDelivery(
+            gathered_bytes=d_bytes, gathered_length=d_length,
+            deliver=d_deliver))
 
-    return RouteResult(
-        gathered_bytes=g_bytes.reshape(B * S, -1),
-        gathered_length=g_length.reshape(B * S),
-        deliver=deliver,
+    return MultiRouteResult(
+        lanes=tuple(lanes), direct_lanes=tuple(direct_lanes),
         state=RouterState(crdt=merged, topic_masks=masks),
-        evictions=evictions,
-        direct_bytes=d_bytes,
-        direct_length=d_length,
-        direct_deliver=d_deliver,
-    )
+        evictions=evictions)
 
 
 # ---------------------------------------------------------------------------
@@ -203,6 +258,39 @@ def routing_step_single(state: RouterState, batch: IngressBatch
                         ) -> RouteResult:
     """Single-chip step (mesh of one): the compile-checked `entry()` path."""
     return routing_step(state, batch, jnp.int32(0), axis_name=None)
+
+
+@jax.jit
+def routing_step_lanes_single(state: RouterState, batches: tuple,
+                              directs: tuple = ()) -> MultiRouteResult:
+    """Single-chip lane step (a change in the number of lanes is a pytree
+    structure change, so jit retraces per lane-set shape)."""
+    return routing_step_lanes(state, batches, jnp.int32(0), axis_name=None,
+                              directs=directs)
+
+
+def make_mesh_lane_step(mesh: Mesh):
+    """Build the multi-chip lane step: every leaf of (state, batches,
+    directs) is stacked on a leading broker axis and sharded over the mesh;
+    one jitted shard_map program routes all lanes (per-lane all_gather /
+    all_to_all over ICI, one shared CRDT merge)."""
+
+    def per_shard(state: RouterState, batches: tuple, directs: tuple):
+        state = jax.tree.map(lambda x: x[0], state)
+        batches = jax.tree.map(lambda x: x[0], batches)
+        directs = jax.tree.map(lambda x: x[0], directs)
+        my = jax.lax.axis_index(BROKER_AXIS).astype(jnp.int32)
+        result = routing_step_lanes(state, batches, my,
+                                    axis_name=BROKER_AXIS, directs=directs)
+        return jax.tree.map(lambda x: x[None], result)
+
+    sharded = jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(BROKER_AXIS), P(BROKER_AXIS), P(BROKER_AXIS)),
+        out_specs=P(BROKER_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
 
 
 def make_mesh_routing_step(mesh: Mesh, with_direct: bool = False):

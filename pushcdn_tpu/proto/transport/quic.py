@@ -43,6 +43,7 @@ Packet layout (all integers big-endian):
 from __future__ import annotations
 
 import asyncio
+import errno
 import os
 import struct
 import time
@@ -469,6 +470,12 @@ class _ClientEndpoint(asyncio.DatagramProtocol):
             self.stream.on_packet(ptype, data[_HDR.size:])
 
     def error_received(self, exc):
+        # EMSGSIZE only means a path-MTU probe exceeded the link (the DF
+        # bit is set for DPLPMTUD): the probe simply goes unacknowledged
+        # and the smaller MTU stands. Poisoning here would kill every
+        # connection on real (non-loopback) paths ~150 ms after connect.
+        if isinstance(exc, OSError) and exc.errno == errno.EMSGSIZE:
+            return
         if self.stream is not None:
             self.stream._poison(exc)
 

@@ -323,12 +323,21 @@ async def test_device_plane_routes_broker_traffic():
         await wait_until(lambda: device.messages_routed >= 3)
         assert device.steps >= 1
 
-        # oversized: falls back to the host path, still delivered
-        big = b"z" * 4096  # > frame_bytes=1024
+        # mid-size: too big for the 1 KB base lane, rides the default
+        # 16 KB extra lane on device (hard-part #1 size bucketing)
+        mid = b"z" * 4096
+        routed_before = device.messages_routed
+        await alice.send_direct_message(bob.public_key, mid)
+        got4 = await asyncio.wait_for(bob.receive_message(), 10)
+        assert bytes(got4.message) == mid
+        await wait_until(lambda: device.messages_routed == routed_before + 1)
+
+        # oversized beyond every lane: falls back to the host path
+        big = b"z" * 30_000  # > the 16 KB widest lane
         routed_before = device.messages_routed
         await alice.send_direct_message(bob.public_key, big)
-        got4 = await asyncio.wait_for(bob.receive_message(), 10)
-        assert bytes(got4.message) == big
+        got5 = await asyncio.wait_for(bob.receive_message(), 10)
+        assert bytes(got5.message) == big
         assert device.messages_routed == routed_before  # host path took it
         alice.close()
         bob.close()

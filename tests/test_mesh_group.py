@@ -30,9 +30,10 @@ _UID = itertools.count()
 class MeshCluster:
     """N broker shards on the device mesh + marshal, users over Memory."""
 
-    def __init__(self, num_shards: int = 4):
+    def __init__(self, num_shards: int = 4, extra_lanes: tuple = ()):
         self.uid = next(_UID)
         self.num_shards = num_shards
+        self.extra_lanes = extra_lanes
         self.db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-mesh-"),
                                "d.sqlite")
         self.run_def = make_run_def()
@@ -45,7 +46,7 @@ class MeshCluster:
         mesh = make_broker_mesh(self.num_shards)
         self.group = MeshBrokerGroup(mesh, MeshGroupConfig(
             num_user_slots=64, ring_slots=32, frame_bytes=1024,
-            batch_window_s=0.002))
+            extra_lanes=self.extra_lanes, batch_window_s=0.002))
         for i in range(self.num_shards):
             b = await Broker.new(BrokerConfig(
                 run_def=self.run_def, keypair=self.keypair,
@@ -260,6 +261,45 @@ async def test_overflow_traffic_triggers_host_links_in_mesh_only_mode():
         pending = asyncio.create_task(bob.receive_message())
         await asyncio.sleep(0.3)
         assert not pending.done()  # no duplicate via host + mesh
+        pending.cancel()
+        alice.close()
+        bob.close()
+    finally:
+        await cluster.stop()
+
+
+async def test_size_bucketed_lanes_carry_large_frames_on_mesh():
+    """Hard-part #1: with an extra 16 KB lane configured, frames too big
+    for the base 1 KB lane still cross shards on the device mesh (no host
+    links exist to fall back to), while small frames ride the base lane —
+    each delivered exactly once."""
+    cluster = await MeshCluster(
+        num_shards=2, extra_lanes=((16384, 8, 4),),
+    ).start(form_host_mesh=False)
+    try:
+        alice = await cluster.place_client(seed=700, shard=0, topics=[1])
+        bob = await cluster.place_client(seed=701, shard=1, topics=[1])
+        for b in cluster.brokers:
+            assert b.connections.num_brokers == 0  # mesh-only
+
+        big = b"L" * 8000   # > base lane (1 KB), fits the 16 KB lane
+        await alice.send_broadcast_message([1], big)
+        got = await asyncio.wait_for(bob.receive_message(), 10)
+        assert bytes(got.message) == big
+        assert not cluster.group.overflow_seen  # the lane carried it
+
+        # direct frames use the lane buckets the same way
+        await alice.send_direct_message(bob.public_key, b"D" * 4000)
+        got2 = await asyncio.wait_for(bob.receive_message(), 10)
+        assert bytes(got2.message) == b"D" * 4000
+
+        await alice.send_broadcast_message([1], b"small lane")
+        got3 = await asyncio.wait_for(bob.receive_message(), 10)
+        assert bytes(got3.message) == b"small lane"
+
+        pending = asyncio.create_task(bob.receive_message())
+        await asyncio.sleep(0.3)
+        assert not pending.done()  # exactly-once across lanes
         pending.cancel()
         alice.close()
         bob.close()
