@@ -49,7 +49,7 @@ import numpy as np
 from pushcdn_tpu.broker.staging import StageResult
 from pushcdn_tpu.broker.tasks.senders import try_send_to_user_nowait
 from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
-from pushcdn_tpu.parallel.frames import FrameRing, UserSlots
+from pushcdn_tpu.parallel.frames import FrameRing, UserSlots, stage_best_fit
 from pushcdn_tpu.parallel.router import (
     IngressBatch,
     RouterState,
@@ -174,27 +174,20 @@ class DevicePlane:
             mask = self._mask_of(message.topics)
             if mask == 0:
                 return StageResult.INELIGIBLE
-            ok = self._push(frame, lambda r: r.push_broadcast(frame, mask))
+            ok = stage_best_fit(self.rings, len(frame),
+                                lambda r: r.push_broadcast(frame, mask))
         elif isinstance(message, Direct):
             slot = self.slots.slot_of(bytes(message.recipient))
             if slot is None:
                 return StageResult.INELIGIBLE  # not mirrored (cross-broker)
-            ok = self._push(frame, lambda r: r.push_direct(frame, slot))
+            ok = stage_best_fit(self.rings, len(frame),
+                                lambda r: r.push_direct(frame, slot))
         else:
             return StageResult.INELIGIBLE
         if ok:
             self._kick.set()
             return StageResult.STAGED
         return StageResult.FULL
-
-    def _push(self, frame: bytes, push) -> bool:
-        """Best-fit lane staging: the smallest lane the frame fits, spilling
-        upward when it's full (a wider slot just pads more); False only when
-        every eligible lane is full (slot-credit backpressure)."""
-        for ring in self.rings:
-            if len(frame) <= ring.frame_bytes and push(ring):
-                return True
-        return False
 
     def covered_broker_idents(self) -> set:
         """Broker identifiers whose delivery this plane covers — none for
@@ -211,8 +204,14 @@ class DevicePlane:
     def _warmup(self) -> None:
         empty = [r.take_batch() for r in self.rings]
         try:
-            self._run_step(empty, self._owned.copy(), self._masks.copy())
-            self.steps -= 1  # warmup doesn't count
+            # compile the two common lane subsets off the hot path: all
+            # lanes busy, and base-lane-only (steady state for small
+            # messages); other subsets jit-compile on first use
+            self._run_step(empty, self._owned.copy(), self._masks.copy(),
+                           keep_idle_lanes=True)
+            self._run_step(empty[:1], self._owned.copy(), self._masks.copy(),
+                           keep_idle_lanes=True)
+            self.steps -= 2  # warmup doesn't count
         except Exception:
             logger.exception("device-plane warmup step failed")
             self.disabled = True
@@ -262,9 +261,13 @@ class DevicePlane:
                 for slot in quarantined:  # safe to recycle now
                     self.slots.free_slot(slot)
 
-    def _run_step(self, lane_batches, owned: np.ndarray, masks: np.ndarray):
+    def _run_step(self, lane_batches, owned: np.ndarray, masks: np.ndarray,
+                  keep_idle_lanes: bool = False):
         """Blocking device step (runs in a worker thread) against the
-        snapshotted mirrors. All lanes ride one jitted program."""
+        snapshotted mirrors. All busy lanes ride one jitted program; idle
+        lanes are dropped before the H2D transfer — an empty lane delivers
+        nothing, so skipping it is semantically free, and each lane subset
+        is its own (cached) jit specialization."""
         import jax.numpy as jnp
         state = RouterState(
             crdt=CrdtState(
@@ -279,7 +282,7 @@ class DevicePlane:
                 jnp.asarray(b.bytes_), jnp.asarray(b.kind),
                 jnp.asarray(b.length), jnp.asarray(b.topic_mask),
                 jnp.asarray(b.dest), jnp.asarray(b.valid))
-            for b in lane_batches)
+            for b in lane_batches if keep_idle_lanes or b.valid.any())
         result = routing_step_lanes_single(state, batches)
         self.steps += 1
         return [(np.asarray(lane.deliver), np.asarray(lane.gathered_length),

@@ -41,7 +41,12 @@ import numpy as np
 
 from pushcdn_tpu.broker.tasks.senders import try_send_to_user_nowait
 from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
-from pushcdn_tpu.parallel.frames import DirectBuckets, FrameRing, UserSlots
+from pushcdn_tpu.parallel.frames import (
+    DirectBuckets,
+    FrameRing,
+    UserSlots,
+    stage_best_fit,
+)
 from pushcdn_tpu.parallel.router import (
     BROKER_AXIS,
     DirectIngress,
@@ -161,6 +166,9 @@ class MeshBrokerGroup:
         self._masks = np.zeros(c.num_user_slots, np.uint32)
         self._quarantine: List[int] = []
         self._unmirrored: set[bytes] = set()
+        # dynamic membership over the static mesh (hard-part #3): a stopped
+        # shard is masked dead in-step rather than re-forming the mesh
+        self._liveness = np.zeros(self.num_shards, bool)
         self.disabled = False
         # set when traffic falls outside what the mesh step can carry —
         # heartbeats then form host links even in mesh-only deployments
@@ -178,6 +186,7 @@ class MeshBrokerGroup:
         Broker.new, before Broker.start)."""
         plane = MeshShardPlane(self, shard)
         self.brokers[shard] = broker
+        self._liveness[shard] = True
         broker.device_plane = plane
         broker.connections.observer = plane
         self._member_idents = None  # recompute lazily
@@ -203,15 +212,23 @@ class MeshBrokerGroup:
         batches = [[r.take_batch() for r in rings] for rings in self.lane_rings]
         directs = [[b.take_batch() for b in bkts] for bkts in self.lane_buckets]
         try:
+            # compile the two common lane subsets: everything busy, and
+            # base-lane-only (steady state for small messages)
             self._run_step(batches, directs, self._owner.copy(),
-                           self._claim_version.copy(), self._masks.copy())
-            self.steps -= 1  # warmup doesn't count
+                           self._claim_version.copy(), self._masks.copy(),
+                           keep_idle_lanes=True)
+            self._run_step(batches[:1], directs[:1], self._owner.copy(),
+                           self._claim_version.copy(), self._masks.copy(),
+                           keep_idle_lanes=True)
+            self.steps -= 2  # warmup doesn't count
         except Exception:
             logger.exception("mesh-group warmup step failed")
             self.disabled = True
 
     async def on_shard_stopped(self, shard: int) -> None:
         self.brokers[shard] = None
+        self._liveness[shard] = False
+        self._member_idents = None
         if all(b is None for b in self.brokers) and self._task is not None:
             self._task.cancel()
             try:
@@ -303,10 +320,9 @@ class MeshBrokerGroup:
             mask = _mask_of(message.topics)
             if mask == 0:
                 return StageResult.INELIGIBLE  # no valid topics: no-op send
-            # best-fit lane, spilling to wider lanes when full
-            ok = any(len(frame) <= rings[shard].frame_bytes
-                     and rings[shard].push_broadcast(frame, mask)
-                     for rings in self.lane_rings)
+            ok = stage_best_fit(
+                [rings[shard] for rings in self.lane_rings], len(frame),
+                lambda r: r.push_broadcast(frame, mask))
         elif isinstance(message, Direct):
             slot = self.slots.slot_of(bytes(message.recipient))
             if slot is None:
@@ -316,9 +332,9 @@ class MeshBrokerGroup:
             if owner == ABSENT:
                 return self._overflow()
             # one-hop ICI path: bucket by owner shard for the all_to_all
-            ok = any(len(frame) <= bkts[shard].frame_bytes
-                     and bkts[shard].push(owner, frame, slot)
-                     for bkts in self.lane_buckets)
+            ok = stage_best_fit(
+                [bkts[shard] for bkts in self.lane_buckets], len(frame),
+                lambda b: b.push(owner, frame, slot))
         else:
             return StageResult.INELIGIBLE
         if ok:
@@ -346,10 +362,12 @@ class MeshBrokerGroup:
             owner = self._owner.copy()
             versions = self._claim_version.copy()
             masks = self._masks.copy()
+            liveness = self._liveness.copy()
             quarantined, self._quarantine = self._quarantine, []
             try:
                 lanes, direct_lanes = await asyncio.to_thread(
-                    self._run_step, batches, directs, owner, versions, masks)
+                    self._run_step, batches, directs, owner, versions, masks,
+                    liveness)
                 for deliver, lengths, frames in lanes:
                     self._egress(deliver, lengths, frames)
                 for deliver, lengths, frames in direct_lanes:
@@ -377,12 +395,21 @@ class MeshBrokerGroup:
                 for slot in quarantined:
                     self.slots.free_slot(slot)
 
-    def _run_step(self, batches, directs, owner, versions, masks):
+    def _run_step(self, batches, directs, owner, versions, masks,
+                  liveness=None, keep_idle_lanes: bool = False):
         """Blocking multi-shard device step (worker thread). ``batches`` and
-        ``directs`` are [lane][shard] host snapshots; all lanes ride ONE
-        jitted shard_map program with one shared CRDT merge."""
+        ``directs`` are [lane][shard] host snapshots; busy lanes ride ONE
+        jitted shard_map program with one shared CRDT merge. Lanes idle on
+        EVERY shard are dropped before the H2D transfer (an empty lane
+        delivers nothing; each lane subset is its own cached jit
+        specialization), so an idle wide lane costs no ICI traffic."""
         import jax.numpy as jnp
         B = self.num_shards
+        if not keep_idle_lanes:
+            batches = [lane for lane in batches
+                       if any(b.valid.any() for b in lane)]
+            directs = [lane for lane in directs
+                       if any(d.valid.any() for d in lane)]
         # every shard's state row is the (shared) global view; on real
         # multi-host pods these rows diverge and the in-step merge converges
         # them — the device program is identical
@@ -410,7 +437,9 @@ class MeshBrokerGroup:
                 jnp.asarray(np.stack([d.dest for d in lane])),
                 jnp.asarray(np.stack([d.valid for d in lane])))
             for lane in directs)
-        result = self.step_fn(state, lane_batches, lane_directs)
+        live = (np.ones(B, bool) if liveness is None else liveness)
+        result = self.step_fn(state, lane_batches, lane_directs,
+                              jnp.asarray(np.broadcast_to(live, (B, B))))
         self.steps += 1
         lanes = [(np.asarray(l.deliver), np.asarray(l.gathered_length),
                   np.asarray(l.gathered_bytes)) for l in result.lanes]

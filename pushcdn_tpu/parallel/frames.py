@@ -127,6 +127,7 @@ class FrameRing:
         self._valid = np.zeros(slots, dtype=bool)
         self._next = 0
         self._used = 0
+        self._empty: Optional[FrameBatch] = None
 
     @property
     def free_slots(self) -> int:
@@ -223,7 +224,13 @@ class FrameRing:
 
     def take_batch(self) -> FrameBatch:
         """Snapshot the ring as one step's batch and clear it (slot credits
-        return to the host pump)."""
+        return to the host pump). An idle ring returns a cached all-zero
+        batch (batches are read-only downstream), so idle lanes cost no
+        copy per step."""
+        if self._used == 0:
+            if self._empty is None:
+                self._empty = empty_batch(self.slots, self.frame_bytes)
+            return self._empty
         batch = FrameBatch(
             bytes_=self._bytes.copy(), kind=self._kind.copy(),
             length=self._length.copy(), topic_mask=self._topic_mask.copy(),
@@ -268,6 +275,7 @@ class DirectBuckets:
         self._dest = np.full((num_shards, capacity), -1, np.int32)
         self._valid = np.zeros((num_shards, capacity), bool)
         self._used = np.zeros(num_shards, np.int64)
+        self._empty: Optional[DirectBatch] = None
 
     @property
     def total_used(self) -> int:
@@ -292,6 +300,11 @@ class DirectBuckets:
         return True
 
     def take_batch(self) -> DirectBatch:
+        if self.total_used == 0:  # idle: cached zero batch, no copies
+            if self._empty is None:
+                self._empty = empty_direct_batch(
+                    self.num_shards, self.capacity, self.frame_bytes)
+            return self._empty
         batch = DirectBatch(
             bytes_=self._bytes.copy(), length=self._length.copy(),
             dest=self._dest.copy(), valid=self._valid.copy())
@@ -310,6 +323,19 @@ def empty_direct_batch(num_shards: int, capacity: int,
         dest=np.full((num_shards, capacity), -1, np.int32),
         valid=np.zeros((num_shards, capacity), bool),
     )
+
+
+def stage_best_fit(lanes, size: int, push) -> bool:
+    """Stage into the smallest lane a ``size``-byte frame fits, spilling to
+    wider lanes when the best fit is full (a wider slot just pads more).
+    ``lanes`` must be sorted ascending by ``frame_bytes``; ``push(lane)``
+    does the actual staging and returns False when that lane is full.
+    Returns False only when every eligible lane is full (backpressure) —
+    callers pre-check ``size`` against the widest lane for eligibility."""
+    for lane in lanes:
+        if size <= lane.frame_bytes and push(lane):
+            return True
+    return False
 
 
 def empty_batch(slots: int, frame_bytes: int) -> FrameBatch:

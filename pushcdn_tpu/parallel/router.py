@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pushcdn_tpu.parallel.crdt import (
+    ABSENT,
     CrdtState,
     empty_state,
     merge_all_gathered_with_payload,
@@ -101,7 +102,8 @@ def empty_router_state(num_users: int) -> RouterState:
 
 
 def _direct_route(direct: DirectIngress, now_local: jax.Array,
-                  axis_name: Optional[str]):
+                  axis_name: Optional[str],
+                  liveness: Optional[jax.Array] = None):
     """Exchange per-destination buckets and build the local delivery mask.
 
     ``all_to_all`` swaps the destination-shard axis for a source-shard
@@ -117,6 +119,10 @@ def _direct_route(direct: DirectIngress, now_local: jax.Array,
         r_length = jax.lax.all_to_all(direct.length, axis_name, 0, 0)
         r_dest = jax.lax.all_to_all(direct.dest, axis_name, 0, 0)
         r_valid = jax.lax.all_to_all(direct.valid, axis_name, 0, 0)
+    if liveness is not None:
+        # axis 0 is the SOURCE shard post-exchange: a dead shard's stale
+        # frames (in flight when it was declared down) never deliver
+        r_valid = r_valid & liveness[:, None]
     B, C = r_dest.shape
     dest_f = r_dest.reshape(B * C)
     valid_f = r_valid.reshape(B * C)
@@ -188,6 +194,7 @@ def routing_step_lanes(state: RouterState,
                        my_index: jax.Array,
                        axis_name: Optional[str],
                        directs: tuple = (),
+                       liveness: Optional[jax.Array] = None,
                        ) -> MultiRouteResult:
     """One routing step over any number of size-bucketed lanes.
 
@@ -197,6 +204,17 @@ def routing_step_lanes(state: RouterState,
     merge runs ONCE; every lane's delivery matrix is computed against the
     same merged state, so cross-lane semantics are identical to a single
     ring — a lane is purely a shape bucket.
+
+    ``liveness`` (bool[B], identical on every shard) is the dynamic-
+    membership mask over the STATIC device mesh (SURVEY.md §7 hard-part
+    #3): the physical mesh can't churn the way the reference's broker
+    mesh does (heartbeat.rs:69-107), so a departed shard is instead
+    declared dead by the host control plane. In-step that means (a) its
+    gathered frames never deliver, and (b) every slot it owned is
+    tombstoned with a deterministic version bump — all shards compute the
+    identical release from the identical gathered state, so the CRDT
+    stays convergent, exactly like the reference aging a dead broker's
+    users out of the DirectMap.
     """
     def gather(x):
         if axis_name is None:
@@ -212,6 +230,18 @@ def routing_step_lanes(state: RouterState,
     merged, masks, _changed = merge_all_gathered_with_payload(
         state.crdt, state.topic_masks,
         CrdtState(g_owners, g_versions, g_ids), g_masks)
+    if liveness is not None:
+        # release every slot owned by a dead shard (owner index is a mesh
+        # coordinate; ABSENT maps to "live" so tombstones pass through)
+        owner_live = jnp.where(merged.owners == ABSENT, True,
+                               liveness[jnp.clip(merged.owners, 0)])
+        merged = CrdtState(
+            owners=jnp.where(owner_live, merged.owners, ABSENT),
+            versions=jnp.where(owner_live, merged.versions,
+                               merged.versions + 1),
+            identities=merged.identities,
+        )
+        masks = jnp.where(owner_live, masks, 0)
     now_local = merged.owners == my_index
     evictions = was_local & ~now_local
 
@@ -225,6 +255,8 @@ def routing_step_lanes(state: RouterState,
         g_dest = gather(batch.dest)
         g_valid = gather(batch.valid)
         B, S = g_kind.shape
+        if liveness is not None:
+            g_valid = g_valid & liveness[:, None]  # dead shards' frames
         valid_f = g_valid.reshape(B * S)
         kind_f = jnp.where(valid_f, g_kind.reshape(B * S), 0)
         deliver = delivery_matrix(
@@ -238,7 +270,7 @@ def routing_step_lanes(state: RouterState,
     direct_lanes = []
     for direct in directs:
         d_bytes, d_length, d_deliver = _direct_route(
-            direct, now_local, axis_name)
+            direct, now_local, axis_name, liveness)
         direct_lanes.append(LaneDelivery(
             gathered_bytes=d_bytes, gathered_length=d_length,
             deliver=d_deliver))
@@ -273,24 +305,36 @@ def make_mesh_lane_step(mesh: Mesh):
     """Build the multi-chip lane step: every leaf of (state, batches,
     directs) is stacked on a leading broker axis and sharded over the mesh;
     one jitted shard_map program routes all lanes (per-lane all_gather /
-    all_to_all over ICI, one shared CRDT merge)."""
+    all_to_all over ICI, one shared CRDT merge). ``liveness`` is stacked
+    [B, B] (every shard carries the full membership mask)."""
 
-    def per_shard(state: RouterState, batches: tuple, directs: tuple):
+    def per_shard(state: RouterState, batches: tuple, directs: tuple,
+                  liveness: jax.Array):
         state = jax.tree.map(lambda x: x[0], state)
         batches = jax.tree.map(lambda x: x[0], batches)
         directs = jax.tree.map(lambda x: x[0], directs)
         my = jax.lax.axis_index(BROKER_AXIS).astype(jnp.int32)
         result = routing_step_lanes(state, batches, my,
-                                    axis_name=BROKER_AXIS, directs=directs)
+                                    axis_name=BROKER_AXIS, directs=directs,
+                                    liveness=liveness[0])
         return jax.tree.map(lambda x: x[None], result)
 
     sharded = jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(BROKER_AXIS), P(BROKER_AXIS), P(BROKER_AXIS)),
+        in_specs=(P(BROKER_AXIS), P(BROKER_AXIS), P(BROKER_AXIS),
+                  P(BROKER_AXIS)),
         out_specs=P(BROKER_AXIS),
         check_vma=False,
     )
-    return jax.jit(sharded)
+
+    @jax.jit
+    def step(state, batches, directs, liveness=None):
+        if liveness is None:
+            B = mesh.devices.size
+            liveness = jnp.ones((B, B), dtype=bool)
+        return sharded(state, batches, directs, liveness)
+
+    return step
 
 
 def make_mesh_routing_step(mesh: Mesh, with_direct: bool = False):

@@ -470,11 +470,15 @@ class _ClientEndpoint(asyncio.DatagramProtocol):
             self.stream.on_packet(ptype, data[_HDR.size:])
 
     def error_received(self, exc):
-        # EMSGSIZE only means a path-MTU probe exceeded the link (the DF
-        # bit is set for DPLPMTUD): the probe simply goes unacknowledged
-        # and the smaller MTU stands. Poisoning here would kill every
-        # connection on real (non-loopback) paths ~150 ms after connect.
+        # EMSGSIZE means a DF-bit datagram exceeded the path (RFC 8899):
+        # for a probe that's expected (it just goes unacknowledged); for
+        # DATA after a route change it means the negotiated MTU no longer
+        # holds — clamp back to the floor so retransmissions fit, instead
+        # of poisoning (which would kill every connection on real
+        # non-loopback paths ~150 ms after connect when probing starts).
         if isinstance(exc, OSError) and exc.errno == errno.EMSGSIZE:
+            if self.stream is not None:
+                self.stream._mtu = MTU_PAYLOAD
             return
         if self.stream is not None:
             self.stream._poison(exc)
@@ -529,6 +533,14 @@ class _ServerEndpoint(asyncio.DatagramProtocol):
     def _drop(self, conn_id: int) -> None:
         self.streams.pop(conn_id, None)
         self.addrs.pop(conn_id, None)
+
+    def error_received(self, exc):
+        # the OS doesn't say which peer the EMSGSIZE belongs to on a
+        # shared socket: clamp every stream's MTU back to the floor (the
+        # prober re-grows the ones whose paths still carry more)
+        if isinstance(exc, OSError) and exc.errno == errno.EMSGSIZE:
+            for stream in self.streams.values():
+                stream._mtu = MTU_PAYLOAD
 
 
 class _QuicUnfinalized(UnfinalizedConnection):

@@ -305,3 +305,40 @@ async def test_size_bucketed_lanes_carry_large_frames_on_mesh():
         bob.close()
     finally:
         await cluster.stop()
+
+
+async def test_shard_departure_survivors_keep_routing():
+    """Hard-part #3 at the group level: one shard of a 3-shard mesh-only
+    group stops; the static device mesh stays up, the stopped shard is
+    masked dead, and the survivors keep exchanging traffic over the mesh
+    with no host links and no group disable."""
+    cluster = await MeshCluster(num_shards=3).start(form_host_mesh=False)
+    try:
+        alice = await cluster.place_client(seed=800, shard=0, topics=[0])
+        bob = await cluster.place_client(seed=801, shard=1, topics=[0])
+        carol = await cluster.place_client(seed=802, shard=2, topics=[0])
+
+        await alice.send_broadcast_message([0], b"all three")
+        for c in (alice, bob, carol):
+            got = await asyncio.wait_for(c.receive_message(), 10)
+            assert bytes(got.message) == b"all three"
+
+        # shard 2 departs (its client goes with it)
+        carol.close()
+        await cluster.brokers[2].stop()
+        assert not cluster.group._liveness[2]
+        assert not cluster.group.disabled
+
+        await alice.send_broadcast_message([0], b"survivors")
+        for c in (alice, bob):
+            got = await asyncio.wait_for(c.receive_message(), 10)
+            assert bytes(got.message) == b"survivors"
+        await alice.send_direct_message(bob.public_key, b"still one hop")
+        got = await asyncio.wait_for(bob.receive_message(), 10)
+        assert bytes(got.message) == b"still one hop"
+        for b in cluster.brokers[:2]:
+            assert b.connections.num_brokers == 0  # still mesh-only
+        alice.close()
+        bob.close()
+    finally:
+        await cluster.stop()

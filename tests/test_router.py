@@ -363,3 +363,60 @@ def test_lane_step_single_and_mesh():
     # CRDT converged identically on every shard
     merged = np.asarray(out.state.crdt.owners)
     assert (merged[0] == merged).all()
+
+
+def test_liveness_mask_dead_shard():
+    """Hard-part #3 (dynamic membership on a static mesh): a shard marked
+    dead contributes no deliveries, and slots it owned are tombstoned by an
+    identical deterministic release on every live shard."""
+    import jax.numpy as jnp
+    from pushcdn_tpu.parallel.router import make_mesh_lane_step
+
+    n = 8
+    dead = 3
+    mesh = make_broker_mesh(n)
+    step = make_mesh_lane_step(mesh)
+    owners = np.full((n, U), ABSENT, np.int32)
+    versions = np.zeros((n, U), np.uint32)
+    ids = np.full((n, U), ABSENT, np.int32)
+    masks = np.zeros((n, U), np.uint32)
+    for i in range(n):
+        owners[i, i] = i
+        versions[i, i] = 1
+        ids[i, i] = i
+        masks[i, i] = 0b1
+    state = RouterState(
+        CrdtState(jnp.asarray(owners), jnp.asarray(versions),
+                  jnp.asarray(ids)), jnp.asarray(masks))
+    parts = []
+    for i in range(n):
+        r = FrameRing(slots=4, frame_bytes=64)
+        r.push_broadcast(b"from %d" % i, 0b1)
+        parts.append(r.take_batch())
+    batch = IngressBatch(
+        jnp.asarray(np.stack([p.bytes_ for p in parts])),
+        jnp.asarray(np.stack([p.kind for p in parts])),
+        jnp.asarray(np.stack([p.length for p in parts])),
+        jnp.asarray(np.stack([p.topic_mask for p in parts])),
+        jnp.asarray(np.stack([p.dest for p in parts])),
+        jnp.asarray(np.stack([p.valid for p in parts])))
+    live = np.ones(n, bool)
+    live[dead] = False
+    out = step(state, (batch,), (),
+               jnp.asarray(np.broadcast_to(live, (n, n))))
+    deliver = np.asarray(out.lanes[0].deliver)
+    # the dead shard's broadcast delivers nowhere; everyone else's reaches
+    # the n-1 live owned users (the dead shard's user slot was released)
+    merged_owners = np.asarray(out.state.crdt.owners)
+    assert (merged_owners[0] == merged_owners).all()  # still convergent
+    assert (merged_owners[:, dead] == ABSENT).all()   # tombstoned
+    # per shard: slots delivered = live users x live frames
+    for shard in range(n):
+        d = deliver[shard]
+        # frames are ordered [src_shard * slots + slot]
+        dead_frame_cols = d[:, dead * 4:(dead + 1) * 4]
+        assert not dead_frame_cols.any(), "dead shard's frames delivered"
+    total = deliver.sum()
+    assert total == (n - 1) * (n - 1), total  # 7 live frames x 7 live users
+    # released slots' masks were cleared with the claim
+    assert (np.asarray(out.state.topic_masks)[:, dead] == 0).all()
