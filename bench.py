@@ -20,7 +20,9 @@ tensor batches.
 
 from __future__ import annotations
 
+import argparse
 import json
+import sys
 import time
 
 import numpy as np
@@ -68,6 +70,14 @@ def build_inputs(seed: int = 0):
 
 
 def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture a JAX/XLA device trace of the timed loop "
+                         "into DIR (view with TensorBoard / xprof) — the "
+                         "flamegraph analog of the reference's pprof-in-"
+                         "criterion integration")
+    args = ap.parse_args()
+
     state, batch = build_inputs()
 
     # warmup / compile
@@ -79,6 +89,9 @@ def main() -> None:
     # timing-noisy; the fastest window reflects the device's real rate
     steps, repeats = 100, 3
     best_dt = float("inf")
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
+        print(f"# tracing to {args.profile}", file=sys.stderr)
     for _ in range(repeats):
         t0 = time.perf_counter()
         for _ in range(steps):
@@ -86,6 +99,8 @@ def main() -> None:
             state = result.state
         jax.block_until_ready(result.deliver)
         best_dt = min(best_dt, time.perf_counter() - t0)
+    if args.profile:
+        jax.profiler.stop_trace()
 
     msgs_per_sec = steps * S / best_dt
     print(json.dumps({

@@ -229,6 +229,21 @@ class MeshBrokerGroup:
         self.brokers[shard] = None
         self._liveness[shard] = False
         self._member_idents = None
+        # Release every slot the dead shard still owned: a crashed broker
+        # never fires per-user removals, and without this sweep directs to
+        # its users would be acked STAGED and dropped at the tombstone
+        # (and the slot table would leak). With the mapping gone,
+        # try_stage sees an unknown recipient and overflows to the host
+        # path — the same "failure is an I/O error, route around it"
+        # posture as the reference.
+        for slot in np.nonzero(self._owner == shard)[0]:
+            key = self.slots.key_of(int(slot))
+            if key is not None:
+                self.slots.unmap(key)
+            self._owner[slot] = ABSENT
+            self._claim_version[slot] += 1
+            self._masks[slot] = 0
+            self._quarantine.append(int(slot))
         if all(b is None for b in self.brokers) and self._task is not None:
             self._task.cancel()
             try:

@@ -98,6 +98,10 @@ _DATA_OVERHEAD = _HDR.size + _OFF.size
 PROBE_DATAGRAM_SIZES = (4096, 16384, 65000)
 PROBE_ATTEMPTS = 3
 PROBE_INTERVAL_S = 0.15
+# EMSGSIZE within this window of a probe send is the probe itself bouncing
+# off a smaller link (expected; the probed MTU was validated by PROBEACK) —
+# outside it, it's the path shrinking under DATA and the MTU must clamp
+PROBE_GRACE_S = 1.0
 SEND_WINDOW = 512 * 1024         # unacked bytes before write blocks (floor)
 ACK_DELAY_S = 0.02               # delayed-ACK timer (in-order data)
 ACK_EVERY_BYTES = 64 * 1024      # ...or after this many unacked rx bytes
@@ -135,6 +139,7 @@ class _UdpStream(RawStream):
         self._finack = asyncio.Event()
         self._dup_acks = 0
         self._mtu = MTU_PAYLOAD                  # grows via path-MTU probing
+        self._last_probe_sent = 0.0
 
         # receive side
         self._expected = 0
@@ -295,9 +300,37 @@ class _UdpStream(RawStream):
                     if size - _DATA_OVERHEAD <= self._mtu:
                         continue
                     pad = size - _HDR.size - _PLEN.size
+                    self._last_probe_sent = time.monotonic()
                     self._tx(_PROBE, _PLEN.pack(size) + b"\x00" * pad)
         except asyncio.CancelledError:
             pass
+
+    def on_msgsize_error(self) -> None:
+        """A DF-bit datagram bounced (local EMSGSIZE or ICMP frag-needed).
+
+        Within the probe grace window this is an oversized PROBE being
+        rejected — expected, ignore (any _mtu growth was validated by a
+        PROBEACK that actually crossed the path). Otherwise the path
+        shrank under DATA: clamp to the floor AND re-segment unacked data,
+        because retransmissions resend stored segments verbatim and an
+        oversized one would bounce forever until MAX_RETX poisoned the
+        stream."""
+        if time.monotonic() - self._last_probe_sent < PROBE_GRACE_S:
+            return
+        if self._mtu <= MTU_PAYLOAD:
+            return
+        self._mtu = MTU_PAYLOAD
+        resplit: Dict[int, list] = {}
+        order = []
+        for off in sorted(self._unacked):
+            seg, _last_sent, retx = self._unacked[off]
+            for j in range(0, max(len(seg), 1), MTU_PAYLOAD):
+                # last_sent=0 ⇒ the RTO path re-sends the refitted
+                # segments promptly
+                resplit[off + j] = [seg[j:j + MTU_PAYLOAD], 0.0, retx]
+                order.append(off + j)
+        self._unacked = resplit
+        self._send_order = deque(order)
 
     # -- timers --------------------------------------------------------------
 
@@ -470,15 +503,14 @@ class _ClientEndpoint(asyncio.DatagramProtocol):
             self.stream.on_packet(ptype, data[_HDR.size:])
 
     def error_received(self, exc):
-        # EMSGSIZE means a DF-bit datagram exceeded the path (RFC 8899):
-        # for a probe that's expected (it just goes unacknowledged); for
-        # DATA after a route change it means the negotiated MTU no longer
-        # holds — clamp back to the floor so retransmissions fit, instead
-        # of poisoning (which would kill every connection on real
-        # non-loopback paths ~150 ms after connect when probing starts).
+        # EMSGSIZE means a DF-bit datagram exceeded the path (RFC 8899);
+        # the stream decides whether that's an expected probe bounce or a
+        # genuine path-MTU decrease (clamp + re-segment). Never poison for
+        # it — that would kill every connection on real non-loopback paths
+        # ~150 ms after connect when probing starts.
         if isinstance(exc, OSError) and exc.errno == errno.EMSGSIZE:
             if self.stream is not None:
-                self.stream._mtu = MTU_PAYLOAD
+                self.stream.on_msgsize_error()
             return
         if self.stream is not None:
             self.stream._poison(exc)
@@ -536,11 +568,11 @@ class _ServerEndpoint(asyncio.DatagramProtocol):
 
     def error_received(self, exc):
         # the OS doesn't say which peer the EMSGSIZE belongs to on a
-        # shared socket: clamp every stream's MTU back to the floor (the
-        # prober re-grows the ones whose paths still carry more)
+        # shared socket: let every stream decide (each ignores it while
+        # its own prober is active, clamps + re-segments otherwise)
         if isinstance(exc, OSError) and exc.errno == errno.EMSGSIZE:
-            for stream in self.streams.values():
-                stream._mtu = MTU_PAYLOAD
+            for stream in list(self.streams.values()):
+                stream.on_msgsize_error()
 
 
 class _QuicUnfinalized(UnfinalizedConnection):

@@ -342,3 +342,25 @@ async def test_shard_departure_survivors_keep_routing():
         bob.close()
     finally:
         await cluster.stop()
+
+
+async def test_dead_shard_sweep_releases_slots():
+    """on_shard_stopped must release every slot the dead shard still owned
+    (a crashed broker fires no per-user removals): directs to its users
+    then overflow to the host path instead of being staged at a ghost, and
+    the slot table doesn't leak."""
+    mesh = make_broker_mesh(2)
+    group = MeshBrokerGroup(mesh, MeshGroupConfig(
+        num_user_slots=8, ring_slots=4, frame_bytes=512, extra_lanes=()))
+    group._liveness[:] = True
+    group.claim_user(0, b"alice-key", [0])
+    group.claim_user(1, b"bob-key", [0])
+    assert len(group.slots) == 2
+
+    # shard 1 "crashes": declared dead without per-user removals
+    await group.on_shard_stopped(1)
+    assert group.slots.slot_of(b"bob-key") is None  # mapping swept
+    assert group.slots.slot_of(b"alice-key") is not None  # survivor intact
+    assert not group._liveness[1]
+    # swept slot is quarantined until the next step, then reusable
+    assert len(group._quarantine) == 1

@@ -134,3 +134,48 @@ async def test_limiter_backpressure_blocks_reader():
     second.release()
     a.close()
     b.close()
+
+
+async def test_quic_msgsize_clamp_and_resegment():
+    """A post-negotiation path-MTU decrease (EMSGSIZE outside the probe
+    grace window) clamps the MTU to the floor AND re-segments unacked
+    data so retransmissions fit; during the grace window (probe bounce)
+    it is a no-op."""
+    import time as _time
+    from pushcdn_tpu.proto.transport.quic import (
+        MTU_PAYLOAD, _UdpStream)
+
+    sent = []
+    stream = _UdpStream(1, sent.append)
+    try:
+        # pretend probing negotiated a jumbo path
+        stream._mtu = 16000
+        await stream.write(b"x" * 40000)
+        big_segs = dict(stream._unacked)
+        assert any(len(s[0]) > MTU_PAYLOAD for s in big_segs.values())
+
+        # 1) within the grace window: ignored (probe bounce)
+        stream._last_probe_sent = _time.monotonic()
+        stream.on_msgsize_error()
+        assert stream._mtu == 16000
+        assert stream._unacked == big_segs
+
+        # 2) outside the window: clamp + re-segment
+        stream._last_probe_sent = 0.0
+        stream.on_msgsize_error()
+        assert stream._mtu == MTU_PAYLOAD
+        assert all(len(s[0]) <= MTU_PAYLOAD
+                   for s in stream._unacked.values())
+        # byte coverage is identical after the re-split
+        covered = sorted((off, off + len(s[0]))
+                         for off, s in stream._unacked.items())
+        assert covered[0][0] == 0
+        for (a0, a1), (b0, _) in zip(covered, covered[1:]):
+            assert a1 == b0, "gap or overlap after resegmentation"
+        assert covered[-1][1] == 40000
+        assert list(stream._send_order) == [c[0] for c in covered]
+        # idempotent at the floor
+        stream.on_msgsize_error()
+        assert stream._mtu == MTU_PAYLOAD
+    finally:
+        stream.abort()
