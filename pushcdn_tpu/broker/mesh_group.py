@@ -165,7 +165,9 @@ class MeshBrokerGroup:
         self._claim_version = np.zeros(c.num_user_slots, np.uint32)
         self._masks = np.zeros(c.num_user_slots, np.uint32)
         self._quarantine: List[int] = []
-        self._unmirrored: set[bytes] = set()
+        # users the slot table couldn't hold, keyed to their shard so a
+        # dead shard's entries can be swept (a crash fires no releases)
+        self._unmirrored: Dict[bytes, int] = {}
         # dynamic membership over the static mesh (hard-part #3): a stopped
         # shard is masked dead in-step rather than re-forming the mesh
         self._liveness = np.zeros(self.num_shards, bool)
@@ -176,6 +178,7 @@ class MeshBrokerGroup:
         self._kick = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
         self._started = False
+        self._state_dirty = False  # forces a step with no staged traffic
         self.steps = 0
         self.messages_routed = 0
 
@@ -244,6 +247,16 @@ class MeshBrokerGroup:
             self._claim_version[slot] += 1
             self._masks[slot] = 0
             self._quarantine.append(int(slot))
+        # unmirrored users of the dead shard would otherwise pin every
+        # broadcast to the host path forever
+        for key in [k for k, s in self._unmirrored.items() if s == shard]:
+            del self._unmirrored[key]
+        # wake the pump even with no staged traffic: the tombstoned release
+        # must reach the device CRDT, already-staged frames to the dead
+        # shard must be flushed (dropped at the tombstone), and the
+        # quarantined slots must return to the free list
+        self._state_dirty = True
+        self._kick.set()
         if all(b is None for b in self.brokers) and self._task is not None:
             self._task.cancel()
             try:
@@ -261,7 +274,7 @@ class MeshBrokerGroup:
         try:
             slot = self.slots.assign(public_key)
         except Error:
-            self._unmirrored.add(public_key)
+            self._unmirrored[public_key] = shard
             logger.warning("mesh-group slot table full; %d unmirrored",
                            len(self._unmirrored))
             return
@@ -281,7 +294,7 @@ class MeshBrokerGroup:
                 try:
                     slot = self.slots.assign(public_key)
                 except Error:
-                    self._unmirrored.add(public_key)
+                    self._unmirrored[public_key] = shard
                     logger.warning(
                         "mesh-group slot table full after in-group kick; "
                         "%d unmirrored", len(self._unmirrored))
@@ -291,7 +304,7 @@ class MeshBrokerGroup:
         self._masks[slot] = _mask_of(topics)
 
     def release_user(self, shard: int, public_key: bytes) -> None:
-        self._unmirrored.discard(public_key)
+        self._unmirrored.pop(public_key, None)
         slot = self.slots.slot_of(public_key)
         if slot is None or int(self._owner[slot]) != shard:
             return  # not ours (already taken over by another shard)
@@ -364,11 +377,13 @@ class MeshBrokerGroup:
             await self._kick.wait()
             self._kick.clear()
             await asyncio.sleep(self.config.batch_window_s)
-            if all(r.free_slots == r.slots
-                   for rings in self.lane_rings for r in rings) and \
+            if not self._state_dirty and \
+                    all(r.free_slots == r.slots
+                        for rings in self.lane_rings for r in rings) and \
                     all(b.total_used == 0
                         for bkts in self.lane_buckets for b in bkts):
                 continue
+            self._state_dirty = False
             # one-tick snapshot: all lanes' rings + buckets + mirrors
             batches = [[r.take_batch() for r in rings]
                        for rings in self.lane_rings]

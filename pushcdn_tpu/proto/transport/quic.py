@@ -317,7 +317,11 @@ class _UdpStream(RawStream):
         stream."""
         if time.monotonic() - self._last_probe_sent < PROBE_GRACE_S:
             return
-        if self._mtu <= MTU_PAYLOAD:
+        if not any(len(s[0]) > MTU_PAYLOAD for s in self._unacked.values()):
+            # nothing of OURS bigger than the floor is in flight, so this
+            # bounce can't be our DATA (a bounced segment stays unacked) —
+            # it's another stream's probe on a shared server socket, or a
+            # stale ICMP. Don't punish this stream for it.
             return
         self._mtu = MTU_PAYLOAD
         resplit: Dict[int, list] = {}
@@ -331,6 +335,11 @@ class _UdpStream(RawStream):
                 order.append(off + j)
         self._unacked = resplit
         self._send_order = deque(order)
+        # the clamp may be a misattribution (shared socket) or the path
+        # may recover: restart the one-shot prober so a still-jumbo path
+        # re-grows within ~half a second instead of being floored forever
+        if self._prober.done():
+            self._prober = asyncio.create_task(self._probe_mtu())
 
     # -- timers --------------------------------------------------------------
 
@@ -417,16 +426,17 @@ class _UdpStream(RawStream):
         i = 0
         n = len(view)
         while i < n:
-            # segment size tracks the probed MTU (it can grow mid-write);
-            # the window scales with it so large segments keep pipelining
-            mtu = self._mtu
-            window = max(SEND_WINDOW, 32 * mtu)
-            while self._inflight() >= window:
+            while self._inflight() >= max(SEND_WINDOW, 32 * self._mtu):
                 if self._error is not None:
                     raise self._error
                 fut = asyncio.get_running_loop().create_future()
                 self._window_waiters.append(fut)
                 await fut
+            # read the MTU only after the window wait: it tracks the probed
+            # path (grows mid-write) and may have been CLAMPED while we
+            # were blocked — cutting with a stale larger value would emit a
+            # segment that bounces off the shrunken path forever
+            mtu = self._mtu
             seg = bytes(view[i:i + mtu])
             i += len(seg)
             off = self._next_off

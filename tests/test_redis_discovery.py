@@ -1,0 +1,186 @@
+"""Redis discovery logic, driven through a faithful in-memory fake client
+(the real ``redis`` package isn't in this environment; `Redis.new` is
+gated). Covers the same semantics the Embedded tests cover: heartbeat
+TTLs, least-connections incl. outstanding permits, single-use permit
+redemption, scoped vs global permits, whitelist (parity
+cdn-proto/src/discovery/redis.rs:38-327)."""
+
+import fnmatch
+
+import pytest
+
+from pushcdn_tpu.proto.discovery.base import BrokerIdentifier
+from pushcdn_tpu.proto.discovery.redis import Redis
+from pushcdn_tpu.proto.error import Error
+
+
+class FakeRedis:
+    """The subset of redis.asyncio the discovery client uses, with a
+    manually-advanced clock for TTL behavior."""
+
+    def __init__(self):
+        self.kv = {}        # key -> (value_bytes, expires_at | None)
+        self.sets = {}      # key -> set[bytes]
+        self.now = 0.0
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+    def _live(self, key):
+        ent = self.kv.get(key)
+        if ent is None:
+            return None
+        value, exp = ent
+        if exp is not None and self.now >= exp:
+            del self.kv[key]
+            return None
+        return value
+
+    # -- commands ----------------------------------------------------------
+    async def set(self, key, value, ex=None, nx=False):
+        if nx and self._live(key) is not None:
+            return None
+        if isinstance(value, int):
+            value = str(value).encode()
+        elif isinstance(value, str):
+            value = value.encode()
+        self.kv[key] = (value, self.now + ex if ex is not None else None)
+        return True
+
+    async def get(self, key):
+        return self._live(key)
+
+    async def getdel(self, key):
+        value = self._live(key)
+        self.kv.pop(key, None)
+        return value
+
+    async def delete(self, *keys):
+        for k in keys:
+            self.kv.pop(k, None)
+            self.sets.pop(k, None)
+
+    async def sadd(self, key, *members):
+        self.sets.setdefault(key, set()).update(members)
+
+    async def scard(self, key):
+        return len(self.sets.get(key, ()))
+
+    async def sismember(self, key, member):
+        return member in self.sets.get(key, set())
+
+    async def scan_iter(self, match="*"):
+        for key in list(self.kv):
+            if self._live(key) is not None and fnmatch.fnmatch(key, match):
+                yield key
+
+    def pipeline(self, transaction=False):
+        return FakePipeline(self)
+
+    async def aclose(self):
+        pass
+
+
+class FakePipeline:
+    def __init__(self, fake):
+        self.fake = fake
+        self.ops = []
+
+    def __getattr__(self, name):
+        def queue(*args, **kwargs):
+            self.ops.append((name, args, kwargs))
+            return self
+        return queue
+
+    async def execute(self):
+        out = []
+        for name, args, kwargs in self.ops:
+            out.append(await getattr(self.fake, name)(*args, **kwargs))
+        return out
+
+
+B1 = BrokerIdentifier("b1-pub", "b1-priv")
+B2 = BrokerIdentifier("b2-pub", "b2-priv")
+
+
+def make(fake, ident, global_permits=False):
+    return Redis(fake, ident, global_permits=global_permits)
+
+
+async def test_heartbeat_membership_and_ttl():
+    fake = FakeRedis()
+    r1, r2 = make(fake, B1), make(fake, B2)
+    await r1.perform_heartbeat(3, heartbeat_expiry_s=60)
+    await r2.perform_heartbeat(5, heartbeat_expiry_s=60)
+    others = await r1.get_other_brokers()
+    assert others == [B2]
+    # TTL: a broker that stops heartbeating ages out (redis.rs:93-99)
+    fake.advance(61)
+    await r1.perform_heartbeat(3, heartbeat_expiry_s=60)
+    assert await r1.get_other_brokers() == []
+
+
+async def test_least_connections_counts_permits():
+    fake = FakeRedis()
+    r1, r2 = make(fake, B1), make(fake, B2)
+    await r1.perform_heartbeat(2, 60)
+    await r2.perform_heartbeat(1, 60)
+    marshal = make(fake, None)
+    assert await marshal.get_with_least_connections() == B2
+    # two outstanding permits for b2 outweigh b1's one extra connection
+    await marshal.issue_permit(B2, 30, b"user-a")
+    await marshal.issue_permit(B2, 30, b"user-b")
+    assert await marshal.get_with_least_connections() == B1
+
+
+async def test_permit_single_use_and_scoping():
+    fake = FakeRedis()
+    marshal = make(fake, None)
+    permit = await marshal.issue_permit(B1, 30, b"alice")
+    assert permit > 1  # 0/1 are reserved response codes (message.rs:338)
+    broker = make(fake, B1)
+    # wrong broker: rejected (and consumed — GETDEL semantics)
+    p2 = await marshal.issue_permit(B1, 30, b"bob")
+    assert await broker.validate_permit(B2, p2) is None
+    # right broker: returns the public key, single-use
+    assert await broker.validate_permit(B1, permit) == b"alice"
+    assert await broker.validate_permit(B1, permit) is None
+
+
+async def test_global_permits_flag():
+    fake = FakeRedis()
+    marshal = make(fake, None)
+    permit = await marshal.issue_permit(B1, 30, b"carol")
+    broker = make(fake, B2, global_permits=True)
+    # with global permits any broker may redeem (discovery/redis.rs:219-226)
+    assert await broker.validate_permit(B2, permit) == b"carol"
+
+
+async def test_permit_expiry():
+    fake = FakeRedis()
+    marshal = make(fake, None)
+    permit = await marshal.issue_permit(B1, 30, b"dave")
+    fake.advance(31)
+    broker = make(fake, B1)
+    assert await broker.validate_permit(B1, permit) is None
+
+
+async def test_whitelist():
+    fake = FakeRedis()
+    r = make(fake, None)
+    # empty whitelist admits everyone
+    assert await r.check_whitelist(b"anyone")
+    await r.set_whitelist([b"alice", b"bob"])
+    assert await r.check_whitelist(b"alice")
+    assert not await r.check_whitelist(b"mallory")
+    # replacing the list drops old entries atomically
+    await r.set_whitelist([b"carol"])
+    assert not await r.check_whitelist(b"alice")
+    assert await r.check_whitelist(b"carol")
+
+
+async def test_no_brokers_is_an_error():
+    fake = FakeRedis()
+    marshal = make(fake, None)
+    with pytest.raises(Error):
+        await marshal.get_with_least_connections()
