@@ -17,7 +17,7 @@ cost lives. Multi-shard meshes route via parallel.router's shard_map step.
 
 Consistency design (single-writer, snapshot-per-step):
 
-- The **host mirrors** (``_owned`` bool[U], ``_masks`` u32[U]) are the
+- The **host mirrors** (``_owned`` bool[U], ``_masks`` u32[U, 8]) are the
   source of truth, mutated only on the event loop by the Connections
   observer hooks. Each step SNAPSHOTS them together with ``take_batch()``
   (same event-loop tick), and the device ``RouterState`` is rebuilt from
@@ -49,7 +49,14 @@ import numpy as np
 from pushcdn_tpu.broker.staging import StageResult
 from pushcdn_tpu.broker.tasks.senders import try_send_to_user_nowait
 from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
-from pushcdn_tpu.parallel.frames import FrameRing, UserSlots, stage_best_fit
+from pushcdn_tpu.parallel.frames import (
+    TOPIC_WORDS_FULL,
+    FrameRing,
+    UserSlots,
+    mask_of_topics,
+    mask_row_of,
+    stage_best_fit,
+)
 from pushcdn_tpu.parallel.router import (
     IngressBatch,
     RouterState,
@@ -75,6 +82,10 @@ class DevicePlaneConfig:
     # A frame is staged into the smallest lane it fits, so 100 B acks don't
     # ride 32 KB-padded slots and 16 KB proposals still stay on device.
     extra_lanes: tuple = ((16384, 64),)
+    # u32 words per topic mask: 8 covers the reference's whole u8 topic
+    # space; 1 keeps compact masks (and the native batch packer) for
+    # deployments with ≤32 topics
+    topic_words: int = TOPIC_WORDS_FULL
     # batch window: how long the pump waits to coalesce ingress into one
     # step (the latency ↔ step-efficiency knob)
     batch_window_s: float = 0.001
@@ -96,11 +107,15 @@ class DevicePlane:
         self.config = config or DevicePlaneConfig()
         c = self.config
         self.slots = UserSlots(c.num_user_slots)
-        self.rings = [FrameRing(slots=s, frame_bytes=f)
+        self.rings = [FrameRing(slots=s, frame_bytes=f,
+                                topic_words=c.topic_words)
                       for f, s in c.lane_shapes()]
-        # host mirrors — the single source of truth for device state
+        # host mirrors — the single source of truth for device state;
+        # mask shape tracks the configured topic-space width
         self._owned = np.zeros(c.num_user_slots, bool)
-        self._masks = np.zeros(c.num_user_slots, np.uint32)
+        self._masks = np.zeros(
+            c.num_user_slots if c.topic_words == 1
+            else (c.num_user_slots, c.topic_words), np.uint32)
         self._quarantine: List[int] = []   # slots awaiting step completion
         # users the slot table couldn't hold: broadcasts must stay on the
         # host path while any exist (they'd miss device-only fan-out)
@@ -128,7 +143,7 @@ class DevicePlane:
                            len(self._unmirrored))
             return
         self._owned[slot] = True
-        self._masks[slot] = self._mask_of(topics)
+        self._masks[slot] = mask_row_of(topics, self.config.topic_words)
 
     def on_user_removed(self, public_key: bytes) -> None:
         self._unmirrored.discard(public_key)
@@ -145,15 +160,7 @@ class DevicePlane:
         slot = self.slots.slot_of(public_key)
         if slot is None:
             return
-        self._masks[slot] = self._mask_of(topics)
-
-    @staticmethod
-    def _mask_of(topics) -> int:
-        mask = 0
-        for t in topics:
-            if int(t) < 32:  # the device mask covers topics 0..31
-                mask |= 1 << int(t)
-        return mask
+        self._masks[slot] = mask_row_of(topics, self.config.topic_words)
 
     # ---- ingress ----------------------------------------------------------
 
@@ -169,9 +176,10 @@ class DevicePlane:
         if isinstance(message, Broadcast):
             if self._unmirrored:
                 return StageResult.INELIGIBLE  # would miss unmirrored users
-            if any(int(t) >= 32 for t in message.topics):
-                return StageResult.INELIGIBLE  # beyond the u32 device mask
-            mask = self._mask_of(message.topics)
+            if any(int(t) >= 32 * self.config.topic_words
+                   for t in message.topics):
+                return StageResult.INELIGIBLE  # beyond the configured space
+            mask = mask_of_topics(message.topics, self.config.topic_words)
             if mask == 0:
                 return StageResult.INELIGIBLE
             ok = stage_best_fit(self.rings, len(frame),

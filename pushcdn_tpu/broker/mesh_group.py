@@ -42,9 +42,12 @@ import numpy as np
 from pushcdn_tpu.broker.tasks.senders import try_send_to_user_nowait
 from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
 from pushcdn_tpu.parallel.frames import (
+    TOPIC_WORDS_FULL,
     DirectBuckets,
     FrameRing,
     UserSlots,
+    mask_of_topics,
+    mask_row_of,
     stage_best_fit,
 )
 from pushcdn_tpu.parallel.router import (
@@ -75,6 +78,9 @@ class MeshGroupConfig:
     # into the smallest lane they fit, so big proposals ride ICI without
     # padding every small ack to the widest slot.
     extra_lanes: tuple = ((16384, 32, 8),)
+    # u32 words per topic mask: 8 covers the reference's whole u8 topic
+    # space; 1 keeps compact masks for deployments with ≤32 topics
+    topic_words: int = TOPIC_WORDS_FULL
     batch_window_s: float = 0.001
 
     def lane_shapes(self):
@@ -149,7 +155,7 @@ class MeshBrokerGroup:
         self.brokers: List[Optional["Broker"]] = [None] * self.num_shards
         # lane_rings[lane][shard] — size-bucketed broadcast staging
         self.lane_rings = [
-            [FrameRing(slots=s, frame_bytes=f)
+            [FrameRing(slots=s, frame_bytes=f, topic_words=c.topic_words)
              for _ in range(self.num_shards)]
             for f, s, _d in c.lane_shapes()]
         # direct frames go into per-destination-shard buckets and cross the
@@ -163,7 +169,10 @@ class MeshBrokerGroup:
         self.slots = UserSlots(c.num_user_slots)
         self._owner = np.full(c.num_user_slots, ABSENT, np.int32)
         self._claim_version = np.zeros(c.num_user_slots, np.uint32)
-        self._masks = np.zeros(c.num_user_slots, np.uint32)
+        # mask shape tracks the configured topic-space width
+        self._masks = np.zeros(
+            c.num_user_slots if c.topic_words == 1
+            else (c.num_user_slots, c.topic_words), np.uint32)
         self._quarantine: List[int] = []
         # users the slot table couldn't hold, keyed to their shard so a
         # dead shard's entries can be swept (a crash fires no releases)
@@ -301,7 +310,7 @@ class MeshBrokerGroup:
                     return
         self._owner[slot] = shard
         self._claim_version[slot] += 1
-        self._masks[slot] = _mask_of(topics)
+        self._masks[slot] = mask_row_of(topics, self.config.topic_words)
 
     def release_user(self, shard: int, public_key: bytes) -> None:
         self._unmirrored.pop(public_key, None)
@@ -317,7 +326,7 @@ class MeshBrokerGroup:
     def update_mask(self, shard: int, public_key: bytes, topics) -> None:
         slot = self.slots.slot_of(public_key)
         if slot is not None and int(self._owner[slot]) == shard:
-            self._masks[slot] = _mask_of(topics)
+            self._masks[slot] = mask_row_of(topics, self.config.topic_words)
 
     # ---- staging ----------------------------------------------------------
 
@@ -343,9 +352,10 @@ class MeshBrokerGroup:
         if isinstance(message, Broadcast):
             if self._unmirrored:
                 return self._overflow()
-            if any(int(t) >= 32 for t in message.topics):
+            if any(int(t) >= 32 * self.config.topic_words
+                   for t in message.topics):
                 return self._overflow()
-            mask = _mask_of(message.topics)
+            mask = mask_of_topics(message.topics, self.config.topic_words)
             if mask == 0:
                 return StageResult.INELIGIBLE  # no valid topics: no-op send
             ok = stage_best_fit(
@@ -562,10 +572,3 @@ class MeshBrokerGroup:
                 finally:
                     raw.release()
 
-
-def _mask_of(topics) -> int:
-    mask = 0
-    for t in topics:
-        if int(t) < 32:
-            mask |= 1 << int(t)
-    return mask

@@ -39,34 +39,46 @@ def delivery_matrix_reference(user_masks: jax.Array, local: jax.Array,
                               frame_tmask: jax.Array, kind: jax.Array,
                               dest: jax.Array) -> jax.Array:
     """Pure-jnp reference. ``local`` is bool[U] (owners == my_index);
-    ``kind`` must already be 0 on invalid slots."""
+    ``kind`` must already be 0 on invalid slots. Masks are either [U]/[N]
+    (one u32 word, topics 0..31) or [U, W]/[N, W] (multi-word masks
+    covering the reference's full u8 topic space at W=8)."""
     U = user_masks.shape[0]
     N = frame_tmask.shape[0]
     is_b = kind == KIND_BROADCAST
     is_d = kind == KIND_DIRECT
-    bcast = (user_masks[:, None] & frame_tmask[None, :]) != 0
+    if user_masks.ndim == 1:
+        bcast = (user_masks[:, None] & frame_tmask[None, :]) != 0
+    else:
+        bcast = ((user_masks[:, None, :] & frame_tmask[None, :, :]) != 0
+                 ).any(axis=-1)
     uidx = jax.lax.broadcasted_iota(jnp.int32, (U, N), 0)
     direct = dest[None, :] == uidx
     return ((bcast & is_b[None, :]) | (direct & is_d[None, :])) \
         & local[:, None]
 
 
-def _kernel(umask_ref, local_ref, tmask_ref, kind_ref, dest_ref, out_ref):
-    i = pl.program_id(0)
-    umask = umask_ref[:]            # [TILE_U, 1] uint32
-    local = local_ref[:]            # [TILE_U, 1] int32 (0/1)
-    tmask = tmask_ref[:]            # [1, TILE_N] uint32
-    kind = kind_ref[:]              # [1, TILE_N] int32
-    dest = dest_ref[:]              # [1, TILE_N] int32
+def _make_kernel(W: int):
+    def _kernel(umask_ref, local_ref, tmask_ref, kind_ref, dest_ref,
+                out_ref):
+        i = pl.program_id(0)
+        umask = umask_ref[:]            # [TILE_U, W] uint32
+        local = local_ref[:]            # [TILE_U, 1] int32 (0/1)
+        tmask = tmask_ref[:]            # [W, TILE_N] uint32
+        kind = kind_ref[:]              # [1, TILE_N] int32
+        dest = dest_ref[:]              # [1, TILE_N] int32
 
-    is_b = kind == KIND_BROADCAST
-    is_d = kind == KIND_DIRECT
-    bcast = (umask & tmask) != 0                    # [TILE_U, TILE_N]
-    # global user index of each tile row
-    row = jax.lax.broadcasted_iota(jnp.int32, (TILE_U, TILE_N), 0) \
-        + i * TILE_U
-    direct = dest == row
-    out_ref[:] = ((bcast & is_b) | (direct & is_d)) & (local != 0)
+        is_b = kind == KIND_BROADCAST
+        is_d = kind == KIND_DIRECT
+        # OR of the per-word AND — W is static, the loop unrolls
+        bcast = (umask[:, 0:1] & tmask[0:1, :]) != 0    # [TILE_U, TILE_N]
+        for w in range(1, W):
+            bcast |= (umask[:, w:w + 1] & tmask[w:w + 1, :]) != 0
+        # global user index of each tile row
+        row = jax.lax.broadcasted_iota(jnp.int32, (TILE_U, TILE_N), 0) \
+            + i * TILE_U
+        direct = dest == row
+        out_ref[:] = ((bcast & is_b) | (direct & is_d)) & (local != 0)
+    return _kernel
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -74,28 +86,30 @@ def delivery_matrix_pallas(user_masks: jax.Array, local: jax.Array,
                            frame_tmask: jax.Array, kind: jax.Array,
                            dest: jax.Array,
                            interpret: bool = False) -> jax.Array:
-    """Pallas version. Shapes: user_masks/local [U], frame arrays [N];
-    U must be a multiple of TILE_U and N of TILE_N (the router pads)."""
+    """Pallas version. Shapes: user_masks [U] or [U, W], local [U],
+    frame_tmask [N] or [N, W], kind/dest [N]; U must be a multiple of
+    TILE_U and N of TILE_N (the router pads)."""
     U = user_masks.shape[0]
     N = frame_tmask.shape[0]
+    W = 1 if user_masks.ndim == 1 else user_masks.shape[1]
     grid = (U // TILE_U, N // TILE_N)
     return pl.pallas_call(
-        _kernel,
+        _make_kernel(W),
         out_shape=jax.ShapeDtypeStruct((U, N), jnp.bool_),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((TILE_U, 1), lambda i, j: (i, 0)),       # user_masks
+            pl.BlockSpec((TILE_U, W), lambda i, j: (i, 0)),       # user_masks
             pl.BlockSpec((TILE_U, 1), lambda i, j: (i, 0)),       # local
-            pl.BlockSpec((1, TILE_N), lambda i, j: (0, j)),       # tmask
+            pl.BlockSpec((W, TILE_N), lambda i, j: (0, j)),       # tmask
             pl.BlockSpec((1, TILE_N), lambda i, j: (0, j)),       # kind
             pl.BlockSpec((1, TILE_N), lambda i, j: (0, j)),       # dest
         ],
         out_specs=pl.BlockSpec((TILE_U, TILE_N), lambda i, j: (i, j)),
         interpret=interpret,
     )(
-        user_masks.reshape(U, 1),
+        user_masks.reshape(U, W),
         local.astype(jnp.int32).reshape(U, 1),
-        frame_tmask.reshape(1, N),
+        frame_tmask.reshape(N, W).T,
         kind.reshape(1, N),
         dest.reshape(1, N),
     )

@@ -147,7 +147,10 @@ def merge_all_gathered_with_payload(
             versions=jnp.where(adopt, incoming.versions, state.versions),
             identities=jnp.where(adopt, incoming.identities, state.identities),
         )
-        new_payload = jnp.where(adopt, in_payload, payload)
+        # the payload may carry trailing dims beyond the slot axis (e.g.
+        # multi-word topic masks [U, W]): broadcast the adoption decision
+        a = adopt.reshape(adopt.shape + (1,) * (payload.ndim - adopt.ndim))
+        new_payload = jnp.where(a, in_payload, payload)
         changed = adopt & (incoming.owners != state.owners)
         return (new_state, new_payload, changed_any | changed), None
 

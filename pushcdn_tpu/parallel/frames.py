@@ -39,6 +39,45 @@ from pushcdn_tpu.proto.message import KIND_BROADCAST, KIND_DIRECT
 DEFAULT_FRAME_BYTES = 1024
 DEFAULT_SLOTS = 1024
 
+# The reference's topic type is a u8 (message.rs:26) — 256 possible topics.
+# A topic set on device is a multi-word u32 bitmask; 8 words cover the full
+# space. Rings are parameterized (``topic_words=1`` keeps the compact mask
+# for deployments with ≤32 topics).
+TOPIC_WORDS_FULL = 8
+MAX_TOPICS = 32 * TOPIC_WORDS_FULL
+
+
+def split_mask(mask: int, words: int) -> np.ndarray:
+    """Split an arbitrary-width Python-int topic mask into u32 words
+    (little-endian: word w holds topics 32w..32w+31)."""
+    out = np.zeros(words, np.uint32)
+    w = 0
+    while mask and w < words:
+        out[w] = mask & 0xFFFFFFFF
+        mask >>= 32
+        w += 1
+    return out
+
+
+def mask_of_topics(topics, words: int) -> int:
+    """Python-int bitmask of every topic representable in ``words`` u32
+    words; out-of-range topics are ignored (callers pre-check)."""
+    mask = 0
+    limit = 32 * words
+    for t in topics:
+        t = int(t)
+        if t < limit:
+            mask |= 1 << t
+    return mask
+
+
+def mask_row_of(topics, words: int):
+    """The mask-mirror row for a topic set: a u32 scalar when ``words`` is
+    1 (compact deployments, 1-D mirrors) or a uint32[words] row otherwise —
+    assignable to ``mirror[slot]`` either way."""
+    mask = mask_of_topics(topics, words)
+    return mask & 0xFFFFFFFF if words == 1 else split_mask(mask, words)
+
 
 class UserSlots:
     """Dense user-slot allocator: public key ↔ int slot (device identity)."""
@@ -116,13 +155,18 @@ class FrameRing:
     """
 
     def __init__(self, slots: int = DEFAULT_SLOTS,
-                 frame_bytes: int = DEFAULT_FRAME_BYTES):
+                 frame_bytes: int = DEFAULT_FRAME_BYTES,
+                 topic_words: int = 1):
         self.slots = slots
         self.frame_bytes = frame_bytes
+        self.topic_words = topic_words
         self._bytes = np.zeros((slots, frame_bytes), dtype=np.uint8)
         self._kind = np.zeros(slots, dtype=np.int32)
         self._length = np.zeros(slots, dtype=np.int32)
-        self._topic_mask = np.zeros(slots, dtype=np.uint32)
+        # [S] for the compact 1-word mask, [S, W] for wider topic spaces
+        self._topic_mask = np.zeros(
+            slots if topic_words == 1 else (slots, topic_words),
+            dtype=np.uint32)
         self._dest = np.full(slots, -1, dtype=np.int32)
         self._valid = np.zeros(slots, dtype=bool)
         self._next = 0
@@ -151,7 +195,10 @@ class FrameRing:
             self._bytes[i, n:] = 0
         self._kind[i] = kind
         self._length[i] = n
-        self._topic_mask[i] = topic_mask
+        if self.topic_words == 1:
+            self._topic_mask[i] = topic_mask & 0xFFFFFFFF
+        else:
+            self._topic_mask[i] = split_mask(topic_mask, self.topic_words)
         self._dest[i] = dest
         self._valid[i] = True
 
@@ -198,20 +245,23 @@ class FrameRing:
                 raise ValueError(
                     f"payload {i} is {len(p)} B > frame slot "
                     f"{self.frame_bytes} B; pre-filter to the host path")
-        from pushcdn_tpu import native
         kinds_a = np.asarray(kinds, np.int32)
-        tmasks_a = np.asarray(tmasks, np.uint32)
         dests_a = np.asarray(dests, np.int32)
-        valid_u8 = np.zeros(self.slots, np.uint8)
-        n = native.pack_frames_into(
-            list(payloads), kinds_a, tmasks_a, dests_a,
-            self._bytes, self._kind, self._length, self._topic_mask,
-            self._dest, valid_u8)
-        if n is not None:
-            self._valid = valid_u8.astype(bool)
-            self._used = n
-            self._next = n % self.slots
-            return n
+        if self.topic_words == 1:
+            from pushcdn_tpu import native
+            tmasks_a = np.asarray(
+                [m & 0xFFFFFFFF for m in tmasks], np.uint32)
+            valid_u8 = np.zeros(self.slots, np.uint8)
+            n = native.pack_frames_into(
+                list(payloads), kinds_a, tmasks_a, dests_a,
+                self._bytes, self._kind, self._length, self._topic_mask,
+                self._dest, valid_u8)
+            if n is not None:
+                self._valid = valid_u8.astype(bool)
+                self._used = n
+                self._next = n % self.slots
+                return n
+        tmasks_a = list(tmasks)
         # Python fallback (identical semantics)
         n = 0
         for payload, k, tm, d in zip(payloads, kinds_a, tmasks_a, dests_a):
@@ -229,7 +279,8 @@ class FrameRing:
         copy per step."""
         if self._used == 0:
             if self._empty is None:
-                self._empty = empty_batch(self.slots, self.frame_bytes)
+                self._empty = empty_batch(self.slots, self.frame_bytes,
+                                          self.topic_words)
             return self._empty
         batch = FrameBatch(
             bytes_=self._bytes.copy(), kind=self._kind.copy(),
@@ -338,12 +389,14 @@ def stage_best_fit(lanes, size: int, push) -> bool:
     return False
 
 
-def empty_batch(slots: int, frame_bytes: int) -> FrameBatch:
+def empty_batch(slots: int, frame_bytes: int,
+                topic_words: int = 1) -> FrameBatch:
     return FrameBatch(
         bytes_=np.zeros((slots, frame_bytes), np.uint8),
         kind=np.zeros(slots, np.int32),
         length=np.zeros(slots, np.int32),
-        topic_mask=np.zeros(slots, np.uint32),
+        topic_mask=np.zeros(
+            slots if topic_words == 1 else (slots, topic_words), np.uint32),
         dest=np.full(slots, -1, np.int32),
         valid=np.zeros(slots, bool),
     )

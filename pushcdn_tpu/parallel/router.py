@@ -55,7 +55,8 @@ class RouterState(NamedTuple):
     """Per-shard routing state: the DirectMap twin + per-user topic masks."""
 
     crdt: CrdtState          # owners/versions/identities, each int32/uint32[U]
-    topic_masks: jax.Array   # uint32[U] — authoritative at the owner
+    topic_masks: jax.Array   # uint32[U] or uint32[U, W] — authoritative at
+                             # the owner (W words cover 32·W topics)
 
 
 class IngressBatch(NamedTuple):
@@ -64,7 +65,7 @@ class IngressBatch(NamedTuple):
     frame_bytes: jax.Array  # uint8[S, F]
     kind: jax.Array         # int32[S]
     length: jax.Array       # int32[S]
-    topic_mask: jax.Array   # uint32[S]
+    topic_mask: jax.Array   # uint32[S] or uint32[S, W]
     dest: jax.Array         # int32[S]
     valid: jax.Array        # bool[S]
 
@@ -94,10 +95,11 @@ class RouteResult(NamedTuple):
     direct_deliver: Optional[jax.Array] = None  # bool[U, B*C]
 
 
-def empty_router_state(num_users: int) -> RouterState:
+def empty_router_state(num_users: int, topic_words: int = 1) -> RouterState:
+    shape = (num_users,) if topic_words == 1 else (num_users, topic_words)
     return RouterState(
         crdt=empty_state(num_users),
-        topic_masks=jnp.zeros((num_users,), dtype=jnp.uint32),
+        topic_masks=jnp.zeros(shape, dtype=jnp.uint32),
     )
 
 
@@ -241,7 +243,9 @@ def routing_step_lanes(state: RouterState,
                                merged.versions + 1),
             identities=merged.identities,
         )
-        masks = jnp.where(owner_live, masks, 0)
+        live_b = owner_live.reshape(
+            owner_live.shape + (1,) * (masks.ndim - owner_live.ndim))
+        masks = jnp.where(live_b, masks, 0)
     now_local = merged.owners == my_index
     evictions = was_local & ~now_local
 
@@ -259,8 +263,10 @@ def routing_step_lanes(state: RouterState,
             g_valid = g_valid & liveness[:, None]  # dead shards' frames
         valid_f = g_valid.reshape(B * S)
         kind_f = jnp.where(valid_f, g_kind.reshape(B * S), 0)
+        # topic masks may be multi-word ([.., W]) for >32-topic spaces
+        tmask_f = g_tmask.reshape((B * S,) + g_tmask.shape[2:])
         deliver = delivery_matrix(
-            masks, now_local, g_tmask.reshape(B * S), kind_f,
+            masks, now_local, tmask_f, kind_f,
             g_dest.reshape(B * S), use_pallas=USE_PALLAS_DELIVERY)
         lanes.append(LaneDelivery(
             gathered_bytes=g_bytes.reshape(B * S, -1),

@@ -343,3 +343,74 @@ async def test_device_plane_routes_broker_traffic():
         bob.close()
     finally:
         await cluster.stop()
+
+
+async def test_device_plane_routes_high_topics():
+    """Topics ≥ 32 (up to the reference's u8 ceiling) ride the device
+    plane via multi-word masks instead of falling back to the host path."""
+    from pushcdn_tpu.broker.device_plane import DevicePlaneConfig
+    from pushcdn_tpu.proto.topic import TopicSpace
+
+    cluster = await Cluster(
+        num_brokers=1,
+        device_plane=DevicePlaneConfig(
+            num_user_slots=64, ring_slots=64, frame_bytes=1024,
+            batch_window_s=0.005),
+        topics=TopicSpace.range(256)).start()
+    try:
+        alice = cluster.client(seed=71, topics=[200])
+        bob = cluster.client(seed=72, topics=[200, 255])
+        await alice.ensure_initialized()
+        await bob.ensure_initialized()
+        device = cluster.brokers[0].device_plane
+
+        await alice.send_broadcast_message([200], b"high topic")
+        got = await asyncio.wait_for(bob.receive_message(), 10)
+        assert bytes(got.message) == b"high topic"
+        got2 = await asyncio.wait_for(alice.receive_message(), 10)
+        assert bytes(got2.message) == b"high topic"
+        await wait_until(lambda: device.messages_routed >= 2)
+
+        # topic 255 reaches only bob — and still on the device
+        routed = device.messages_routed
+        await alice.send_broadcast_message([255], b"edge of the space")
+        got3 = await asyncio.wait_for(bob.receive_message(), 10)
+        assert bytes(got3.message) == b"edge of the space"
+        await wait_until(lambda: device.messages_routed == routed + 1)
+        alice.close()
+        bob.close()
+    finally:
+        await cluster.stop()
+
+
+async def test_device_plane_compact_topic_words():
+    """topic_words=1 keeps the compact 1-word masks (and 1-D mirrors) for
+    ≤32-topic deployments; topics ≥ 32 then fall back to the host path."""
+    from pushcdn_tpu.broker.device_plane import DevicePlaneConfig
+    from pushcdn_tpu.proto.topic import TopicSpace
+
+    cluster = await Cluster(
+        num_brokers=1,
+        device_plane=DevicePlaneConfig(
+            num_user_slots=32, ring_slots=32, frame_bytes=1024,
+            topic_words=1, batch_window_s=0.005),
+        topics=TopicSpace.range(256)).start()
+    try:
+        alice = cluster.client(seed=81, topics=[3, 40])
+        await alice.ensure_initialized()
+        device = cluster.brokers[0].device_plane
+        assert device._masks.ndim == 1
+
+        await alice.send_broadcast_message([3], b"compact lane")
+        got = await asyncio.wait_for(alice.receive_message(), 10)
+        assert bytes(got.message) == b"compact lane"
+        await wait_until(lambda: device.messages_routed >= 1)
+
+        routed = device.messages_routed
+        await alice.send_broadcast_message([40], b"host path")
+        got2 = await asyncio.wait_for(alice.receive_message(), 10)
+        assert bytes(got2.message) == b"host path"
+        assert device.messages_routed == routed  # beyond the 1-word space
+        alice.close()
+    finally:
+        await cluster.stop()

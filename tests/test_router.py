@@ -420,3 +420,41 @@ def test_liveness_mask_dead_shard():
     assert total == (n - 1) * (n - 1), total  # 7 live frames x 7 live users
     # released slots' masks were cleared with the claim
     assert (np.asarray(out.state.topic_masks)[:, dead] == 0).all()
+
+
+def test_multiword_topic_masks():
+    """8×u32 masks cover the reference's full u8 topic space: delivery on
+    topics ≥ 32, Pallas kernel ≡ jnp reference at W=8, and masks riding
+    the lane step."""
+    from pushcdn_tpu.parallel.frames import (
+        TOPIC_WORDS_FULL, mask_of_topics, split_mask)
+
+    rng = np.random.default_rng(7)
+    Uw, Nw, W = 16, 256, TOPIC_WORDS_FULL
+    umask = rng.integers(0, 2**32, (Uw, W), dtype=np.uint32)
+    tmask = rng.integers(0, 2**32, (Nw, W), dtype=np.uint32)
+    local = rng.random(Uw) < 0.7
+    kind = rng.choice([0, KIND_BROADCAST, KIND_DIRECT], Nw).astype(np.int32)
+    dest = rng.integers(-1, Uw, Nw).astype(np.int32)
+    ref = delivery_matrix_reference(
+        jnp.asarray(umask), jnp.asarray(local), jnp.asarray(tmask),
+        jnp.asarray(kind), jnp.asarray(dest))
+    pal = delivery_matrix_pallas(
+        jnp.asarray(umask), jnp.asarray(local), jnp.asarray(tmask),
+        jnp.asarray(kind), jnp.asarray(dest), interpret=True)
+    assert (np.asarray(ref) == np.asarray(pal)).all()
+
+    # semantic check on a high topic through the full lane step
+    state = empty_router_state(U, topic_words=W)
+    mask200 = mask_of_topics([200], W)
+    claim = jnp.zeros(U, bool).at[0].set(True)
+    from pushcdn_tpu.parallel.crdt import local_claim
+    state = RouterState(
+        local_claim(state.crdt, claim, jnp.int32(0)),
+        state.topic_masks.at[0].set(jnp.asarray(split_mask(mask200, W))))
+    ring = FrameRing(slots=8, frame_bytes=64, topic_words=W)
+    ring.push_broadcast(b"topic 200", topic_mask=mask200)
+    ring.push_broadcast(b"topic 7", topic_mask=mask_of_topics([7], W))
+    res = routing_step_lanes_single(state, (_batch_from_ring(ring),))
+    d = np.asarray(res.lanes[0].deliver)
+    assert d[0, 0] and not d[0, 1]  # subscribed to 200, not to 7
