@@ -40,7 +40,8 @@ from pushcdn_tpu.parallel.router import (
 from pushcdn_tpu.proto.message import KIND_BROADCAST
 
 U = 1024        # user slots on this broker shard
-S = 4096        # ingress frames per step
+S = 32768       # ingress frames per step (a ~1.5 ms coalescing window at
+                # target rate; throughput scales with S until HBM binds)
 F = 1024        # frame slot bytes (10 KB-class messages live on 10 slots;
                 # the reference's routing benches use 10 KB)
 TOPICS = 8
@@ -85,19 +86,27 @@ def main() -> None:
     jax.block_until_ready(result.deliver)
     state = result.state  # carry the merged CRDT like a real steady state
 
-    # best-of-N repeats: dispatch through the remote-chip tunnel is
-    # timing-noisy; the fastest window reflects the device's real rate
-    steps, repeats = 100, 3
+    # Every step's delivery matrix is CONSUMED on device (folded into an
+    # accumulator): blocking only on the final step would let a lazy
+    # remote-chip backend elide intermediate steps' work and overstate
+    # throughput. best-of-N repeats because tunnel dispatch is noisy.
+    @jax.jit
+    def consume(acc, deliver):
+        return acc + deliver[0, 0].astype(jnp.int32)
+
+    steps, repeats = 50, 3
     best_dt = float("inf")
     if args.profile:
         jax.profiler.start_trace(args.profile)
         print(f"# tracing to {args.profile}", file=sys.stderr)
+    acc = jnp.zeros((), jnp.int32)
     for _ in range(repeats):
         t0 = time.perf_counter()
         for _ in range(steps):
             result = routing_step_single(state, batch)
             state = result.state
-        jax.block_until_ready(result.deliver)
+            acc = consume(acc, result.deliver)
+        jax.block_until_ready(acc)
         best_dt = min(best_dt, time.perf_counter() - t0)
     if args.profile:
         jax.profiler.stop_trace()

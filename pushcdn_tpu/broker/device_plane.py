@@ -53,6 +53,7 @@ from pushcdn_tpu.parallel.frames import (
     TOPIC_WORDS_FULL,
     FrameRing,
     UserSlots,
+    mask_mirror_shape,
     mask_of_topics,
     mask_row_of,
     stage_best_fit,
@@ -114,8 +115,7 @@ class DevicePlane:
         # mask shape tracks the configured topic-space width
         self._owned = np.zeros(c.num_user_slots, bool)
         self._masks = np.zeros(
-            c.num_user_slots if c.topic_words == 1
-            else (c.num_user_slots, c.topic_words), np.uint32)
+            mask_mirror_shape(c.num_user_slots, c.topic_words), np.uint32)
         self._quarantine: List[int] = []   # slots awaiting step completion
         # users the slot table couldn't hold: broadcasts must stay on the
         # host path while any exist (they'd miss device-only fan-out)

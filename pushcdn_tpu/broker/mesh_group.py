@@ -46,6 +46,7 @@ from pushcdn_tpu.parallel.frames import (
     DirectBuckets,
     FrameRing,
     UserSlots,
+    mask_mirror_shape,
     mask_of_topics,
     mask_row_of,
     stage_best_fit,
@@ -171,8 +172,7 @@ class MeshBrokerGroup:
         self._claim_version = np.zeros(c.num_user_slots, np.uint32)
         # mask shape tracks the configured topic-space width
         self._masks = np.zeros(
-            c.num_user_slots if c.topic_words == 1
-            else (c.num_user_slots, c.topic_words), np.uint32)
+            mask_mirror_shape(c.num_user_slots, c.topic_words), np.uint32)
         self._quarantine: List[int] = []
         # users the slot table couldn't hold, keyed to their shard so a
         # dead shard's entries can be swept (a crash fires no releases)

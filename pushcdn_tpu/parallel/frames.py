@@ -71,6 +71,13 @@ def mask_of_topics(topics, words: int) -> int:
     return mask
 
 
+def mask_mirror_shape(n: int, words: int):
+    """Shape of an ``n``-slot topic-mask mirror/column: 1-D for the
+    compact 1-word representation, [n, words] otherwise. The single place
+    that encodes the dual representation rule."""
+    return n if words == 1 else (n, words)
+
+
 def mask_row_of(topics, words: int):
     """The mask-mirror row for a topic set: a u32 scalar when ``words`` is
     1 (compact deployments, 1-D mirrors) or a uint32[words] row otherwise —
@@ -164,9 +171,8 @@ class FrameRing:
         self._kind = np.zeros(slots, dtype=np.int32)
         self._length = np.zeros(slots, dtype=np.int32)
         # [S] for the compact 1-word mask, [S, W] for wider topic spaces
-        self._topic_mask = np.zeros(
-            slots if topic_words == 1 else (slots, topic_words),
-            dtype=np.uint32)
+        self._topic_mask = np.zeros(mask_mirror_shape(slots, topic_words),
+                                    dtype=np.uint32)
         self._dest = np.full(slots, -1, dtype=np.int32)
         self._valid = np.zeros(slots, dtype=bool)
         self._next = 0
@@ -395,8 +401,8 @@ def empty_batch(slots: int, frame_bytes: int,
         bytes_=np.zeros((slots, frame_bytes), np.uint8),
         kind=np.zeros(slots, np.int32),
         length=np.zeros(slots, np.int32),
-        topic_mask=np.zeros(
-            slots if topic_words == 1 else (slots, topic_words), np.uint32),
+        topic_mask=np.zeros(mask_mirror_shape(slots, topic_words),
+                            np.uint32),
         dest=np.full(slots, -1, np.int32),
         valid=np.zeros(slots, bool),
     )
