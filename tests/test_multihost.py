@@ -1,0 +1,27 @@
+"""Multi-host helpers (single-process degenerate case: one host owns every
+shard; the SPMD contract itself is exercised by the shard_map routing
+tests, whose per-shard program is identical on a pod)."""
+
+import jax
+
+from pushcdn_tpu.parallel.mesh import make_broker_mesh
+from pushcdn_tpu.parallel.multihost import (
+    dcn_crossings,
+    initialize,
+    local_shard_indices,
+    pod_broker_mesh,
+)
+
+
+def test_single_host_owns_all_shards():
+    initialize()  # no-op off-pod
+    mesh = pod_broker_mesh(8)
+    assert local_shard_indices(mesh) == list(range(8))
+    # one host ⇒ the ring never crosses DCN
+    assert dcn_crossings(mesh) == 0
+    assert mesh.devices.size == 8
+
+
+def test_pod_mesh_matches_plain_mesh():
+    assert [d.id for d in pod_broker_mesh(4).devices.flat] == \
+        [d.id for d in make_broker_mesh(4).devices.flat]
