@@ -92,7 +92,9 @@ def main() -> None:
     # throughput. best-of-N repeats because tunnel dispatch is noisy.
     @jax.jit
     def consume(acc, deliver):
-        return acc + deliver[0, 0].astype(jnp.int32)
+        # full on-device reduction: the whole matrix is in acc's
+        # dependency cone, so no backend can elide any of it
+        return acc + deliver.sum(dtype=jnp.int32)
 
     steps, repeats = 50, 3
     best_dt = float("inf")
@@ -100,6 +102,8 @@ def main() -> None:
         jax.profiler.start_trace(args.profile)
         print(f"# tracing to {args.profile}", file=sys.stderr)
     acc = jnp.zeros((), jnp.int32)
+    acc = consume(acc, result.deliver)  # compile consume before timing
+    jax.block_until_ready(acc)
     for _ in range(repeats):
         t0 = time.perf_counter()
         for _ in range(steps):
