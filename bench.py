@@ -98,12 +98,12 @@ def main() -> None:
 
     steps, repeats = 50, 3
     best_dt = float("inf")
-    if args.profile:
-        jax.profiler.start_trace(args.profile)
-        print(f"# tracing to {args.profile}", file=sys.stderr)
     acc = jnp.zeros((), jnp.int32)
     acc = consume(acc, result.deliver)  # compile consume before timing
     jax.block_until_ready(acc)
+    if args.profile:  # start AFTER warm-up so the trace is steady-state
+        jax.profiler.start_trace(args.profile)
+        print(f"# tracing to {args.profile}", file=sys.stderr)
     for _ in range(repeats):
         t0 = time.perf_counter()
         for _ in range(steps):
