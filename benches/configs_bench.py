@@ -33,6 +33,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# the device-mesh variant of configs[3] runs its 8 broker shards on a
+# virtual 8-device CPU mesh (same stand-in the test suite uses); the
+# flag must be set before jax initializes
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
 from pushcdn_tpu.proto.crypto.signature import (
     BlsBn254Scheme,
     DEFAULT_SCHEME,
@@ -233,11 +241,62 @@ async def bench_eight_broker_mesh(msgs: int):
         await cluster.stop()
 
 
+# ---------------------------------------------------------------------------
+# configs[3], device plane: the same 8-broker mesh with inter-broker
+# traffic on the DEVICE mesh (all_gather over the broker axis — the
+# BASELINE.json north-star path), zero host broker links
+# ---------------------------------------------------------------------------
+
+async def bench_eight_broker_device_mesh(msgs: int):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from pushcdn_tpu.testing.mesh_cluster import MeshCluster
+
+    cluster = await MeshCluster(
+        num_shards=8, ring_slots=128, frame_bytes=2048,
+        batch_window_s=0.001, devices=jax.devices("cpu"), prefix="cfg3d",
+    ).start(form_host_mesh=False)
+    try:
+        clients = [await cluster.place_client(3000 + i, i % 8, topics=[0])
+                   for i in range(16)]
+        assert all(b.connections.num_brokers == 0 for b in cluster.brokers)
+
+        payload = os.urandom(1024)
+        publisher = clients[0]
+        lat = []
+        for _ in range(min(100, msgs)):
+            t0 = time.perf_counter()
+            await publisher.send_broadcast_message([0], payload)
+            await asyncio.gather(*(
+                asyncio.wait_for(c.receive_message(), 30) for c in clients))
+            lat.append((time.perf_counter() - t0) * 1e6)
+        emit("configs3/device_mesh_broadcast_latency", statistics.median(lat),
+             "us_median", p99=_p99(lat), receivers=16, brokers=8,
+             host_links=0, steps=cluster.group.steps)
+
+        t0 = time.perf_counter()
+        drains = [asyncio.create_task(_drain(c, msgs)) for c in clients]
+        for _ in range(msgs):
+            await publisher.send_broadcast_message([0], payload)
+        await asyncio.gather(*drains)
+        dt = time.perf_counter() - t0
+        emit("configs3/device_mesh_broadcast_fanout", msgs * 16 / dt,
+             "deliveries/s", msgs=msgs, brokers=8,
+             publish_rate=round(msgs / dt, 1), frame=1024,
+             host_links=0, mesh_routed=cluster.group.messages_routed)
+        for c in clients:
+            c.close()
+    finally:
+        await cluster.stop()
+
+
 async def amain(quick: bool):
     await bench_two_broker_fanout(msgs=100 if quick else 500)
     await bench_topic_pubsub(per_topic=16 if quick else 64,
                              rounds=20 if quick else 100)
     await bench_eight_broker_mesh(msgs=100 if quick else 400)
+    await bench_eight_broker_device_mesh(msgs=100 if quick else 400)
 
 
 def main():

@@ -47,7 +47,7 @@ from typing import TYPE_CHECKING, List, Optional, Tuple
 import numpy as np
 
 from pushcdn_tpu.broker.staging import StageResult
-from pushcdn_tpu.broker.tasks.senders import try_send_to_user_nowait
+from pushcdn_tpu.broker.tasks.senders import egress_delivery_rows
 from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
 from pushcdn_tpu.parallel.frames import (
     TOPIC_WORDS_FULL,
@@ -298,21 +298,22 @@ class DevicePlane:
 
     def _egress(self, deliver, lengths, frames) -> None:
         """Walk the delivery matrix and queue the original wire frames to
-        local user connections — non-blocking per user, so one slow
-        consumer cannot stall the pump (its overflow is handled by the
-        failure-is-removal policy in the sender)."""
+        local user connections — non-blocking and grouped per user
+        (senders.egress_delivery_rows), so one slow consumer cannot stall
+        the pump (its overflow is handled by the failure-is-removal
+        policy in the sender)."""
         users, frame_idx = np.nonzero(deliver)
         cache: dict[int, Bytes] = {}
-        for u, f in zip(users.tolist(), frame_idx.tolist()):
-            key = self.slots.key_of(u)
-            if key is None:
-                continue  # released while the step ran: drop (user is gone)
+
+        def frame_of(f: int) -> Bytes:
             raw = cache.get(f)
             if raw is None:
                 raw = Bytes(frames[f, :lengths[f]].tobytes())
                 cache[f] = raw
-            if try_send_to_user_nowait(self.broker, key, raw):
-                self.messages_routed += 1
+            return raw
+
+        self.messages_routed += egress_delivery_rows(
+            self.broker, self.slots, users, frame_idx, frame_of)
         for raw in cache.values():
             raw.release()
 

@@ -39,7 +39,7 @@ from typing import TYPE_CHECKING, Dict, List, Optional
 
 import numpy as np
 
-from pushcdn_tpu.broker.tasks.senders import try_send_to_user_nowait
+from pushcdn_tpu.broker.tasks.senders import egress_delivery_rows
 from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
 from pushcdn_tpu.parallel.frames import (
     TOPIC_WORDS_FULL,
@@ -495,16 +495,17 @@ class MeshBrokerGroup:
                 continue
             users, frame_idx = np.nonzero(deliver[shard])
             cache: Dict[int, Bytes] = {}
-            for u, f in zip(users.tolist(), frame_idx.tolist()):
-                key = self.slots.key_of(u)
-                if key is None:
-                    continue
+
+            def frame_of(f: int) -> Bytes:
                 raw = cache.get(f)
                 if raw is None:
-                    raw = Bytes(frames[shard, f, :lengths[shard, f]].tobytes())
+                    raw = Bytes(
+                        frames[shard, f, :lengths[shard, f]].tobytes())
                     cache[f] = raw
-                if try_send_to_user_nowait(broker, key, raw):
-                    self.messages_routed += 1
+                return raw
+
+            self.messages_routed += egress_delivery_rows(
+                broker, self.slots, users, frame_idx, frame_of)
             for raw in cache.values():
                 raw.release()
 

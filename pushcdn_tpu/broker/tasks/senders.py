@@ -42,25 +42,51 @@ async def try_send_to_user(broker: "Broker", public_key: bytes,
         return False
 
 
-def try_send_to_user_nowait(broker: "Broker", public_key: bytes,
-                            raw: Bytes) -> bool:
-    """Non-blocking variant for the device-plane egress: a full queue is a
-    failed send (⇒ removal), so one slow consumer can't head-of-line block
-    the pump."""
+def try_send_frames_to_user_nowait(broker: "Broker", public_key: bytes,
+                                   raws: Iterable[Bytes]) -> int:
+    """Queue a whole batch of frames to one user with a single connection
+    lookup (the device-plane egress delivers per-user groups). Returns
+    the number queued; a failure removes the user and stops the batch."""
     connection = broker.connections.get_user_connection(public_key)
     if connection is None:
-        return False
-    clone = raw.clone()
-    try:
-        connection.send_raw_nowait(clone)
-        return True
-    except Exception as exc:
-        clone.release()
-        logger.info("nowait send to user %s failed (%r); removing",
-                    mnemonic(public_key), exc)
-        broker.connections.remove_user(public_key, reason="send failed")
-        broker.update_metrics()
-        return False
+        return 0
+    sent = 0
+    for raw in raws:
+        clone = raw.clone()
+        try:
+            connection.send_raw_nowait(clone)
+            sent += 1
+        except Exception as exc:
+            clone.release()
+            logger.info("nowait send to user %s failed (%r); removing",
+                        mnemonic(public_key), exc)
+            broker.connections.remove_user(public_key, reason="send failed")
+            broker.update_metrics()
+            break
+    return sent
+
+
+def egress_delivery_rows(broker: "Broker", slots, users, frame_idx,
+                         frame_of) -> int:
+    """Shared device-plane egress walk: deliver a (users, frame_idx)
+    nonzero listing grouped per user (np.nonzero is row-major, so each
+    user's frames are contiguous — one connection lookup per user).
+    ``frame_of(f)`` materializes/caches the frame's Bytes; ``slots`` maps
+    user slot → public key. Returns the number queued."""
+    routed = 0
+    start = 0
+    n = len(users)
+    while start < n:
+        u = users[start]
+        end = start
+        while end < n and users[end] == u:
+            end += 1
+        key = slots.key_of(int(u))
+        if key is not None:  # released mid-step: drop (user is gone)
+            routed += try_send_frames_to_user_nowait(
+                broker, key, [frame_of(int(f)) for f in frame_idx[start:end]])
+        start = end
+    return routed
 
 
 async def try_send_to_broker(broker: "Broker", identifier: str,

@@ -7,98 +7,11 @@ import asyncio
 import numpy as np
 
 from pushcdn_tpu.broker.mesh_group import MeshBrokerGroup, MeshGroupConfig
-from pushcdn_tpu.broker.broker import Broker, BrokerConfig
-from pushcdn_tpu.broker.tasks.heartbeat import heartbeat_once
-from pushcdn_tpu.client import Client, ClientConfig
-from pushcdn_tpu.marshal import Marshal, MarshalConfig
 from pushcdn_tpu.parallel.mesh import make_broker_mesh
-from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME
-from pushcdn_tpu.proto.def_ import testing_run_def as make_run_def
-from pushcdn_tpu.proto.discovery.base import BrokerIdentifier
-from pushcdn_tpu.proto.discovery.embedded import Embedded
 from pushcdn_tpu.proto.message import Broadcast, Direct
-from pushcdn_tpu.proto.transport.memory import Memory
+from pushcdn_tpu.testing.mesh_cluster import MeshCluster
 from tests.test_integration import wait_until
 
-import itertools
-import os
-import tempfile
-
-_UID = itertools.count()
-
-
-class MeshCluster:
-    """N broker shards on the device mesh + marshal, users over Memory."""
-
-    def __init__(self, num_shards: int = 4, extra_lanes: tuple = ()):
-        self.uid = next(_UID)
-        self.num_shards = num_shards
-        self.extra_lanes = extra_lanes
-        self.db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-mesh-"),
-                               "d.sqlite")
-        self.run_def = make_run_def()
-        self.keypair = DEFAULT_SCHEME.generate_keypair(seed=40_000 + self.uid)
-        self.brokers: list[Broker] = []
-        self.group: MeshBrokerGroup = None
-        self.marshal: Marshal = None
-
-    async def start(self, form_host_mesh: bool = False):
-        mesh = make_broker_mesh(self.num_shards)
-        self.group = MeshBrokerGroup(mesh, MeshGroupConfig(
-            num_user_slots=64, ring_slots=32, frame_bytes=1024,
-            extra_lanes=self.extra_lanes, batch_window_s=0.002))
-        for i in range(self.num_shards):
-            b = await Broker.new(BrokerConfig(
-                run_def=self.run_def, keypair=self.keypair,
-                discovery_endpoint=self.db,
-                public_advertise_endpoint=f"mg{self.uid}-b{i}-pub",
-                public_bind_endpoint=f"mg{self.uid}-b{i}-pub",
-                private_advertise_endpoint=f"mg{self.uid}-b{i}-priv",
-                private_bind_endpoint=f"mg{self.uid}-b{i}-priv",
-                heartbeat_interval_s=3600, sync_interval_s=3600,
-                whitelist_interval_s=3600,
-                form_mesh=form_host_mesh))
-            self.group.attach(b, i)
-            await b.start()
-            self.brokers.append(b)
-        # register in discovery WITHOUT dialing (external handles), so the
-        # mesh-only tests prove traffic crosses shards with zero host links
-        for i in range(self.num_shards):
-            h = await Embedded.new(self.db, identity=BrokerIdentifier(
-                f"mg{self.uid}-b{i}-pub", f"mg{self.uid}-b{i}-priv"))
-            await h.perform_heartbeat(0, 60.0)
-            await h.close()
-        if form_host_mesh:
-            for b in self.brokers:
-                await heartbeat_once(b)  # dial host links as backup plane
-            await asyncio.sleep(0.2)
-        self.marshal = await Marshal.new(MarshalConfig(
-            run_def=self.run_def, discovery_endpoint=self.db,
-            bind_endpoint=f"mg{self.uid}-marshal"))
-        await self.marshal.start()
-        return self
-
-    async def place_client(self, seed: int, shard: int, topics):
-        """Steer the marshal so this client lands on ``shard``."""
-        for i in range(self.num_shards):
-            h = await Embedded.new(self.db, identity=BrokerIdentifier(
-                f"mg{self.uid}-b{i}-pub", f"mg{self.uid}-b{i}-priv"))
-            await h.perform_heartbeat(0 if i == shard else 100, 60.0)
-            await h.close()
-        c = Client(ClientConfig(
-            marshal_endpoint=f"mg{self.uid}-marshal",
-            keypair=DEFAULT_SCHEME.generate_keypair(seed=seed),
-            protocol=Memory, subscribed_topics=set(topics)))
-        await c.ensure_initialized()
-        await wait_until(
-            lambda: self.brokers[shard].connections.has_user(c.public_key))
-        return c
-
-    async def stop(self):
-        if self.marshal:
-            await self.marshal.stop()
-        for b in self.brokers:
-            await b.stop()
 
 
 async def test_cross_shard_broadcast_over_mesh_only():
