@@ -40,8 +40,9 @@ from pushcdn_tpu.parallel.router import (
 from pushcdn_tpu.proto.message import KIND_BROADCAST
 
 U = 1024        # user slots on this broker shard
-S = 32768       # ingress frames per step (a ~1.5 ms coalescing window at
-                # target rate; throughput scales with S until HBM binds)
+S = 65536       # ingress frames per step (a ~2 ms coalescing window at
+                # the measured rate; throughput scales with S until HBM
+                # binds — see BASELINE.md scaling data)
 F = 1024        # frame slot bytes (10 KB-class messages live on 10 slots;
                 # the reference's routing benches use 10 KB)
 TOPICS = 8
