@@ -93,4 +93,24 @@ int64_t pushcdn_encode_frames(
   return pos;
 }
 
+// Same encode, but the payloads arrive as an array of pointers (ctypes
+// c_char_p array built from the Python bytes objects — zero join, zero
+// intermediate blob). The single copy is straight into `out`.
+int64_t pushcdn_encode_frames_ptrs(
+    const uint8_t* const* payloads, const int32_t* lengths,
+    int32_t n, uint8_t* out, int64_t out_capacity) {
+  int64_t pos = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    const int32_t len = lengths[i];
+    if (pos + 4 + (int64_t)len > out_capacity) return -1;
+    out[pos] = (uint8_t)((uint32_t)len >> 24);
+    out[pos + 1] = (uint8_t)((uint32_t)len >> 16);
+    out[pos + 2] = (uint8_t)((uint32_t)len >> 8);
+    out[pos + 3] = (uint8_t)len;
+    std::memcpy(out + pos + 4, payloads[i], (size_t)len);
+    pos += 4 + (int64_t)len;
+  }
+  return pos;
+}
+
 }  // extern "C"

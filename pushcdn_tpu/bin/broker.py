@@ -11,7 +11,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 
-from pushcdn_tpu.bin.common import init_logging, keypair_from_seed, run_def_from_args
+from pushcdn_tpu.bin.common import init_logging, tune_gc, keypair_from_seed, run_def_from_args
 from pushcdn_tpu.broker.broker import GIB, Broker, BrokerConfig
 
 
@@ -62,6 +62,7 @@ async def amain(args: argparse.Namespace) -> None:
 def main() -> None:
     args = build_parser().parse_args()
     init_logging(args.verbose)
+    tune_gc()
     try:
         asyncio.run(amain(args))
     except KeyboardInterrupt:

@@ -39,6 +39,20 @@ class _JsonFormatter(logging.Formatter):
         })
 
 
+def tune_gc(threshold0: int = 50_000) -> None:
+    """Server-style GC tuning for the message hot path: the router
+    allocates ~20 small objects per delivery, and CPython's default gen-0
+    threshold (700) turns that into thousands of collections per second —
+    with the periodic gen-2 passes scanning the whole (jax-sized) heap.
+    Raise the thresholds and freeze the post-startup heap so steady-state
+    collections only walk the young, message-sized garbage. Call once
+    after bootstrap (binaries and benches do)."""
+    import gc
+    gc.collect()
+    gc.freeze()
+    gc.set_threshold(threshold0, 50, 100)
+
+
 def init_logging(verbosity: int = 0) -> None:
     """Env-driven log format: ``PUSHCDN_LOG_FORMAT=json`` switches to
     structured JSON lines (reference: RUST_LOG_FORMAT=json)."""

@@ -6,7 +6,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 
-from pushcdn_tpu.bin.common import init_logging, run_def_from_args
+from pushcdn_tpu.bin.common import init_logging, tune_gc, run_def_from_args
 from pushcdn_tpu.marshal import Marshal, MarshalConfig
 
 
@@ -47,6 +47,7 @@ async def amain(args: argparse.Namespace) -> None:
 def main() -> None:
     args = build_parser().parse_args()
     init_logging(args.verbose)
+    tune_gc()
     try:
         asyncio.run(amain(args))
     except KeyboardInterrupt:

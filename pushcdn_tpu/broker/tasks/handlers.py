@@ -26,13 +26,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import TYPE_CHECKING, List, Sequence
+from typing import TYPE_CHECKING, List, Optional, Sequence
 
-from pushcdn_tpu.broker.tasks.senders import (
-    try_send_to_broker,
-    try_send_to_brokers,
-    try_send_to_user,
-)
 from pushcdn_tpu.broker.staging import StageResult
 from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto.def_ import HookResult
@@ -59,18 +54,141 @@ logger = logging.getLogger("pushcdn.broker")
 # routing core
 # ---------------------------------------------------------------------------
 
-async def handle_direct_message(broker: "Broker", recipient: bytes,
-                                raw: Bytes, to_user_only: bool) -> None:
-    """One-hop direct routing (broker/handler.rs:197-237)."""
+class EgressBatch:
+    """Per-wakeup egress accumulator: routing decisions append fan-out
+    clones per peer; ``flush()`` hands each peer its whole batch with ONE
+    ``send_raw_many`` (one queue entry, one writer wakeup). Per-peer frame
+    order is the processing order, so per-(sender→receiver) ordering is
+    identical to the per-frame path. Failure ⇒ removal semantics are the
+    senders' (sender.rs:17-58)."""
+
+    __slots__ = ("broker", "users", "brokers")
+
+    def __init__(self, broker: "Broker"):
+        self.broker = broker
+        self.users: dict = {}
+        self.brokers: dict = {}
+
+    def to_user(self, public_key: bytes, raw: Bytes) -> None:
+        lst = self.users.get(public_key)
+        if lst is None:
+            lst = self.users[public_key] = []
+        lst.append(raw.clone())
+
+    def to_broker(self, identifier: str, raw: Bytes) -> None:
+        lst = self.brokers.get(identifier)
+        if lst is None:
+            lst = self.brokers[identifier] = []
+        lst.append(raw.clone())
+
+    def release_all(self) -> None:
+        for frames in self.users.values():
+            for f in frames:
+                f.release()
+        self.users.clear()
+        for frames in self.brokers.values():
+            for f in frames:
+                f.release()
+        self.brokers.clear()
+
+    async def flush(self) -> None:
+        broker = self.broker
+        try:
+            # brokers first (reference fan-out order, handler.rs:240-272)
+            while self.brokers:
+                ident, frames = self.brokers.popitem()
+                conn = broker.connections.get_broker_connection(ident)
+                if conn is None:
+                    for f in frames:
+                        f.release()
+                    continue
+                try:
+                    await conn.send_raw_many(frames)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    logger.info("send to broker %s failed (%r); removing",
+                                ident, exc)
+                    broker.connections.remove_broker(ident,
+                                                     reason="send failed")
+                    broker.update_metrics()
+            while self.users:
+                key, frames = self.users.popitem()
+                conn = broker.connections.get_user_connection(key)
+                if conn is None:
+                    for f in frames:
+                        f.release()
+                    continue
+                try:
+                    await conn.send_raw_many(frames)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    logger.info("send to user %s failed (%r); removing",
+                                mnemonic(key), exc)
+                    broker.connections.remove_user(key, reason="send failed")
+                    broker.update_metrics()
+        except BaseException:
+            # interrupted mid-flush (e.g. cancellation): the un-flushed
+            # peers' clones must still return their pool permits
+            self.release_all()
+            raise
+
+
+def route_direct(broker: "Broker", recipient: bytes, raw: Bytes,
+                 to_user_only: bool, egress: EgressBatch) -> None:
+    """One-hop direct routing decision (broker/handler.rs:197-237)."""
     owner = broker.connections.get_broker_identifier_of_user(recipient)
     if owner is None:
         return  # unknown user: drop
     if owner == broker.connections.identity:
-        await try_send_to_user(broker, recipient, raw)
+        egress.to_user(recipient, raw)
     elif not to_user_only:
         # forward one hop to the owning broker; the remote end delivers
         # with to_user_only=True so it can never bounce back
-        await try_send_to_broker(broker, owner, raw)
+        egress.to_broker(owner, raw)
+
+
+def route_broadcast(broker: "Broker", topics: Sequence[int], raw: Bytes,
+                    to_users_only: bool, egress: EgressBatch,
+                    users_via_device: bool = False,
+                    exclude_brokers: frozenset = frozenset(),
+                    interest_cache: Optional[dict] = None) -> None:
+    """Interest-driven fan-out decision (broker/handler.rs:240-272).
+
+    ``users_via_device=True`` means the local-user fan-out was staged onto
+    the device plane; only the inter-broker forwarding runs on the host.
+    ``exclude_brokers`` are peers already covered by the device mesh
+    (group members) — interested OUT-of-group brokers still get the frame.
+    ``interest_cache`` memoizes the interest query per (topics, scope)
+    within one receive batch; callers clear it whenever subscriptions or
+    peer sync state change mid-batch.
+    """
+    if interest_cache is None:
+        users, brokers = broker.connections.get_interested_by_topic(
+            list(topics), to_users_only)
+    else:
+        key = (tuple(topics), to_users_only)
+        hit = interest_cache.get(key)
+        if hit is None:
+            hit = broker.connections.get_interested_by_topic(
+                list(topics), to_users_only)
+            interest_cache[key] = hit
+        users, brokers = hit
+    for ident in brokers:
+        if ident not in exclude_brokers:
+            egress.to_broker(ident, raw)
+    if not users_via_device:
+        for user in users:
+            egress.to_user(user, raw)
+
+
+async def handle_direct_message(broker: "Broker", recipient: bytes,
+                                raw: Bytes, to_user_only: bool) -> None:
+    """One-shot direct routing (kept for non-batched callers)."""
+    egress = EgressBatch(broker)
+    route_direct(broker, recipient, raw, to_user_only, egress)
+    await egress.flush()
 
 
 async def handle_broadcast_message(broker: "Broker", topics: Sequence[int],
@@ -78,21 +196,12 @@ async def handle_broadcast_message(broker: "Broker", topics: Sequence[int],
                                    users_via_device: bool = False,
                                    exclude_brokers: frozenset = frozenset()
                                    ) -> None:
-    """Interest-driven fan-out (broker/handler.rs:240-272).
-
-    ``users_via_device=True`` means the local-user fan-out was staged onto
-    the device plane; only the inter-broker forwarding runs on the host.
-    ``exclude_brokers`` are peers already covered by the device mesh
-    (group members) — interested OUT-of-group brokers still get the frame.
-    """
-    users, brokers = broker.connections.get_interested_by_topic(
-        list(topics), to_users_only)
-    for ident in brokers:
-        if ident not in exclude_brokers:
-            await try_send_to_broker(broker, ident, raw)
-    if not users_via_device:
-        for user in users:
-            await try_send_to_user(broker, user, raw)
+    """One-shot broadcast fan-out (kept for non-batched callers)."""
+    egress = EgressBatch(broker)
+    route_broadcast(broker, topics, raw, to_users_only, egress,
+                    users_via_device=users_via_device,
+                    exclude_brokers=exclude_brokers)
+    await egress.flush()
 
 
 async def _stage_with_backpressure(device, message, raw: Bytes):
@@ -115,70 +224,93 @@ async def _stage_with_backpressure(device, message, raw: Bytes):
 async def user_receive_loop(broker: "Broker", public_key: bytes,
                             connection) -> None:
     """Pump one user's messages until the connection dies or the user is
-    kicked (user/handler.rs:104-161)."""
+    kicked (user/handler.rs:104-161). Messages are drained and routed in
+    batches: one ``recv_raw_many`` wakeup routes every pending frame, and
+    the fan-out goes out as per-peer ``send_raw_many`` batches."""
     hook = broker.run_def.user_def.hook
     topics = broker.run_def.topics
+    alive = True
     try:
-        while True:
-            raw = await connection.recv_raw()
+        while alive:
+            raws = await connection.recv_raw_many()
+            egress = EgressBatch(broker)
+            interest_cache: dict = {}
             try:
-                try:
-                    message = deserialize(raw.data)
-                except Error:
-                    # malformed frame ⇒ disconnect (user/handler.rs:106-118)
-                    logger.info("user %s sent malformed frame; disconnecting",
-                                mnemonic(public_key))
-                    break
-                result = hook(public_key, message)
-                if result == HookResult.SKIP:
-                    continue
-                if result == HookResult.DISCONNECT:
-                    break
+                for raw in raws:
+                    try:
+                        message = deserialize(raw.data)
+                    except Error:
+                        # malformed frame ⇒ disconnect
+                        # (user/handler.rs:106-118)
+                        logger.info(
+                            "user %s sent malformed frame; disconnecting",
+                            mnemonic(public_key))
+                        alive = False
+                        break
+                    result = hook(public_key, message)
+                    if result == HookResult.SKIP:
+                        continue
+                    if result == HookResult.DISCONNECT:
+                        alive = False
+                        break
 
-                device = broker.device_plane
-                if isinstance(message, Direct):
-                    # device path covers local-recipient delivery (and, for
-                    # a mesh-group plane, any recipient in the group); host
-                    # path covers the rest
-                    if device is not None:
-                        result = await _stage_with_backpressure(
-                            device, message, raw)
-                        if result == StageResult.STAGED:
-                            continue
-                    await handle_direct_message(
-                        broker, message.recipient, raw, to_user_only=False)
-                elif isinstance(message, Broadcast):
-                    pruned, _bad = topics.prune(message.topics)
-                    if pruned:
-                        staged = False
+                    device = broker.device_plane
+                    if isinstance(message, Direct):
+                        # device path covers local-recipient delivery (and,
+                        # for a mesh-group plane, any recipient in the
+                        # group); host path covers the rest
                         if device is not None:
                             result = await _stage_with_backpressure(
                                 device, message, raw)
-                            staged = result == StageResult.STAGED
-                        # host side: remaining fan-out — all of it when not
-                        # staged; only out-of-group/interest forwarding when
-                        # the device covers users (+ group peers over ICI)
-                        await handle_broadcast_message(
-                            broker, pruned, raw, to_users_only=False,
-                            users_via_device=staged,
-                            exclude_brokers=(
-                                frozenset(device.covered_broker_idents())
-                                if staged else frozenset()))
-                elif isinstance(message, Subscribe):
-                    pruned, bad = topics.prune(message.topics)
-                    if bad:
-                        # unknown topic ⇒ disconnect (subscribe.rs test
-                        # behavior: invalid-topic subscriptions kick)
+                            if result == StageResult.STAGED:
+                                continue
+                        route_direct(broker, message.recipient, raw,
+                                     to_user_only=False, egress=egress)
+                    elif isinstance(message, Broadcast):
+                        pruned, _bad = topics.prune(message.topics)
+                        if pruned:
+                            staged = False
+                            if device is not None:
+                                result = await _stage_with_backpressure(
+                                    device, message, raw)
+                                staged = result == StageResult.STAGED
+                            # host side: remaining fan-out — all of it when
+                            # not staged; only out-of-group/interest
+                            # forwarding when the device covers users
+                            # (+ group peers over ICI)
+                            route_broadcast(
+                                broker, pruned, raw, to_users_only=False,
+                                egress=egress, users_via_device=staged,
+                                exclude_brokers=(
+                                    frozenset(device.covered_broker_idents())
+                                    if staged else frozenset()),
+                                interest_cache=interest_cache)
+                    elif isinstance(message, Subscribe):
+                        pruned, bad = topics.prune(message.topics)
+                        if bad:
+                            # unknown topic ⇒ disconnect (subscribe.rs test
+                            # behavior: invalid-topic subscriptions kick)
+                            alive = False
+                            break
+                        broker.connections.subscribe_user_to(public_key,
+                                                             pruned)
+                        interest_cache.clear()
+                    elif isinstance(message, Unsubscribe):
+                        pruned, _bad = topics.prune(message.topics)
+                        broker.connections.unsubscribe_user_from(public_key,
+                                                                 pruned)
+                        interest_cache.clear()
+                    else:
+                        # users may not send auth or sync messages
+                        # post-handshake
+                        alive = False
                         break
-                    broker.connections.subscribe_user_to(public_key, pruned)
-                elif isinstance(message, Unsubscribe):
-                    pruned, _bad = topics.prune(message.topics)
-                    broker.connections.unsubscribe_user_from(public_key, pruned)
-                else:
-                    # users may not send auth or sync messages post-handshake
-                    break
             finally:
-                raw.release()
+                try:
+                    await egress.flush()
+                finally:
+                    for raw in raws:
+                        raw.release()
     except (Error, asyncio.IncompleteReadError):
         pass  # connection died: fall through to removal
     except asyncio.CancelledError:
@@ -199,67 +331,88 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
 
 async def broker_receive_loop(broker: "Broker", identifier: str,
                               connection) -> None:
-    """Pump a peer broker's messages (broker/handler.rs:121-193)."""
+    """Pump a peer broker's messages (broker/handler.rs:121-193), batched
+    the same way as the user loop."""
     hook = broker.run_def.broker_def.hook
     topics = broker.run_def.topics
+    alive = True
     try:
-        while True:
-            raw = await connection.recv_raw()
+        while alive:
+            raws = await connection.recv_raw_many()
+            egress = EgressBatch(broker)
+            interest_cache: dict = {}
             try:
-                try:
-                    message = deserialize(raw.data)
-                except Error:
-                    logger.warning("broker %s sent malformed frame; dropping link",
-                                   identifier)
-                    break
-                result = hook(identifier, message)
-                if result == HookResult.SKIP:
-                    continue
-                if result == HookResult.DISCONNECT:
-                    break
+                for raw in raws:
+                    try:
+                        message = deserialize(raw.data)
+                    except Error:
+                        logger.warning(
+                            "broker %s sent malformed frame; dropping link",
+                            identifier)
+                        alive = False
+                        break
+                    result = hook(identifier, message)
+                    if result == HookResult.SKIP:
+                        continue
+                    if result == HookResult.DISCONNECT:
+                        alive = False
+                        break
 
-                device = broker.device_plane
-                # A covers_brokers (mesh-group) plane must NOT re-stage
-                # host-forwarded traffic: the origin couldn't stage it, and
-                # re-staging would all_gather it back to every shard —
-                # duplicate delivery. Host-forwarded frames are delivered
-                # locally only, exactly the reference's to_users_only rule.
-                single_shard = device is not None and not device.covers_brokers
-                if isinstance(message, Direct):
-                    # deliver to our own user only — never re-forward
-                    # (broker/handler.rs:148-153); the single-shard device
-                    # path's delivery-iff-owner rule keeps that invariant
-                    if single_shard:
-                        result = await _stage_with_backpressure(
-                            device, message, raw)
-                        if result == StageResult.STAGED:
-                            continue
-                    await handle_direct_message(
-                        broker, message.recipient, raw, to_user_only=True)
-                elif isinstance(message, Broadcast):
-                    # users only — prevents broadcast loops
-                    # (broker/handler.rs:156-161)
-                    pruned, _bad = topics.prune(message.topics)
-                    if pruned:
+                    device = broker.device_plane
+                    # A covers_brokers (mesh-group) plane must NOT re-stage
+                    # host-forwarded traffic: the origin couldn't stage it,
+                    # and re-staging would all_gather it back to every
+                    # shard — duplicate delivery. Host-forwarded frames are
+                    # delivered locally only, exactly the reference's
+                    # to_users_only rule.
+                    single_shard = (device is not None
+                                    and not device.covers_brokers)
+                    if isinstance(message, Direct):
+                        # deliver to our own user only — never re-forward
+                        # (broker/handler.rs:148-153); the single-shard
+                        # device path's delivery-iff-owner rule keeps that
+                        # invariant
                         if single_shard:
                             result = await _stage_with_backpressure(
                                 device, message, raw)
                             if result == StageResult.STAGED:
                                 continue
-                        await handle_broadcast_message(
-                            broker, pruned, raw, to_users_only=True)
-                elif isinstance(message, UserSync):
-                    broker.connections.apply_user_sync(message.payload)
-                    broker.update_metrics()
-                elif isinstance(message, TopicSync):
-                    broker.connections.apply_topic_sync(identifier,
-                                                        message.payload)
-                else:
-                    logger.warning("broker %s sent unexpected %s; dropping link",
-                                   identifier, type(message).__name__)
-                    break
+                        route_direct(broker, message.recipient, raw,
+                                     to_user_only=True, egress=egress)
+                    elif isinstance(message, Broadcast):
+                        # users only — prevents broadcast loops
+                        # (broker/handler.rs:156-161)
+                        pruned, _bad = topics.prune(message.topics)
+                        if pruned:
+                            if single_shard:
+                                result = await _stage_with_backpressure(
+                                    device, message, raw)
+                                if result == StageResult.STAGED:
+                                    continue
+                            route_broadcast(broker, pruned, raw,
+                                            to_users_only=True,
+                                            egress=egress,
+                                            interest_cache=interest_cache)
+                    elif isinstance(message, UserSync):
+                        broker.connections.apply_user_sync(message.payload)
+                        broker.update_metrics()
+                        interest_cache.clear()
+                    elif isinstance(message, TopicSync):
+                        broker.connections.apply_topic_sync(identifier,
+                                                            message.payload)
+                        interest_cache.clear()
+                    else:
+                        logger.warning(
+                            "broker %s sent unexpected %s; dropping link",
+                            identifier, type(message).__name__)
+                        alive = False
+                        break
             finally:
-                raw.release()
+                try:
+                    await egress.flush()
+                finally:
+                    for raw in raws:
+                        raw.release()
     except (Error, asyncio.IncompleteReadError):
         pass
     except asyncio.CancelledError:

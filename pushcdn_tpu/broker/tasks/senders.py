@@ -44,26 +44,26 @@ async def try_send_to_user(broker: "Broker", public_key: bytes,
 
 def try_send_frames_to_user_nowait(broker: "Broker", public_key: bytes,
                                    raws: Iterable[Bytes]) -> int:
-    """Queue a whole batch of frames to one user with a single connection
-    lookup (the device-plane egress delivers per-user groups). Returns
-    the number queued; a failure removes the user and stops the batch."""
+    """Queue a whole batch of frames to one user as ONE send queue entry
+    (single connection lookup, single writer wakeup — the device-plane
+    egress delivers per-user groups). Returns the number queued; a failure
+    removes the user."""
     connection = broker.connections.get_user_connection(public_key)
     if connection is None:
         return 0
-    sent = 0
-    for raw in raws:
-        clone = raw.clone()
-        try:
-            connection.send_raw_nowait(clone)
-            sent += 1
-        except Exception as exc:
-            clone.release()
-            logger.info("nowait send to user %s failed (%r); removing",
-                        mnemonic(public_key), exc)
-            broker.connections.remove_user(public_key, reason="send failed")
-            broker.update_metrics()
-            break
-    return sent
+    clones = [raw.clone() for raw in raws]
+    if not clones:
+        return 0
+    try:
+        # the connection owns the clones from here (released on failure too)
+        connection.send_raw_many_nowait(clones)
+        return len(clones)
+    except Exception as exc:
+        logger.info("nowait send to user %s failed (%r); removing",
+                    mnemonic(public_key), exc)
+        broker.connections.remove_user(public_key, reason="send failed")
+        broker.update_metrics()
+        return 0
 
 
 def egress_delivery_rows(broker: "Broker", slots, users, frame_idx,

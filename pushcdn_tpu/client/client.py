@@ -125,7 +125,9 @@ class Client:
     # -- messaging API (lib.rs:295-481) -------------------------------------
 
     async def send_message(self, message: Message) -> None:
-        conn = await self._get_connection()
+        conn = self._connection  # fast path: live connection, no coroutine
+        if conn is None or conn.is_closed:
+            conn = await self._get_connection()
         try:
             await conn.send_message(message)
         except Exception as exc:
@@ -142,7 +144,9 @@ class Client:
                                        message=payload))
 
     async def receive_message(self) -> Message:
-        conn = await self._get_connection()
+        conn = self._connection  # fast path: live connection, no coroutine
+        if conn is None or conn.is_closed:
+            conn = await self._get_connection()
         try:
             return await conn.recv_message()
         except Exception as exc:

@@ -57,6 +57,10 @@ def _compile() -> Optional[ctypes.CDLL]:
     lib.pushcdn_encode_frames.restype = ctypes.c_int64
     lib.pushcdn_encode_frames.argtypes = [
         u8p, i64p, i32p, ctypes.c_int32, u8p, ctypes.c_int64]
+    lib.pushcdn_encode_frames_ptrs.restype = ctypes.c_int64
+    lib.pushcdn_encode_frames_ptrs.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), i32p,
+        ctypes.c_int32, u8p, ctypes.c_int64]
     return lib
 
 
@@ -145,6 +149,89 @@ def scan_frames(buf: bytes, max_frame_len: int, max_frames: int = 4096
         max_frames, ctypes.byref(nframes), ctypes.byref(error))
     frames = [(int(out_off[i]), int(out_len[i])) for i in range(nframes.value)]
     return frames, int(consumed), bool(error.value)
+
+
+class FrameScanner:
+    """Reusable scan state for one connection's reader loop: the (offset,
+    length) output columns are allocated once and reused every chunk, and
+    results come back as plain-int lists via one ``tolist()`` call — the
+    per-frame Python cost of the wire scan is two list indexes.
+
+    ``None``-safe construction: ``FrameScanner.create()`` returns None when
+    the native library is unavailable (callers fall back to the Python
+    struct scan).
+    """
+
+    __slots__ = ("_lib", "_off", "_len", "max_frames")
+
+    def __init__(self, lib, max_frames: int):
+        self._lib = lib
+        self.max_frames = max_frames
+        self._off = np.zeros(max_frames, np.int64)
+        self._len = np.zeros(max_frames, np.int32)
+
+    @classmethod
+    def create(cls, max_frames: int = 8192) -> Optional["FrameScanner"]:
+        lib = _get()
+        return None if lib is None else cls(lib, max_frames)
+
+    def scan(self, buf, max_frame_len: int):
+        """Scan a ``bytearray``/``bytes`` carry buffer for complete frames.
+        Returns (offsets, lengths, consumed, error) with offsets/lengths as
+        plain-int lists pointing at payload starts."""
+        blen = len(buf)
+        if blen < 4:
+            return (), (), 0, False
+        arr = np.frombuffer(buf, np.uint8)  # zero-copy view
+        nframes = ctypes.c_int32(0)
+        error = ctypes.c_int32(0)
+        consumed = self._lib.pushcdn_scan_frames(
+            _ptr(arr, ctypes.c_uint8), blen, max_frame_len,
+            _ptr(self._off, ctypes.c_int64), _ptr(self._len, ctypes.c_int32),
+            self.max_frames, ctypes.byref(nframes), ctypes.byref(error))
+        n = nframes.value
+        return (self._off[:n].tolist(), self._len[:n].tolist(),
+                int(consumed), bool(error.value))
+
+
+class FrameEncoder:
+    """Reusable writer-side batch encoder: length-delimits many payloads
+    into one reusable output buffer with a single C call and a single copy
+    (payload pointers are passed directly — no intermediate join)."""
+
+    __slots__ = ("_lib", "_out", "_lens")
+
+    def __init__(self, lib, capacity: int):
+        self._lib = lib
+        self._out = bytearray(capacity)
+        self._lens = np.zeros(1024, np.int32)
+
+    @classmethod
+    def create(cls, capacity: int = 256 * 1024) -> Optional["FrameEncoder"]:
+        lib = _get()
+        return None if lib is None else cls(lib, capacity)
+
+    def encode(self, payloads: list) -> Optional[memoryview]:
+        """Encode ``payloads`` (bytes objects) as one length-delimited
+        stream; returns a memoryview over the internal buffer (valid until
+        the next call) or None when the batch doesn't fit."""
+        n = len(payloads)
+        if n > len(self._lens):
+            self._lens = np.zeros(max(n, 2 * len(self._lens)), np.int32)
+        lens = self._lens
+        lens[:n] = np.fromiter(map(len, payloads), np.int32, count=n)
+        total = int(lens[:n].sum()) + 4 * n
+        if total > len(self._out):
+            return None
+        ptrs = (ctypes.c_char_p * n)(*payloads)
+        out_ptr = (ctypes.c_uint8 * len(self._out)).from_buffer(self._out)
+        wrote = self._lib.pushcdn_encode_frames_ptrs(
+            ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_char_p)),
+            _ptr(lens, ctypes.c_int32), n,
+            ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_uint8)), len(self._out))
+        if wrote < 0:
+            return None
+        return memoryview(self._out)[:wrote]
 
 
 def encode_frames(payloads: list[bytes]) -> Optional[bytes]:

@@ -172,6 +172,20 @@ class MemoryPool:
         await self._sem.acquire(nbytes)
         return AllocationPermit(self, nbytes)
 
+    def try_allocate(self, nbytes: int) -> Optional[AllocationPermit]:
+        """Synchronous fast path: reserve ``nbytes`` without suspending, or
+        return None when the reservation would have to wait (FIFO fairness
+        preserved: never jumps an existing waiter). The reader's batch scan
+        uses this so the common non-backpressured case costs no awaits."""
+        if nbytes > self.capacity:
+            bail(ErrorKind.EXCEEDED_SIZE,
+                 f"message of {nbytes} B exceeds pool capacity {self.capacity} B")
+        sem = self._sem
+        if sem._wait_list or nbytes > sem._available:
+            return None
+        sem._available -= nbytes
+        return AllocationPermit(self, nbytes)
+
     def _on_release(self, nbytes: int, lifetime_s: float) -> None:
         self._sem.release(nbytes)
         if len(self.latency_samples) < self._latency_cap:

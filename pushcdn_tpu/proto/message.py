@@ -204,20 +204,13 @@ def serialize(msg: Message) -> bytes:
     kind = msg.kind
     try:
         if kind == KIND_DIRECT:
-            out = bytearray(1 + 4 + len(msg.recipient))
-            out[0] = kind
-            _U32.pack_into(out, 1, len(msg.recipient))
-            out[5:] = msg.recipient
-            out += msg.message
-            frame = bytes(out)
+            recipient = msg.recipient
+            frame = b"".join((b"\x04", _U32.pack(len(recipient)), recipient,
+                              msg.message))
         elif kind == KIND_BROADCAST:
             topics = msg.topics
-            out = bytearray(1 + 2 + len(topics))
-            out[0] = kind
-            _U16.pack_into(out, 1, len(topics))
-            out[3:3 + len(topics)] = bytes(topics)
-            out += msg.message
-            frame = bytes(out)
+            frame = b"".join((b"\x05", _U16.pack(len(topics)), bytes(topics),
+                              msg.message))
         elif kind in (KIND_SUBSCRIBE, KIND_UNSUBSCRIBE):
             topics = msg.topics
             out = bytearray(1 + 2 + len(topics))
@@ -338,6 +331,31 @@ def materialize(msg: Message) -> Message:
         cls = UserSync if kind == KIND_USER_SYNC else TopicSync
         return cls(payload=bytes(msg.payload))
     return msg
+
+
+def deserialize_owned(frame: BytesLike) -> Message:
+    """``materialize(deserialize(frame))`` fused for the hot variants: when
+    ``frame`` is immutable ``bytes`` (the reader's complete-frame payloads
+    are), slicing it copies directly — one object construction and one copy
+    instead of view + materialize + recopy. Convenience receive APIs use
+    this; semantics are identical to the two-step path."""
+    if type(frame) is bytes:
+        n = len(frame)
+        if n >= 1:
+            kind = frame[0]
+            if kind == KIND_DIRECT:
+                (rlen,) = _U32.unpack_from(frame, 1)
+                if 5 + rlen <= n:
+                    return Direct(recipient=frame[5:5 + rlen],
+                                  message=frame[5 + rlen:])
+                bail(ErrorKind.DESERIALIZE, "Direct recipient overruns frame")
+            if kind == KIND_BROADCAST:
+                (ntopics,) = _U16.unpack_from(frame, 1)
+                if 3 + ntopics <= n:
+                    return Broadcast(topics=tuple(frame[3:3 + ntopics]),
+                                     message=frame[3 + ntopics:])
+                bail(ErrorKind.DESERIALIZE, "Broadcast topics overrun frame")
+    return materialize(deserialize(frame))
 
 
 def peek_kind(frame: BytesLike) -> int:

@@ -24,12 +24,20 @@ from __future__ import annotations
 import abc
 import asyncio
 import struct
-from typing import Optional
+from collections import deque
+from typing import List, Optional
 
+from pushcdn_tpu import native
 from pushcdn_tpu.proto import MAX_MESSAGE_SIZE
 from pushcdn_tpu.proto.error import Error, ErrorKind, bail
 from pushcdn_tpu.proto.limiter import Bytes, Limiter, NO_LIMIT
-from pushcdn_tpu.proto.message import Message, deserialize, materialize, serialize
+from pushcdn_tpu.proto.message import (
+    Message,
+    deserialize,
+    deserialize_owned,
+    materialize,
+    serialize,
+)
 from pushcdn_tpu.proto import metrics as metrics_mod
 
 # Parity: 5 s read/write timeouts (protocols/mod.rs:336, :368, :379) and a
@@ -41,6 +49,28 @@ CONNECT_TIMEOUT_S = 5.0
 _LEN = struct.Struct(">I")
 
 _CLOSE = object()  # sentinel queued to ask the writer task to soft-close
+
+
+def _py_scan_frames(buf, max_frame_len: int):
+    """Python fallback for native.FrameScanner.scan: walk a carry buffer
+    for complete length-delimited frames. Returns (payload_offsets,
+    payload_lengths, consumed, oversized_error)."""
+    offs: list = []
+    lens: list = []
+    pos = 0
+    blen = len(buf)
+    error = False
+    while blen - pos >= 4:
+        (length,) = _LEN.unpack_from(buf, pos)
+        if length > max_frame_len:
+            error = True
+            break
+        if blen - pos - 4 < length:
+            break
+        offs.append(pos + 4)
+        lens.append(length)
+        pos += 4 + length
+    return offs, lens, pos, error
 
 
 class RawStream(abc.ABC):
@@ -125,6 +155,9 @@ class Connection:
         qsize = limiter.queue_size()
         self._send_q: asyncio.Queue = asyncio.Queue(maxsize=qsize)
         self._recv_q: asyncio.Queue = asyncio.Queue(maxsize=qsize)
+        # frames already popped off _recv_q but not yet handed to a caller
+        # (the reader enqueues whole parse batches; receivers drain here)
+        self._recv_pending: deque = deque()
         self._error: Optional[Error] = None
         self._closed = False
         self._writer_task = asyncio.create_task(self._writer_loop())
@@ -147,6 +180,11 @@ class Connection:
         metrics_mod.BYTES_SENT.inc(len(buf))
 
     async def _writer_loop(self) -> None:
+        # the native batch encoder length-delimits a run of small frames in
+        # one C call + one copy (the verdict's "egress batches ... through
+        # encode_frames"); None ⇒ the Python coalescer below does it
+        encoder = native.FrameEncoder.create(4 * self._BATCH_COALESCE_LIMIT)
+        enc_cap = 3 * self._BATCH_COALESCE_LIMIT
         batch: list = []
         try:
             while True:
@@ -165,17 +203,60 @@ class Connection:
                     if nxt is _CLOSE:
                         break
 
-                buf = bytearray()
                 dones = []
                 close_after = False
                 try:
+                    # flatten: an entry's payload is one frame or a whole
+                    # list of frames (send_raw_many batches)
+                    frames: list = []
                     for entry in batch:
                         if entry is _CLOSE:
                             close_after = True
                             break
                         payload, done = entry
-                        data = payload.data if isinstance(payload, Bytes) else payload
+                        if type(payload) is list:
+                            for p in payload:
+                                frames.append(
+                                    p.data if isinstance(p, Bytes) else p)
+                        else:
+                            frames.append(payload.data
+                                          if isinstance(payload, Bytes)
+                                          else payload)
+                        if done is not None:
+                            dones.append(done)
+
+                    buf = bytearray()
+                    i, nf = 0, len(frames)
+                    while i < nf:
+                        data = frames[i]
                         n = len(data)
+                        if encoder is not None and type(data) is bytes \
+                                and n <= self._BATCH_COALESCE_LIMIT:
+                            # native run: consecutive small bytes frames
+                            j, total = i, 0
+                            while j < nf:
+                                d = frames[j]
+                                if type(d) is not bytes:
+                                    break
+                                ln = len(d)
+                                if ln > self._BATCH_COALESCE_LIMIT or \
+                                        total + ln + 4 > enc_cap:
+                                    break
+                                total += ln + 4
+                                j += 1
+                            if j - i > 1:
+                                if buf:
+                                    await self._flush(buf)
+                                    buf = bytearray()
+                                enc = encoder.encode(frames[i:j])
+                                if enc is not None:
+                                    try:
+                                        await self._flush(enc)
+                                    finally:
+                                        enc.release()
+                                    i = j
+                                    continue
+                                # encode failed (shouldn't): python path
                         if n <= self._BATCH_COALESCE_LIMIT:
                             buf += _LEN.pack(n)
                             buf += data
@@ -194,14 +275,20 @@ class Connection:
                             chunk = 4 * self._BATCH_COALESCE_LIMIT
                             for off in range(0, n, chunk):
                                 await self._flush(bytearray(view[off:off + chunk]))
-                        if done is not None:
-                            dones.append(done)
+                        i += 1
                     if buf:
                         await self._flush(buf)
                 finally:
                     for entry in batch:
-                        if entry is not _CLOSE and isinstance(entry[0], Bytes):
-                            entry[0].release()
+                        if entry is _CLOSE:
+                            continue
+                        p = entry[0]
+                        if type(p) is list:
+                            for x in p:
+                                if isinstance(x, Bytes):
+                                    x.release()
+                        elif isinstance(p, Bytes):
+                            p.release()
                 batch = []
                 for done in dones:
                     if not done.done():
@@ -228,6 +315,8 @@ class Connection:
 
     async def _reader_loop(self) -> None:
         buf = bytearray()
+        scanner = native.FrameScanner.create()
+        pool = self._limiter.pool
         try:
             while True:
                 # The per-frame 5 s read timeout (mod.rs:336) now applies to
@@ -239,64 +328,101 @@ class Connection:
                 else:
                     chunk = await self._stream.read_some(self._READ_CHUNK)
                 buf += chunk
-                off = 0
-                blen = len(buf)
-                # one exported view per chunk: slicing it yields bytes in a
-                # single copy (a bytearray slice + bytes() would be two);
-                # must be released before the bytearray is resized
-                mv = memoryview(buf)
-                while blen - off >= 4:
-                    (length,) = _LEN.unpack_from(buf, off)
-                    if length > MAX_MESSAGE_SIZE:
-                        mv.release()
+
+                # Scan every complete frame out of the carry buffer (one C
+                # call via native.scan_frames when available) and hand the
+                # whole batch to the receive queue in ONE put — per-frame
+                # asyncio machinery is what bounded small-frame throughput.
+                while len(buf) >= 4:
+                    if scanner is not None and len(buf) >= 4096:
+                        offs, lens, consumed, oversized = scanner.scan(
+                            buf, MAX_MESSAGE_SIZE)
+                    else:
+                        # tiny buffers (one or two frames — the latency
+                        # regime) scan faster in Python than via ctypes
+                        offs, lens, consumed, oversized = _py_scan_frames(
+                            buf, MAX_MESSAGE_SIZE)
+                    if offs:
+                        batch: List[Bytes] = []
+                        try:
+                            mv = memoryview(buf)
+                            try:
+                                for o, ln in zip(offs, lens):
+                                    # one copy detaches the payload from the
+                                    # carry buffer
+                                    payload = bytes(mv[o:o + ln])
+                                    permit = None
+                                    if pool is not None:
+                                        # sync fast path; when the pool is
+                                        # exhausted, hand over what we have
+                                        # FIRST (consumers releasing those
+                                        # frames are what refill the pool),
+                                        # then block — backpressure still
+                                        # stops the socket: no further
+                                        # read_some until we get through
+                                        permit = pool.try_allocate(ln)
+                                        if permit is None:
+                                            if batch:
+                                                await self._recv_q.put(batch)
+                                                batch = []
+                                            permit = await pool.allocate(ln)
+                                    batch.append(Bytes(payload, permit))
+                            finally:
+                                mv.release()
+                        except BaseException:
+                            for b in batch:
+                                b.release()
+                            raise
+                        metrics_mod.BYTES_RECV.inc(consumed)
+                        if batch:
+                            await self._recv_q.put(batch)
+                        del buf[:consumed]
+                    if oversized:
+                        # announced length beyond MAX_MESSAGE_SIZE ⇒ peer
+                        # violation (preceding good frames were delivered)
+                        (length,) = _LEN.unpack_from(buf, 0)
                         raise Error(ErrorKind.EXCEEDED_SIZE,
                                     f"peer announced {length} B frame")
-                    if blen - off - 4 < length:
-                        # Incomplete frame: acquire the pool permit BEFORE
-                        # buffering the remainder (mod.rs:328 — backpressure
-                        # lands on the socket), then stream straight into
-                        # one preallocated buffer (no reassembly copy), one
-                        # progress-timeout window per chunk rather than one
-                        # for the whole payload.
-                        permit = await self._limiter.allocate_message_bytes(
-                            length)
+                    if not offs:
+                        break
+                    if scanner is not None and len(offs) == scanner.max_frames:
+                        continue  # scanner capacity hit: rescan remainder
+                    break
+
+                # Remainder is at most one incomplete frame (at offset 0):
+                # acquire the pool permit BEFORE buffering the payload
+                # (mod.rs:328 — backpressure lands on the socket), then
+                # stream straight into one preallocated buffer, one
+                # progress-timeout window per chunk.
+                blen = len(buf)
+                if blen >= 4:
+                    (length,) = _LEN.unpack_from(buf, 0)
+                    permit = None
+                    if pool is not None:
+                        permit = pool.try_allocate(length)
+                        if permit is None:
+                            permit = await pool.allocate(length)
+                    try:
+                        out = bytearray(length)
+                        pos = blen - 4
+                        out[:pos] = memoryview(buf)[4:blen]
+                        del buf[:]
+                        mv = memoryview(out)
                         try:
-                            out = bytearray(length)
-                            pos = blen - off - 4
-                            out[:pos] = mv[off + 4:blen]
-                            mv.release()
-                            del buf[:]
-                            off = 0
-                            blen = 0
-                            mv = memoryview(out)
                             while pos < length:
                                 async with asyncio.timeout(READ_TIMEOUT_S):
                                     chunk = await self._stream.read_some(
                                         min(length - pos, 4 * self._READ_CHUNK))
                                 mv[pos:pos + len(chunk)] = chunk
                                 pos += len(chunk)
-                        except BaseException:
-                            if permit is not None:
-                                permit.release()
-                            raise
-                        metrics_mod.BYTES_RECV.inc(length + 4)
-                        await self._recv_q.put(Bytes(out, permit))
-                        continue
-                    # Complete frame in the buffer. The permit is acquired
-                    # after the bytes were read — the overshoot is bounded
-                    # by _READ_CHUNK, and a blocked permit still stops the
-                    # socket (no further read_some until the put succeeds).
-                    payload = bytes(mv[off + 4:off + 4 + length])
-                    off += 4 + length
-                    permit = await self._limiter.allocate_message_bytes(length)
+                        finally:
+                            mv.release()
+                    except BaseException:
+                        if permit is not None:
+                            permit.release()
+                        raise
                     metrics_mod.BYTES_RECV.inc(length + 4)
-                    await self._recv_q.put(Bytes(payload, permit))
-                else:
-                    # loop fell through (≤3 leftover bytes): release the
-                    # view so the carry buffer can be resized
-                    mv.release()
-                if off:
-                    del buf[:off]
+                    await self._recv_q.put([Bytes(out, permit)])
         except asyncio.CancelledError:
             raise
         except asyncio.IncompleteReadError as exc:
@@ -330,20 +456,29 @@ class Connection:
             if item is _CLOSE:
                 continue
             payload, done = item
-            if isinstance(payload, Bytes):
+            if type(payload) is list:
+                for p in payload:
+                    if isinstance(p, Bytes):
+                        p.release()
+            elif isinstance(payload, Bytes):
                 payload.release()
             if done is not None and not done.done():
                 if err is not None:
                     done.set_exception(err)
                 else:
                     done.cancel()
+        while self._recv_pending:
+            item = self._recv_pending.popleft()
+            if isinstance(item, Bytes):
+                item.release()
         while True:
             try:
                 item = self._recv_q.get_nowait()
             except asyncio.QueueEmpty:
                 break
-            if isinstance(item, Bytes):
-                item.release()
+            if isinstance(item, list):
+                for p in item:
+                    p.release()
 
     def _check(self) -> None:
         if self._error is not None:
@@ -381,30 +516,122 @@ class Connection:
         if self._error is not None:
             raise self._error
 
+    async def send_raw_many(self, raws: list, flush: bool = False) -> None:
+        """Queue a whole batch of pre-serialized frames as ONE queue entry
+        (one writer wakeup for the lot) — the routing loops build per-peer
+        batches and hand them over here.
+
+        Ownership semantics are stricter than :meth:`send_raw`: every
+        :class:`Bytes` in ``raws`` is ALWAYS released by this connection —
+        by the writer after flushing, by the poison drain, or right here
+        when the frames never made it into the queue — so callers must not
+        release on failure (no double-release of fan-out clones)."""
+        try:
+            self._check()
+            done = asyncio.get_running_loop().create_future() if flush else None
+        except BaseException:
+            for p in raws:
+                if isinstance(p, Bytes):
+                    p.release()
+            raise
+        try:
+            await self._send_q.put((raws, done))
+        except BaseException:
+            # cancelled while blocked on a bounded queue: never inserted
+            for p in raws:
+                if isinstance(p, Bytes):
+                    p.release()
+            raise
+        if self._error is not None:
+            # poisoned around the enqueue: the poison drain may have run
+            # before our insert landed, so drain again (idempotent) to
+            # guarantee the batch's permits return to the pool
+            self._drain_queues(self._error)
+            raise self._error
+        if done is not None:
+            await done
+
+    def send_raw_many_nowait(self, raws: list) -> None:
+        """Batch variant of :meth:`send_raw_nowait` (one entry, no await),
+        with :meth:`send_raw_many`'s ownership rule: the frames are always
+        released by the connection, never by the caller."""
+        try:
+            self._check()
+            self._send_q.put_nowait((raws, None))
+        except BaseException:
+            for p in raws:
+                if isinstance(p, Bytes):
+                    p.release()
+            raise
+        if self._error is not None:
+            self._drain_queues(self._error)
+            raise self._error
+
     async def recv_message(self) -> Message:
         """Receive + decode one message, copying payload views out of the
         receive buffer so the pool permit can be released immediately. Hot
         paths that fan raw frames out should use :meth:`recv_raw` and
         release after the last send instead."""
-        raw = await self.recv_raw()
+        pending = self._recv_pending
+        raw = pending.popleft() if pending else await self.recv_raw()
         try:
-            return materialize(deserialize(raw.data))
+            return deserialize_owned(raw.data)
         finally:
             raw.release()
 
     async def recv_raw(self) -> Bytes:
         """Receive one frame as refcounted :class:`Bytes` (permit attached)."""
-        if self._error is not None and self._recv_q.empty():
-            raise self._error
-        item = await self._recv_q.get()
-        if isinstance(item, Error):
-            # keep the poison visible to subsequent callers
+        pending = self._recv_pending
+        while not pending:
+            if self._error is not None and self._recv_q.empty():
+                raise self._error
+            item = await self._recv_q.get()
+            if isinstance(item, Error):
+                # keep the poison visible to subsequent callers
+                try:
+                    self._recv_q.put_nowait(item)
+                except asyncio.QueueFull:
+                    pass
+                raise item
+            pending.extend(item)
+        return pending.popleft()
+
+    async def recv_raw_many(self, limit: int = 4096) -> List[Bytes]:
+        """Receive every frame currently available (at least one; blocks
+        only when none are pending). The routing loops drain with this so
+        one task wakeup routes a whole parse batch."""
+        pending = self._recv_pending
+        while not pending:
+            if self._error is not None and self._recv_q.empty():
+                raise self._error
+            item = await self._recv_q.get()
+            if isinstance(item, Error):
+                try:
+                    self._recv_q.put_nowait(item)
+                except asyncio.QueueFull:
+                    pass
+                raise item
+            pending.extend(item)
+        # opportunistically drain whatever else is already queued
+        while len(pending) < limit:
             try:
-                self._recv_q.put_nowait(item)
-            except asyncio.QueueFull:
-                pass
-            raise item
-        return item
+                item = self._recv_q.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if isinstance(item, Error):
+                # deliver the batch first; the error surfaces on the next call
+                try:
+                    self._recv_q.put_nowait(item)
+                except asyncio.QueueFull:
+                    pass
+                break
+            pending.extend(item)
+        if len(pending) <= limit:
+            out = list(pending)
+            pending.clear()
+        else:
+            out = [pending.popleft() for _ in range(limit)]
+        return out
 
     async def soft_close(self) -> None:
         """Flush queued frames, then close the write side (parity

@@ -10,7 +10,7 @@ This is the seam that lets whole-system integration tests (marshal + brokers
 from __future__ import annotations
 
 import asyncio
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 from pushcdn_tpu.proto.error import Error, ErrorKind, bail
 from pushcdn_tpu.proto.limiter import Limiter, NO_LIMIT
@@ -24,14 +24,20 @@ from pushcdn_tpu.proto.transport.base import (
 
 _DUPLEX_BUFFER = 8192  # parity: 8192-byte duplex buffers (memory.rs)
 
+# The conformance default stays at the reference's 8 KiB; deployments and
+# benches that push large frames through the in-process transport can widen
+# it (``Memory.set_duplex_window``) so the window constant — test-infra
+# parity, not a behavioral guarantee — doesn't bound throughput.
+_duplex_window = _DUPLEX_BUFFER
+
 
 class _BoundedBuffer:
     """A bounded in-process byte buffer with real backpressure: writers
     block while ``len >= capacity`` (parity with the reference's 8192-byte
     duplex halves — a fast producer cannot grow memory unboundedly)."""
 
-    def __init__(self, capacity: int = _DUPLEX_BUFFER):
-        self.capacity = capacity
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = capacity if capacity is not None else _duplex_window
         self._buf = bytearray()
         self._eof = False
         self._cond = asyncio.Condition()
@@ -73,9 +79,14 @@ class _BoundedBuffer:
                 if self._eof:
                     raise asyncio.IncompleteReadError(b"", 1)
                 await self._cond.wait()
-            take = min(max_n, len(self._buf))
-            out = bytes(self._buf[:take])
-            del self._buf[:take]
+            blen = len(self._buf)
+            if max_n >= blen:
+                # whole-buffer take: one copy, no O(n) del-compaction
+                out = bytes(self._buf)
+                self._buf.clear()
+            else:
+                out = bytes(self._buf[:max_n])
+                del self._buf[:max_n]
             self._cond.notify_all()
             return out
 
@@ -165,6 +176,16 @@ class Memory(Protocol):
     """The in-process transport (parity protocols/memory.rs)."""
 
     name = "memory"
+
+    @staticmethod
+    def set_duplex_window(capacity: int) -> int:
+        """Set the duplex-buffer capacity used by subsequently-created
+        connections; returns the previous value. 8192 (the reference
+        constant) is the default."""
+        global _duplex_window
+        prev = _duplex_window
+        _duplex_window = capacity
+        return prev
 
     @classmethod
     async def connect(cls, endpoint: str, use_local_authority: bool = True,
