@@ -192,6 +192,30 @@ class Connection:
                 if item is _CLOSE:
                     await self._stream.close()
                     return
+                # Depth-1 fast path (the latency regime): one small single
+                # frame and nothing else queued — write it directly, skipping
+                # batch assembly, the get_nowait exception, flattening and
+                # encoder probing. This is what a handshake or an idle-link
+                # echo pays per message.
+                if self._send_q.empty():
+                    payload, done = item
+                    if type(payload) is not list:
+                        data = payload.data if isinstance(payload, Bytes) \
+                            else payload
+                        n = len(data)
+                        if n <= self._BATCH_COALESCE_LIMIT:
+                            batch = [item]
+                            try:
+                                one = bytearray(_LEN.pack(n))
+                                one += data
+                                await self._flush(one)
+                            finally:
+                                if isinstance(payload, Bytes):
+                                    payload.release()
+                            batch = []
+                            if done is not None and not done.done():
+                                done.set_result(None)
+                            continue
                 # Drain everything queued right now into one write batch.
                 batch = [item]
                 while len(batch) < 512:
@@ -297,6 +321,14 @@ class Connection:
                     await self._stream.close()
                     return
         except asyncio.CancelledError:
+            # close() cancels the writer mid-flush: flush=True senders whose
+            # entries were already dequeued are beyond _drain_queues' reach
+            # and must not await forever (matches the drain's err=None
+            # cancel semantics)
+            for entry in batch:
+                if entry is not _CLOSE and entry[1] is not None \
+                        and not entry[1].done():
+                    entry[1].cancel()
             raise
         except Exception as exc:
             err = Error(ErrorKind.CONNECTION, f"write failed: {exc!r}", exc)
@@ -312,6 +344,20 @@ class Connection:
     # carry buffer — the old two-awaits-per-frame loop spent ~70% of small-
     # frame time in per-frame asyncio machinery (timeout contexts, wakeups).
     _READ_CHUNK = 256 * 1024
+
+    async def _put_recv(self, item) -> None:
+        """Queue parsed frames, releasing their permits if the put is
+        interrupted (a cancelled put never inserts — without this, a reader
+        cancelled while blocked on a full bounded queue leaks pool bytes)."""
+        try:
+            await self._recv_q.put(item)
+        except BaseException:
+            if type(item) is Bytes:
+                item.release()
+            else:
+                for b in item:
+                    b.release()
+            raise
 
     async def _reader_loop(self) -> None:
         buf = bytearray()
@@ -329,11 +375,40 @@ class Connection:
                     chunk = await self._stream.read_some(self._READ_CHUNK)
                 buf += chunk
 
+                # Depth-1 fast path (the latency regime): the chunk completed
+                # exactly one frame — hand the bare Bytes to the receive
+                # queue, skipping the scanner, the batch list, and the
+                # pending-deque indirection on the consumer side.
+                blen = len(buf)
+                if blen >= 4:
+                    (length,) = _LEN.unpack_from(buf, 0)
+                    if length <= MAX_MESSAGE_SIZE and blen == 4 + length:
+                        payload = bytes(memoryview(buf)[4:])
+                        permit = None
+                        if pool is not None:
+                            permit = pool.try_allocate(length)
+                            if permit is None:
+                                permit = await pool.allocate(length)
+                        del buf[:]
+                        metrics_mod.BYTES_RECV.inc(blen)
+                        await self._put_recv(Bytes(payload, permit))
+                        continue
+
                 # Scan every complete frame out of the carry buffer (one C
                 # call via native.scan_frames when available) and hand the
                 # whole batch to the receive queue in ONE put — per-frame
                 # asyncio machinery is what bounded small-frame throughput.
                 while len(buf) >= 4:
+                    # Peek the first header before scanning: a buffer that
+                    # cannot hold one complete frame (the large-frame partial
+                    # case) must not pay a scan — the tail streamer below
+                    # takes it directly.
+                    (first_len,) = _LEN.unpack_from(buf, 0)
+                    if first_len > MAX_MESSAGE_SIZE:
+                        raise Error(ErrorKind.EXCEEDED_SIZE,
+                                    f"peer announced {first_len} B frame")
+                    if len(buf) < 4 + first_len:
+                        break
                     if scanner is not None and len(buf) >= 4096:
                         offs, lens, consumed, oversized = scanner.scan(
                             buf, MAX_MESSAGE_SIZE)
@@ -342,49 +417,55 @@ class Connection:
                         # regime) scan faster in Python than via ctypes
                         offs, lens, consumed, oversized = _py_scan_frames(
                             buf, MAX_MESSAGE_SIZE)
-                    if offs:
-                        batch: List[Bytes] = []
+                    # The peek guarantees at least one complete frame, so the
+                    # scan always yields offsets.
+                    batch: List[Bytes] = []
+                    try:
+                        mv = memoryview(buf)
                         try:
-                            mv = memoryview(buf)
-                            try:
-                                for o, ln in zip(offs, lens):
-                                    # one copy detaches the payload from the
-                                    # carry buffer
-                                    payload = bytes(mv[o:o + ln])
-                                    permit = None
-                                    if pool is not None:
-                                        # sync fast path; when the pool is
-                                        # exhausted, hand over what we have
-                                        # FIRST (consumers releasing those
-                                        # frames are what refill the pool),
-                                        # then block — backpressure still
-                                        # stops the socket: no further
-                                        # read_some until we get through
-                                        permit = pool.try_allocate(ln)
-                                        if permit is None:
-                                            if batch:
-                                                await self._recv_q.put(batch)
-                                                batch = []
-                                            permit = await pool.allocate(ln)
-                                    batch.append(Bytes(payload, permit))
-                            finally:
-                                mv.release()
-                        except BaseException:
-                            for b in batch:
-                                b.release()
-                            raise
-                        metrics_mod.BYTES_RECV.inc(consumed)
-                        if batch:
-                            await self._recv_q.put(batch)
-                        del buf[:consumed]
+                            for o, ln in zip(offs, lens):
+                                # one copy detaches the payload from the
+                                # carry buffer
+                                payload = bytes(mv[o:o + ln])
+                                permit = None
+                                if pool is not None:
+                                    # sync fast path; when the pool is
+                                    # exhausted, hand over what we have
+                                    # FIRST (consumers releasing those
+                                    # frames are what refill the pool),
+                                    # then block — backpressure still
+                                    # stops the socket: no further
+                                    # read_some until we get through
+                                    permit = pool.try_allocate(ln)
+                                    if permit is None:
+                                        if batch:
+                                            # hand ownership over BEFORE the
+                                            # await: a cancelled _put_recv
+                                            # releases the frames itself, and
+                                            # the outer handler must not see
+                                            # them again (double-release)
+                                            handoff, batch = batch, []
+                                            await self._put_recv(handoff)
+                                        permit = await pool.allocate(ln)
+                                batch.append(Bytes(payload, permit))
+                        finally:
+                            mv.release()
+                    except BaseException:
+                        for b in batch:
+                            b.release()
+                        raise
+                    metrics_mod.BYTES_RECV.inc(consumed)
+                    if batch:
+                        await self._put_recv(
+                            batch[0] if len(batch) == 1 else batch)
+                    del buf[:consumed]
                     if oversized:
-                        # announced length beyond MAX_MESSAGE_SIZE ⇒ peer
-                        # violation (preceding good frames were delivered)
+                        # a LATER announced length beyond MAX_MESSAGE_SIZE ⇒
+                        # peer violation (preceding good frames were
+                        # delivered first)
                         (length,) = _LEN.unpack_from(buf, 0)
                         raise Error(ErrorKind.EXCEEDED_SIZE,
                                     f"peer announced {length} B frame")
-                    if not offs:
-                        break
                     if scanner is not None and len(offs) == scanner.max_frames:
                         continue  # scanner capacity hit: rescan remainder
                     break
@@ -422,7 +503,7 @@ class Connection:
                             permit.release()
                         raise
                     metrics_mod.BYTES_RECV.inc(length + 4)
-                    await self._recv_q.put([Bytes(out, permit)])
+                    await self._put_recv(Bytes(out, permit))
         except asyncio.CancelledError:
             raise
         except asyncio.IncompleteReadError as exc:
@@ -479,6 +560,8 @@ class Connection:
             if isinstance(item, list):
                 for p in item:
                     p.release()
+            elif isinstance(item, Bytes):
+                item.release()
 
     def _check(self) -> None:
         if self._error is not None:
@@ -586,6 +669,8 @@ class Connection:
             if self._error is not None and self._recv_q.empty():
                 raise self._error
             item = await self._recv_q.get()
+            if type(item) is Bytes:  # depth-1 fast path: bare frame
+                return item
             if isinstance(item, Error):
                 # keep the poison visible to subsequent callers
                 try:
@@ -605,6 +690,11 @@ class Connection:
             if self._error is not None and self._recv_q.empty():
                 raise self._error
             item = await self._recv_q.get()
+            if type(item) is Bytes:  # depth-1 fast path: bare frame
+                if self._recv_q.empty():
+                    return [item]
+                pending.append(item)
+                break
             if isinstance(item, Error):
                 try:
                     self._recv_q.put_nowait(item)
@@ -618,6 +708,9 @@ class Connection:
                 item = self._recv_q.get_nowait()
             except asyncio.QueueEmpty:
                 break
+            if type(item) is Bytes:
+                pending.append(item)
+                continue
             if isinstance(item, Error):
                 # deliver the batch first; the error surfaces on the next call
                 try:
