@@ -49,13 +49,6 @@ from pushcdn_tpu.proto.topic import TopicSpace
 from pushcdn_tpu.proto.transport.memory import Memory
 from pushcdn_tpu.testing import Cluster, wait_mesh_interest, wait_until
 
-# The Memory transport's conformance default window is the reference's
-# 8 KiB duplex constant — test-infra parity, and at 1 KiB frames it caps
-# every read chunk (and therefore every batch through the edge pump) at ~7
-# frames. Benches model the production edge (TCP with ~256 KiB kernel
-# buffers), so widen it; see BASELINE.md "Methodology notes".
-Memory.set_duplex_window(256 * 1024)
-
 RESULTS: list[dict] = []
 
 
@@ -308,11 +301,21 @@ async def bench_eight_broker_device_mesh(msgs: int):
 async def amain(quick: bool):
     from pushcdn_tpu.bin.common import tune_gc
     tune_gc()  # the binaries' server GC tuning; see bin/common.py
-    await bench_two_broker_fanout(msgs=100 if quick else 500)
-    await bench_topic_pubsub(per_topic=16 if quick else 64,
-                             rounds=20 if quick else 100)
-    await bench_eight_broker_mesh(msgs=100 if quick else 400)
-    await bench_eight_broker_device_mesh(msgs=100 if quick else 400)
+    # The Memory transport's conformance default window is the reference's
+    # 8 KiB duplex constant — test-infra parity, and at 1 KiB frames it caps
+    # every read chunk (and therefore every batch through the edge pump) at
+    # ~7 frames. Benches model the production edge (TCP with ~256 KiB kernel
+    # buffers), so widen it for the duration of the run and restore after —
+    # anything else importing this module must keep the 8 KiB parity.
+    prev_window = Memory.set_duplex_window(256 * 1024)
+    try:
+        await bench_two_broker_fanout(msgs=100 if quick else 500)
+        await bench_topic_pubsub(per_topic=16 if quick else 64,
+                                 rounds=20 if quick else 100)
+        await bench_eight_broker_mesh(msgs=100 if quick else 400)
+        await bench_eight_broker_device_mesh(msgs=100 if quick else 400)
+    finally:
+        Memory.set_duplex_window(prev_window)
 
 
 def main():

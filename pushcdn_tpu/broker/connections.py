@@ -82,6 +82,12 @@ class Connections:
         # our own advertised-topics CRDT + previous snapshot for deltas
         self.our_topic_map: VersionedMap = VersionedMap(local_identity=identity)
         self._previous_local_topics: Set[Topic] = set()
+        # Bumped by every mutation that can change an interest query's
+        # answer; receive loops' per-batch interest caches validate against
+        # it so a subscribe/sync landing from ANOTHER task mid-batch (the
+        # batch awaits on egress/device backpressure) invalidates the cache
+        # the same way the reference's per-message query would see it.
+        self.interest_version = 0
 
     # ---- users ------------------------------------------------------------
 
@@ -97,6 +103,7 @@ class Connections:
                         mnemonic(public_key))
             self._teardown(existing)
             self.user_topics.remove_key(public_key)
+        self.interest_version += 1
         self.users[public_key] = UserHandle(connection, abort_handle)
         if topics:
             self.user_topics.associate_key_with_values(public_key, topics)
@@ -111,6 +118,7 @@ class Connections:
         if handle is None:
             return
         self._teardown(handle)
+        self.interest_version += 1
         self.user_topics.remove_key(public_key)
         # Release our DirectMap claim only if we still hold it — a newer
         # claim by another broker must not be clobbered.
@@ -139,6 +147,7 @@ class Connections:
             logger.info("broker %s reconnected; evicting old link", identifier)
             self._teardown(existing)
             self.broker_topics.remove_key(identifier)
+        self.interest_version += 1
         self.brokers[identifier] = BrokerHandle(
             connection, abort_handle,
             topic_sync_map=VersionedMap(local_identity=identifier))
@@ -149,6 +158,7 @@ class Connections:
         if handle is None:
             return
         self._teardown(handle)
+        self.interest_version += 1
         self.broker_topics.remove_key(identifier)
         # Forget (locally, without tombstoning) every user the dead peer
         # owned — they will re-appear when they reconnect elsewhere
@@ -176,6 +186,7 @@ class Connections:
     def subscribe_user_to(self, public_key: UserPublicKey,
                           topics: List[Topic]) -> None:
         if public_key in self.users and topics:
+            self.interest_version += 1
             self.user_topics.associate_key_with_values(public_key, topics)
             if self.observer is not None:
                 self.observer.on_subscription_changed(
@@ -184,6 +195,7 @@ class Connections:
     def unsubscribe_user_from(self, public_key: UserPublicKey,
                               topics: List[Topic]) -> None:
         if topics:
+            self.interest_version += 1
             self.user_topics.dissociate_key_from_values(public_key, topics)
             if self.observer is not None:
                 self.observer.on_subscription_changed(
@@ -191,11 +203,13 @@ class Connections:
 
     def subscribe_broker_to(self, identifier: str, topics: List[Topic]) -> None:
         if identifier in self.brokers and topics:
+            self.interest_version += 1
             self.broker_topics.associate_key_with_values(identifier, topics)
 
     def unsubscribe_broker_from(self, identifier: str,
                                 topics: List[Topic]) -> None:
         if topics:
+            self.interest_version += 1
             self.broker_topics.dissociate_key_from_values(identifier, topics)
 
     # ---- routing queries --------------------------------------------------

@@ -161,20 +161,24 @@ def route_broadcast(broker: "Broker", topics: Sequence[int], raw: Bytes,
     ``exclude_brokers`` are peers already covered by the device mesh
     (group members) — interested OUT-of-group brokers still get the frame.
     ``interest_cache`` memoizes the interest query per (topics, scope)
-    within one receive batch; callers clear it whenever subscriptions or
-    peer sync state change mid-batch.
+    within one receive batch; entries carry ``Connections.interest_version``
+    so a subscription/membership/sync mutation from ANY task — including
+    one landing while this batch awaits egress or device backpressure —
+    invalidates them, keeping parity with the reference's per-message
+    interest query.
     """
     if interest_cache is None:
         users, brokers = broker.connections.get_interested_by_topic(
             list(topics), to_users_only)
     else:
+        version = broker.connections.interest_version
         key = (tuple(topics), to_users_only)
         hit = interest_cache.get(key)
-        if hit is None:
-            hit = broker.connections.get_interested_by_topic(
-                list(topics), to_users_only)
+        if hit is None or hit[0] != version:
+            hit = (version, broker.connections.get_interested_by_topic(
+                list(topics), to_users_only))
             interest_cache[key] = hit
-        users, brokers = hit
+        users, brokers = hit[1]
     for ident in brokers:
         if ident not in exclude_brokers:
             egress.to_broker(ident, raw)
@@ -294,12 +298,10 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                             break
                         broker.connections.subscribe_user_to(public_key,
                                                              pruned)
-                        interest_cache.clear()
                     elif isinstance(message, Unsubscribe):
                         pruned, _bad = topics.prune(message.topics)
                         broker.connections.unsubscribe_user_from(public_key,
                                                                  pruned)
-                        interest_cache.clear()
                     else:
                         # users may not send auth or sync messages
                         # post-handshake
@@ -396,11 +398,9 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                     elif isinstance(message, UserSync):
                         broker.connections.apply_user_sync(message.payload)
                         broker.update_metrics()
-                        interest_cache.clear()
                     elif isinstance(message, TopicSync):
                         broker.connections.apply_topic_sync(identifier,
                                                             message.payload)
-                        interest_cache.clear()
                     else:
                         logger.warning(
                             "broker %s sent unexpected %s; dropping link",

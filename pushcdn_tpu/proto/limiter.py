@@ -59,6 +59,15 @@ class _ByteSemaphore:
                 self.release(n)
             raise
 
+    def try_acquire(self, n: int) -> bool:
+        """Synchronous fast path: take ``n`` without suspending, or return
+        False when the acquisition would have to wait. FIFO fairness is the
+        same invariant ``acquire`` keeps — never jump an existing waiter."""
+        if self._wait_list or n > self._available:
+            return False
+        self._available -= n
+        return True
+
     def release(self, n: int) -> None:
         self._available += n
         self._wake()
@@ -180,10 +189,8 @@ class MemoryPool:
         if nbytes > self.capacity:
             bail(ErrorKind.EXCEEDED_SIZE,
                  f"message of {nbytes} B exceeds pool capacity {self.capacity} B")
-        sem = self._sem
-        if sem._wait_list or nbytes > sem._available:
+        if not self._sem.try_acquire(nbytes):
             return None
-        sem._available -= nbytes
         return AllocationPermit(self, nbytes)
 
     def _on_release(self, nbytes: int, lifetime_s: float) -> None:

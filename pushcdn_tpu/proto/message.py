@@ -341,20 +341,29 @@ def deserialize_owned(frame: BytesLike) -> Message:
     this; semantics are identical to the two-step path."""
     if type(frame) is bytes:
         n = len(frame)
-        if n >= 1:
+        if 1 <= n <= MAX_MESSAGE_SIZE:
             kind = frame[0]
-            if kind == KIND_DIRECT:
-                (rlen,) = _U32.unpack_from(frame, 1)
-                if 5 + rlen <= n:
-                    return Direct(recipient=frame[5:5 + rlen],
-                                  message=frame[5 + rlen:])
-                bail(ErrorKind.DESERIALIZE, "Direct recipient overruns frame")
-            if kind == KIND_BROADCAST:
-                (ntopics,) = _U16.unpack_from(frame, 1)
-                if 3 + ntopics <= n:
-                    return Broadcast(topics=tuple(frame[3:3 + ntopics]),
-                                     message=frame[3 + ntopics:])
-                bail(ErrorKind.DESERIALIZE, "Broadcast topics overrun frame")
+            try:
+                if kind == KIND_DIRECT:
+                    (rlen,) = _U32.unpack_from(frame, 1)
+                    if 5 + rlen <= n:
+                        return Direct(recipient=frame[5:5 + rlen],
+                                      message=frame[5 + rlen:])
+                    bail(ErrorKind.DESERIALIZE,
+                         "Direct recipient overruns frame")
+                if kind == KIND_BROADCAST:
+                    (ntopics,) = _U16.unpack_from(frame, 1)
+                    if 3 + ntopics <= n:
+                        return Broadcast(topics=tuple(frame[3:3 + ntopics]),
+                                         message=frame[3 + ntopics:])
+                    bail(ErrorKind.DESERIALIZE,
+                         "Broadcast topics overrun frame")
+            except struct.error as exc:
+                # a 1-4 byte truncated frame must surface the same
+                # Error(DESERIALIZE) the two-step path raises — callers'
+                # malformed-frame disconnect policy catches Error only
+                bail(ErrorKind.DESERIALIZE,
+                     f"truncated frame for kind {kind}", exc)
     return materialize(deserialize(frame))
 
 

@@ -1,0 +1,251 @@
+"""Direct contract tests for the round-2/3 transport semantics.
+
+Each test pins a documented contract that would otherwise only fail
+indirectly (a stalled broker, a leaked pool permit) rather than as an
+assert: ``send_raw_many``'s always-released ownership rule, the limiter's
+``try_allocate`` FIFO fairness, the native ``FrameEncoder``'s
+capacity-overflow fallback, ``deserialize_owned``'s malformed-frame error
+parity with ``deserialize``, and the reader/writer cancel-safety paths
+added in round 3.
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+from pushcdn_tpu import native
+from pushcdn_tpu.proto import MAX_MESSAGE_SIZE
+from pushcdn_tpu.proto.error import Error, ErrorKind
+from pushcdn_tpu.proto.limiter import Bytes, Limiter, MemoryPool
+from pushcdn_tpu.proto.message import (
+    KIND_BROADCAST,
+    KIND_DIRECT,
+    Broadcast,
+    Direct,
+    deserialize,
+    deserialize_owned,
+    serialize,
+)
+from pushcdn_tpu.proto.transport import Memory
+
+_LEN = struct.Struct(">I")
+
+
+async def _pair(endpoint: str, limiter: Limiter = None, client_limiter=None):
+    from pushcdn_tpu.proto.limiter import NO_LIMIT
+    listener = await Memory.bind(endpoint)
+    connect = asyncio.create_task(
+        Memory.connect(endpoint, limiter=client_limiter or NO_LIMIT))
+    server = await (await listener.accept()).finalize(
+        limiter=limiter or NO_LIMIT)
+    client = await connect
+    return listener, client, server
+
+
+# ---------------------------------------------------------------------------
+# send_raw_many ownership: frames are ALWAYS released by the connection
+# ---------------------------------------------------------------------------
+
+async def test_send_raw_many_on_poisoned_connection_releases_exactly_once():
+    pool = MemoryPool(64 * 1024)
+    listener, client, server = await _pair("sem-poisoned")
+    # poison the client connection by killing the peer and forcing a write
+    server.close()
+    await client.send_raw(serialize(Direct(recipient=b"r", message=b"x")))
+    for _ in range(200):
+        if client.is_closed:
+            break
+        await asyncio.sleep(0.01)
+    frames = [Bytes(b"p" * 128, None) for _ in range(4)]
+    permits = [await pool.allocate(128) for _ in range(4)]
+    for f, p in zip(frames, permits):
+        f._permit = p
+    with pytest.raises(Error):
+        await client.send_raw_many(frames)
+    # released exactly once: pool back to capacity, refcounts at zero
+    assert pool.available == 64 * 1024
+    assert all(f._refs[0] == 0 for f in frames)
+    client.close()
+    await listener.close()
+
+
+async def test_send_raw_many_cancelled_while_blocked_releases():
+    # bounded per-connection queue: the put blocks, cancellation must
+    # release every frame in the never-inserted batch. The accepted side is
+    # never finalized, so nothing drains the 8 KiB duplex window and the
+    # client writer genuinely stalls mid-flush.
+    pool = MemoryPool(64 * 1024)
+    lim = Limiter(per_connection_queue=1)
+    listener = await Memory.bind("sem-cancelled")
+    connect = asyncio.create_task(Memory.connect("sem-cancelled",
+                                                 limiter=lim))
+    _unfinalized = await listener.accept()
+    client = await connect
+    # top the queue up across ticks: the writer takes one frame and blocks
+    # mid-flush on the full window, then the bounded queue stays full
+    for _ in range(5):
+        try:
+            while True:
+                client.send_raw_nowait(Bytes(b"z" * 8192, None))
+        except asyncio.QueueFull:
+            pass
+        await asyncio.sleep(0.01)
+    frames = [Bytes(b"q" * 64, await pool.allocate(64)) for _ in range(5)]
+    task = asyncio.create_task(client.send_raw_many(frames))
+    await asyncio.sleep(0.05)
+    assert not task.done()  # genuinely blocked on the bounded queue
+    task.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await task
+    assert pool.available == 64 * 1024
+    assert all(f._refs[0] == 0 for f in frames)
+    client.close()
+    await listener.close()
+
+
+# ---------------------------------------------------------------------------
+# try_allocate FIFO fairness
+# ---------------------------------------------------------------------------
+
+async def test_try_allocate_never_jumps_a_waiter():
+    pool = MemoryPool(100)
+    held = await pool.allocate(80)
+    waiter = asyncio.create_task(pool.allocate(60))
+    await asyncio.sleep(0.01)
+    assert not waiter.done()
+    # 10 bytes ARE available, but granting them would jump the FIFO waiter
+    assert pool.try_allocate(10) is None
+    held.release()
+    permit = await waiter
+    assert pool.available == 40
+    # with no waiters, try_allocate takes the sync fast path
+    fast = pool.try_allocate(40)
+    assert fast is not None
+    permit.release()
+    fast.release()
+    assert pool.available == 100
+
+
+# ---------------------------------------------------------------------------
+# FrameEncoder capacity-overflow fallback
+# ---------------------------------------------------------------------------
+
+def test_frame_encoder_overflow_returns_none():
+    enc = native.FrameEncoder.create(capacity=256)
+    if enc is None:
+        pytest.skip("native library unavailable")
+    ok = enc.encode([b"a" * 32, b"b" * 32])
+    assert ok is not None and len(ok) == 72
+    ok.release()
+    # total (4+200)*2 > 256: must refuse, not truncate
+    assert enc.encode([b"c" * 200, b"d" * 200]) is None
+
+
+async def test_writer_falls_back_when_batch_exceeds_encoder_capacity():
+    # a queued batch far beyond the native encoder capacity must still
+    # arrive intact via the Python coalescing fallback
+    listener, client, server = await _pair("sem-encoder-overflow")
+    payloads = [serialize(Broadcast(topics=[0], message=bytes([i]) * 3000))
+                for i in range(128)]
+    await client.send_raw_many([Bytes(p, None) for p in payloads])
+    got = []
+    while len(got) < 128:
+        raws = await asyncio.wait_for(server.recv_raw_many(), 5)
+        got.extend(bytes(r.data) for r in raws)
+        for r in raws:
+            r.release()
+    assert got == payloads
+    client.close()
+    server.close()
+    await listener.close()
+
+
+# ---------------------------------------------------------------------------
+# deserialize_owned malformed-frame parity
+# ---------------------------------------------------------------------------
+
+def test_deserialize_owned_truncated_raises_error_not_struct_error():
+    # 1-4 byte truncated Direct/Broadcast frames: the fast path must raise
+    # the same Error(DESERIALIZE) the two-step path does — the broker's
+    # malformed-frame disconnect policy catches Error only
+    for frame in (bytes([KIND_DIRECT]), bytes([KIND_DIRECT, 0, 0]),
+                  bytes([KIND_BROADCAST]), bytes([KIND_BROADCAST, 1])):
+        with pytest.raises(Error) as ei:
+            deserialize_owned(frame)
+        assert ei.value.kind == ErrorKind.DESERIALIZE
+        with pytest.raises(Error):
+            deserialize(frame)
+
+
+def test_deserialize_owned_oversize_parity():
+    frame = bytes([KIND_DIRECT]) + b"\x00" * (MAX_MESSAGE_SIZE + 4)
+    with pytest.raises(Error) as ei:
+        deserialize_owned(frame)
+    assert ei.value.kind == ErrorKind.EXCEEDED_SIZE
+
+
+def test_deserialize_owned_matches_deserialize_on_valid_frames():
+    for msg in (Direct(recipient=b"rcpt", message=b"payload"),
+                Broadcast(topics=[1, 7], message=b"payload2")):
+        frame = serialize(msg)
+        owned = deserialize_owned(frame)
+        two_step = deserialize(frame)
+        assert type(owned) is type(two_step)
+        assert bytes(owned.message) == bytes(two_step.message)
+
+
+# ---------------------------------------------------------------------------
+# recv error interleaving + cancel safety (round-3 paths)
+# ---------------------------------------------------------------------------
+
+async def test_recv_raw_many_delivers_frames_before_surfacing_error():
+    listener, client, server = await _pair("sem-err-interleave")
+    for i in range(3):
+        await client.send_message(Direct(recipient=b"r", message=bytes([i])))
+    # wait until the frames are parsed server-side, then kill the link
+    await asyncio.sleep(0.05)
+    client.close()
+    got = 0
+    with pytest.raises(Error):
+        while True:
+            raws = await asyncio.wait_for(server.recv_raw_many(), 5)
+            got += len(raws)
+            for r in raws:
+                r.release()
+    assert got == 3  # queued frames delivered before the poison surfaced
+    server.close()
+    await listener.close()
+
+
+async def test_flush_sender_not_stranded_by_close():
+    # a flush=True sender whose entry was dequeued must not await forever
+    # when close() cancels the writer mid-flush; the accepted side is never
+    # finalized, so the 64 KiB frame blocks in the 8 KiB duplex window
+    listener = await Memory.bind("sem-flush-cancel")
+    connect = asyncio.create_task(Memory.connect("sem-flush-cancel"))
+    _unfinalized = await listener.accept()
+    client = await connect
+    blocker = asyncio.create_task(
+        client.send_raw(b"w" * (64 * 1024), flush=True))
+    await asyncio.sleep(0.05)
+    assert not blocker.done()  # writer is mid-flush
+    client.close()
+    with pytest.raises((asyncio.CancelledError, Error)):
+        await asyncio.wait_for(blocker, 5)
+    await listener.close()
+
+
+async def test_close_with_queued_bare_frame_returns_pool_bytes():
+    # the reader's depth-1 fast path queues bare Bytes; close() must drain
+    # them back into the pool like list batches
+    pool_lim = Limiter(global_pool_bytes=32 * 1024)
+    listener, client, server = await _pair("sem-bare-drain",
+                                           limiter=pool_lim)
+    await client.send_message(Direct(recipient=b"r", message=b"m" * 512))
+    await asyncio.sleep(0.05)  # parsed and queued, never received
+    server.close()
+    await asyncio.sleep(0.05)
+    assert pool_lim.pool.available == 32 * 1024
+    client.close()
+    await listener.close()
