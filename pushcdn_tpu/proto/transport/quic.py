@@ -29,6 +29,16 @@ one is disallowed); it is a minimal reliable-datagram transport with the
 same operational envelope, behind the same `Protocol` seam, so a real QUIC
 stack can replace the packet layer without touching callers.
 
+**Encryption:** the stream is TLS 1.3-secured, the same layering real QUIC
+uses (RFC 9001 runs the TLS handshake over QUIC's reliable crypto
+streams): the ARQ provides reliable ordered delivery and
+``TlsStream`` (ssl.MemoryBIO) runs the TLS state machine over it, keyed
+by the same local/production CA plumbing as the TcpTls edge — parity with
+the reference's quinn+rustls configuration (quic.rs:37-146; cert config
+:52-86). The bootstrap byte and all framed messages ride inside TLS;
+only SYN/ACK/PROBE/PING control datagrams and TLS records are visible on
+the wire.
+
 Packet layout (all integers big-endian):
     [1B type][8B conn_id][type-specific]
     SYN/SYNACK/PING/RST: nothing further
@@ -45,12 +55,18 @@ from __future__ import annotations
 import asyncio
 import errno
 import os
+import ssl
 import struct
 import time
 from collections import deque
 from itertools import islice
 from typing import Dict, Optional, Tuple
 
+from pushcdn_tpu.proto.crypto.tls import (
+    Certificate,
+    client_context_for,
+    local_certificate,
+)
 from pushcdn_tpu.proto.error import ErrorKind, bail, parse_endpoint
 from pushcdn_tpu.proto.limiter import Limiter, NO_LIMIT
 from pushcdn_tpu.proto.transport.base import (
@@ -61,6 +77,7 @@ from pushcdn_tpu.proto.transport.base import (
     RawStream,
     UnfinalizedConnection,
 )
+from pushcdn_tpu.proto.transport.tls_stream import TlsStream
 
 (_SYN, _SYNACK, _DATA, _ACK, _FIN, _FINACK, _PING, _RST,
  _PROBE, _PROBEACK) = range(1, 11)
@@ -554,7 +571,7 @@ class _ServerEndpoint(asyncio.DatagramProtocol):
                 self.streams[conn_id] = stream
                 self.addrs[conn_id] = addr
                 self.listener._accept_q.put_nowait(
-                    _QuicUnfinalized(stream))
+                    _QuicUnfinalized(stream, self.listener._ssl_context))
             # (re-)ack the SYN — the client retries until it sees this
             if conn_id in self.streams or known:
                 self.addrs[conn_id] = addr
@@ -586,17 +603,27 @@ class _ServerEndpoint(asyncio.DatagramProtocol):
 
 
 class _QuicUnfinalized(UnfinalizedConnection):
-    def __init__(self, stream: _UdpStream):
+    def __init__(self, stream: _UdpStream, ssl_context: ssl.SSLContext):
         self._stream = stream
+        self._ssl_context = ssl_context
 
     async def finalize(self, limiter: Limiter = NO_LIMIT) -> Connection:
-        # consume the client's stream-bootstrap byte (parity quic.rs:224-266)
-        async with asyncio.timeout(CONNECT_TIMEOUT_S):
-            boot = await self._stream.read_exactly(1)
+        # TLS handshake over the ARQ stream, then consume the client's
+        # stream-bootstrap byte — encrypted, like quinn's stream open rides
+        # the secured connection (parity quic.rs:224-266)
+        try:
+            async with asyncio.timeout(CONNECT_TIMEOUT_S):
+                tls = await TlsStream.wrap_server(self._stream,
+                                                  self._ssl_context)
+                boot = await tls.read_exactly(1)
+        except (ssl.SSLError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError, ConnectionError) as exc:
+            self._stream.abort()
+            bail(ErrorKind.CONNECTION, "QUIC TLS handshake failed", exc)
         if boot != _BOOTSTRAP:
             self._stream.abort()
             bail(ErrorKind.CONNECTION, "bad QUIC stream bootstrap byte")
-        return Connection(self._stream, limiter, label="quic")
+        return Connection(tls, limiter, label="quic")
 
 
 class QuicListener(Listener):
@@ -604,6 +631,7 @@ class QuicListener(Listener):
         self._accept_q: asyncio.Queue = asyncio.Queue()
         self._endpoint: Optional[_ServerEndpoint] = None
         self._transport = None
+        self._ssl_context: Optional[ssl.SSLContext] = None
         self._closed = False
         self.bound_port: int = 0
 
@@ -632,6 +660,9 @@ class Quic(Protocol):
     async def connect(cls, endpoint: str, use_local_authority: bool = True,
                       limiter: Limiter = NO_LIMIT) -> Connection:
         host, port = parse_endpoint(endpoint)
+        # resolve the trust root BEFORE any socket/stream exists: a broken
+        # CA configuration bails (typed, fatal) without leaking timer tasks
+        ctx, server_hostname = client_context_for(use_local_authority, host)
         loop = asyncio.get_running_loop()
         proto: _ClientEndpoint
         try:
@@ -665,15 +696,30 @@ class Quic(Protocol):
         stream = _UdpStream(conn_id, transport.sendto,
                             on_closed=lambda _id: transport.close())
         proto.stream = stream
-        # open "the one bidirectional stream" with the bootstrap byte
-        await stream.write(_BOOTSTRAP)
-        return Connection(stream, limiter, label=f"quic:{endpoint}")
+        try:
+            async with asyncio.timeout(CONNECT_TIMEOUT_S):
+                # TLS 1.3 over the ARQ stream (parity quinn+rustls), then
+                # open "the one bidirectional stream" with the bootstrap
+                # byte — inside TLS
+                tls = await TlsStream.wrap_client(stream, ctx,
+                                                  server_hostname)
+                await tls.write(_BOOTSTRAP)
+        except (ssl.SSLError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError, ConnectionError) as exc:
+            stream.abort()
+            bail(ErrorKind.CONNECTION,
+                 f"quic TLS handshake with {endpoint} failed", exc)
+        return Connection(tls, limiter, label=f"quic:{endpoint}")
 
     @classmethod
-    async def bind(cls, endpoint: str, certificate=None) -> Listener:
+    async def bind(cls, endpoint: str,
+                   certificate: Optional[Certificate] = None) -> Listener:
         host, port = parse_endpoint(endpoint)
+        if certificate is None:
+            certificate = local_certificate()
         loop = asyncio.get_running_loop()
         listener = QuicListener()
+        listener._ssl_context = certificate.server_context()
         endpoint_proto = _ServerEndpoint(listener)
         try:
             transport, _ = await loop.create_datagram_endpoint(

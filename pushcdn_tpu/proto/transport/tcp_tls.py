@@ -12,7 +12,11 @@ import asyncio
 import socket
 import ssl
 
-from pushcdn_tpu.proto.crypto.tls import LOCAL_SAN, Certificate, local_certificate
+from pushcdn_tpu.proto.crypto.tls import (
+    Certificate,
+    client_context_for,
+    local_certificate,
+)
 from pushcdn_tpu.proto.error import ErrorKind, bail, parse_endpoint
 from pushcdn_tpu.proto.limiter import Limiter, NO_LIMIT
 from pushcdn_tpu.proto.transport.base import (
@@ -76,12 +80,7 @@ class TcpTls(Protocol):
     async def connect(cls, endpoint: str, use_local_authority: bool = True,
                       limiter: Limiter = NO_LIMIT) -> Connection:
         host, port = parse_endpoint(endpoint)
-        if use_local_authority:
-            ctx = local_certificate().client_context()
-            server_hostname = LOCAL_SAN
-        else:
-            ctx = ssl.create_default_context()
-            server_hostname = host
+        ctx, server_hostname = client_context_for(use_local_authority, host)
         try:
             async with asyncio.timeout(CONNECT_TIMEOUT_S):
                 reader, writer = await asyncio.open_connection(
@@ -92,7 +91,8 @@ class TcpTls(Protocol):
                           label=f"tcp+tls:{endpoint}")
 
     @classmethod
-    async def bind(cls, endpoint: str, certificate: Certificate = None) -> Listener:
+    async def bind(cls, endpoint: str,
+                   certificate: "Certificate | None" = None) -> Listener:
         host, port = parse_endpoint(endpoint)
         if certificate is None:
             certificate = local_certificate()
