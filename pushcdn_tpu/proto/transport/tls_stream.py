@@ -81,28 +81,36 @@ class TlsStream(RawStream):
     # -- RawStream interface -------------------------------------------------
 
     async def read_some(self, max_n: int) -> bytes:
+        out = bytearray()
         while True:
+            # drain every decrypted record available up to max_n in one
+            # call — SSLObject.read is one SSL_read (<= one ~16 KiB
+            # record), and returning per-record would defeat the
+            # Connection reader's bulk-chunk batch parsing
             try:
-                data = self._obj.read(max_n)
-                # OpenSSL can queue records while reading (e.g. the
-                # mandatory reply to a peer KeyUpdate, RFC 8446 §4.6.3); a
-                # read-mostly connection must still transmit them
-                if self._outgoing.pending:
-                    await self._pump_out()
+                while len(out) < max_n:
+                    data = self._obj.read(max_n - len(out))
+                    if not data:
+                        break
+                    out += data
             except ssl.SSLWantReadError:
-                if self._outgoing.pending:
-                    await self._pump_out()
-                # ARQ-level EOF propagates as IncompleteReadError from the
-                # inner read — exactly what Connection's reader expects
-                chunk = await self._inner.read_some(_CHUNK)
-                self._incoming.write(chunk)
-                continue
+                pass
             except ssl.SSLZeroReturnError:
                 # clean TLS close_notify from the peer
+                if out:
+                    return bytes(out)
                 raise asyncio.IncompleteReadError(b"", 1)
-            if data:
-                return data
-            raise asyncio.IncompleteReadError(b"", 1)
+            # OpenSSL can queue records while reading (e.g. the mandatory
+            # reply to a peer KeyUpdate, RFC 8446 §4.6.3); a read-mostly
+            # connection must still transmit them
+            if self._outgoing.pending:
+                await self._pump_out()
+            if out:
+                return bytes(out)
+            # ARQ-level EOF propagates as IncompleteReadError from the
+            # inner read — exactly what Connection's reader expects
+            chunk = await self._inner.read_some(_CHUNK)
+            self._incoming.write(chunk)
 
     async def read_exactly(self, n: int) -> bytes:
         buf = bytearray()
