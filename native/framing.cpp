@@ -21,10 +21,13 @@ extern "C" {
 // offsets/lengths) into a [capacity, frame_bytes] frame tensor + aligned
 // metadata columns. Returns the number of frames packed (stops at capacity
 // or at a payload that exceeds frame_bytes — the host path handles those).
+// Topic masks are [n, topic_words] / [capacity, topic_words] u32 rows
+// (topic_words=1 is the compact ≤32-topic layout; 8 covers the full u8
+// topic space).
 int32_t pushcdn_pack_frames(
     const uint8_t* blob, const int64_t* offsets, const int32_t* lengths,
     const int32_t* kinds, const uint32_t* tmasks, const int32_t* dests,
-    int32_t n, int32_t capacity, int32_t frame_bytes,
+    int32_t n, int32_t capacity, int32_t frame_bytes, int32_t topic_words,
     uint8_t* out_frames, int32_t* out_kind, int32_t* out_len,
     uint32_t* out_tmask, int32_t* out_dest, uint8_t* out_valid) {
   int32_t packed = 0;
@@ -36,7 +39,9 @@ int32_t pushcdn_pack_frames(
     if (len < frame_bytes) std::memset(slot + len, 0, (size_t)(frame_bytes - len));
     out_kind[packed] = kinds[i];
     out_len[packed] = len;
-    out_tmask[packed] = tmasks[i];
+    std::memcpy(out_tmask + (int64_t)packed * topic_words,
+                tmasks + (int64_t)i * topic_words,
+                (size_t)topic_words * sizeof(uint32_t));
     out_dest[packed] = dests[i];
     out_valid[packed] = 1;
     ++packed;

@@ -65,7 +65,12 @@ from pushcdn_tpu.parallel.router import (
 )
 from pushcdn_tpu.proto.error import Error
 from pushcdn_tpu.proto.limiter import Bytes
-from pushcdn_tpu.proto.message import Broadcast, Direct
+from pushcdn_tpu.proto.message import (
+    KIND_BROADCAST,
+    KIND_DIRECT,
+    Broadcast,
+    Direct,
+)
 
 if TYPE_CHECKING:
     from pushcdn_tpu.broker.broker import Broker
@@ -196,6 +201,65 @@ class DevicePlane:
             self._kick.set()
             return StageResult.STAGED
         return StageResult.FULL
+
+    def stage_batch(self, items) -> List[StageResult]:
+        """Stage a whole receive batch in one pass: classify each
+        (message, raw) pair, group the eligible frames per size lane
+        (best-fit with free-slot accounting), then pack each lane's group
+        with ONE ``FrameRing.push_batch`` (one C call + one copy per
+        lane) instead of a per-frame Python ``_put``. Returns a
+        per-item ``StageResult`` aligned with ``items``; FULL items are
+        the ring-backpressure leftovers the caller retries singly."""
+        results = [StageResult.INELIGIBLE] * len(items)
+        if self.disabled:
+            return results
+        # (ring -> [(item_idx, frame, kind, mask, dest), ...])
+        groups: dict[int, list] = {}
+        free = [r.free_slots for r in self.rings]
+        widest = self.rings[-1].frame_bytes
+        for idx, (message, raw) in enumerate(items):
+            frame = bytes(raw.data)
+            if len(frame) > widest:
+                continue  # INELIGIBLE
+            if isinstance(message, Broadcast):
+                if self._unmirrored:
+                    continue
+                if any(int(t) >= 32 * self.config.topic_words
+                       for t in message.topics):
+                    continue
+                mask = mask_of_topics(message.topics,
+                                      self.config.topic_words)
+                if mask == 0:
+                    continue
+                kind, dest = KIND_BROADCAST, -1
+            elif isinstance(message, Direct):
+                slot = self.slots.slot_of(bytes(message.recipient))
+                if slot is None:
+                    continue
+                kind, mask, dest = KIND_DIRECT, 0, slot
+            else:
+                continue
+            # best-fit with credit accounting (mirrors stage_best_fit)
+            placed = False
+            for li, ring in enumerate(self.rings):
+                if len(frame) <= ring.frame_bytes and free[li] > 0:
+                    free[li] -= 1
+                    groups.setdefault(li, []).append(
+                        (idx, frame, kind, mask, dest))
+                    placed = True
+                    break
+            results[idx] = StageResult.STAGED if placed else StageResult.FULL
+        staged_any = False
+        for li, group in groups.items():
+            n = self.rings[li].push_batch(
+                [g[1] for g in group], [g[2] for g in group],
+                [g[3] for g in group], [g[4] for g in group])
+            staged_any = staged_any or n > 0
+            for idx, *_ in group[n:]:  # raced-full leftovers
+                results[idx] = StageResult.FULL
+        if staged_any:
+            self._kick.set()
+        return results
 
     def covered_broker_idents(self) -> set:
         """Broker identifiers whose delivery this plane covers — none for

@@ -116,6 +116,9 @@ class MeshShardPlane:
     def try_stage(self, message, raw: Bytes):
         return self.group.try_stage(self.shard, message, raw)
 
+    def stage_batch(self, items):
+        return self.group.stage_batch(self.shard, items)
+
     def covered_broker_idents(self) -> set:
         """Identifiers of the group's member brokers — the mesh step covers
         delivery to them, so the host path must not also forward (but MUST
@@ -379,6 +382,72 @@ class MeshBrokerGroup:
             self._kick.set()
             return StageResult.STAGED
         return StageResult.FULL
+
+    def stage_batch(self, shard: int, items):
+        """Batch staging for one member shard: broadcasts are grouped per
+        size lane and packed with ONE ``FrameRing.push_batch`` per lane
+        (the C framing kernel, multi-word masks included); directs keep
+        the per-frame owner-bucket push (each lands in a different
+        [dest][slot] cell, so there is no contiguous batch to pack).
+        Returns per-item ``StageResult``s aligned with ``items``."""
+        from pushcdn_tpu.broker.staging import StageResult
+        results = [StageResult.INELIGIBLE] * len(items)
+        if self.disabled:
+            return results
+        groups: dict[int, list] = {}
+        rings = [lane[shard] for lane in self.lane_rings]
+        free = [r.free_slots for r in rings]
+        widest = rings[-1].frame_bytes
+        staged_any = False
+        for idx, (message, raw) in enumerate(items):
+            frame = bytes(raw.data)
+            if len(frame) > widest:
+                self._overflow()
+                continue
+            if isinstance(message, Broadcast):
+                if self._unmirrored or any(
+                        int(t) >= 32 * self.config.topic_words
+                        for t in message.topics):
+                    self._overflow()
+                    continue
+                mask = mask_of_topics(message.topics,
+                                      self.config.topic_words)
+                if mask == 0:
+                    continue  # no valid topics: no-op send
+                placed = False
+                for li, ring in enumerate(rings):
+                    if len(frame) <= ring.frame_bytes and free[li] > 0:
+                        free[li] -= 1
+                        groups.setdefault(li, []).append((idx, frame, mask))
+                        placed = True
+                        break
+                results[idx] = (StageResult.STAGED if placed
+                                else StageResult.FULL)
+            elif isinstance(message, Direct):
+                slot = self.slots.slot_of(bytes(message.recipient))
+                owner = ABSENT if slot is None else int(self._owner[slot])
+                if slot is None or owner == ABSENT:
+                    self._overflow()
+                    continue
+                ok = stage_best_fit(
+                    [bkts[shard] for bkts in self.lane_buckets], len(frame),
+                    lambda b: b.push(owner, frame, slot))
+                results[idx] = (StageResult.STAGED if ok
+                                else StageResult.FULL)
+                staged_any = staged_any or ok
+        from pushcdn_tpu.proto.message import KIND_BROADCAST
+        for li, group in groups.items():
+            n = rings[li].push_batch(
+                [g[1] for g in group],
+                [KIND_BROADCAST] * len(group),
+                [g[2] for g in group],
+                [-1] * len(group))
+            staged_any = staged_any or n > 0
+            for idx, *_ in group[n:]:
+                results[idx] = StageResult.FULL
+        if staged_any:
+            self._kick.set()
+        return results
 
     # ---- the pump ---------------------------------------------------------
 

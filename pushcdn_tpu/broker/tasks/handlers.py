@@ -239,6 +239,11 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
             raws = await connection.recv_raw_many()
             egress = EgressBatch(broker)
             interest_cache: dict = {}
+            # device-eligible (message, raw, pruned_topics) collected during
+            # the scan and staged in ONE stage_batch call after it (one
+            # native pack per size lane instead of a per-frame ring push)
+            stage_items: list = []
+            device = broker.device_plane
             try:
                 for raw in raws:
                     try:
@@ -258,36 +263,24 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                         alive = False
                         break
 
-                    device = broker.device_plane
                     if isinstance(message, Direct):
                         # device path covers local-recipient delivery (and,
                         # for a mesh-group plane, any recipient in the
                         # group); host path covers the rest
                         if device is not None:
-                            result = await _stage_with_backpressure(
-                                device, message, raw)
-                            if result == StageResult.STAGED:
-                                continue
+                            stage_items.append((message, raw, None))
+                            continue
                         route_direct(broker, message.recipient, raw,
                                      to_user_only=False, egress=egress)
                     elif isinstance(message, Broadcast):
                         pruned, _bad = topics.prune(message.topics)
                         if pruned:
-                            staged = False
                             if device is not None:
-                                result = await _stage_with_backpressure(
-                                    device, message, raw)
-                                staged = result == StageResult.STAGED
-                            # host side: remaining fan-out — all of it when
-                            # not staged; only out-of-group/interest
-                            # forwarding when the device covers users
-                            # (+ group peers over ICI)
+                                stage_items.append((message, raw, pruned))
+                                continue
                             route_broadcast(
                                 broker, pruned, raw, to_users_only=False,
-                                egress=egress, users_via_device=staged,
-                                exclude_brokers=(
-                                    frozenset(device.covered_broker_idents())
-                                    if staged else frozenset()),
+                                egress=egress,
                                 interest_cache=interest_cache)
                     elif isinstance(message, Subscribe):
                         pruned, bad = topics.prune(message.topics)
@@ -307,6 +300,36 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                         # post-handshake
                         alive = False
                         break
+
+                # phase 2: batch-stage the collected device-eligible
+                # messages, then host-route whatever the device didn't take
+                if stage_items:
+                    results = device.stage_batch(
+                        [(m, r) for m, r, _ in stage_items])
+                    for (message, raw, pruned), res in zip(stage_items,
+                                                           results):
+                        if res == StageResult.FULL:
+                            res = await _stage_with_backpressure(
+                                device, message, raw)
+                        staged = res == StageResult.STAGED
+                        if isinstance(message, Direct):
+                            if not staged:
+                                route_direct(broker, message.recipient, raw,
+                                             to_user_only=False,
+                                             egress=egress)
+                        else:
+                            # host side: remaining fan-out — all of it when
+                            # not staged; only out-of-group/interest
+                            # forwarding when the device covers users
+                            # (+ group peers over ICI)
+                            route_broadcast(
+                                broker, pruned, raw, to_users_only=False,
+                                egress=egress, users_via_device=staged,
+                                exclude_brokers=(
+                                    frozenset(
+                                        device.covered_broker_idents())
+                                    if staged else frozenset()),
+                                interest_cache=interest_cache)
             finally:
                 try:
                     await egress.flush()
@@ -343,6 +366,15 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
             raws = await connection.recv_raw_many()
             egress = EgressBatch(broker)
             interest_cache: dict = {}
+            stage_items: list = []
+            device = broker.device_plane
+            # A covers_brokers (mesh-group) plane must NOT re-stage
+            # host-forwarded traffic: the origin couldn't stage it, and
+            # re-staging would all_gather it back to every shard —
+            # duplicate delivery. Host-forwarded frames are delivered
+            # locally only, exactly the reference's to_users_only rule.
+            single_shard = (device is not None
+                            and not device.covers_brokers)
             try:
                 for raw in raws:
                     try:
@@ -360,25 +392,14 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                         alive = False
                         break
 
-                    device = broker.device_plane
-                    # A covers_brokers (mesh-group) plane must NOT re-stage
-                    # host-forwarded traffic: the origin couldn't stage it,
-                    # and re-staging would all_gather it back to every
-                    # shard — duplicate delivery. Host-forwarded frames are
-                    # delivered locally only, exactly the reference's
-                    # to_users_only rule.
-                    single_shard = (device is not None
-                                    and not device.covers_brokers)
                     if isinstance(message, Direct):
                         # deliver to our own user only — never re-forward
                         # (broker/handler.rs:148-153); the single-shard
                         # device path's delivery-iff-owner rule keeps that
                         # invariant
                         if single_shard:
-                            result = await _stage_with_backpressure(
-                                device, message, raw)
-                            if result == StageResult.STAGED:
-                                continue
+                            stage_items.append((message, raw, None))
+                            continue
                         route_direct(broker, message.recipient, raw,
                                      to_user_only=True, egress=egress)
                     elif isinstance(message, Broadcast):
@@ -387,10 +408,8 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                         pruned, _bad = topics.prune(message.topics)
                         if pruned:
                             if single_shard:
-                                result = await _stage_with_backpressure(
-                                    device, message, raw)
-                                if result == StageResult.STAGED:
-                                    continue
+                                stage_items.append((message, raw, pruned))
+                                continue
                             route_broadcast(broker, pruned, raw,
                                             to_users_only=True,
                                             egress=egress,
@@ -407,6 +426,25 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                             identifier, type(message).__name__)
                         alive = False
                         break
+
+                if stage_items:
+                    results = device.stage_batch(
+                        [(m, r) for m, r, _ in stage_items])
+                    for (message, raw, pruned), res in zip(stage_items,
+                                                           results):
+                        if res == StageResult.FULL:
+                            res = await _stage_with_backpressure(
+                                device, message, raw)
+                        if res == StageResult.STAGED:
+                            continue
+                        if isinstance(message, Direct):
+                            route_direct(broker, message.recipient, raw,
+                                         to_user_only=True, egress=egress)
+                        else:
+                            route_broadcast(broker, pruned, raw,
+                                            to_users_only=True,
+                                            egress=egress,
+                                            interest_cache=interest_cache)
             finally:
                 try:
                     await egress.flush()

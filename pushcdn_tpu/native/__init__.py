@@ -48,7 +48,7 @@ def _compile() -> Optional[ctypes.CDLL]:
     lib.pushcdn_pack_frames.restype = ctypes.c_int32
     lib.pushcdn_pack_frames.argtypes = [
         u8p, i64p, i32p, i32p, u32p, i32p,
-        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         u8p, i32p, i32p, u32p, i32p, u8p]
     lib.pushcdn_scan_frames.restype = ctypes.c_int64
     lib.pushcdn_scan_frames.argtypes = [
@@ -92,9 +92,15 @@ def pack_frames_into(payloads: list[bytes], kinds: np.ndarray,
     C++ kernel (zero extra allocation on the pump path). Returns the number
     packed, or None if the native library is unavailable.
 
+    ``tmasks``/``out_tmask`` may be 1-D (compact ≤32-topic masks) or 2-D
+    ``[n, W]`` / ``[capacity, W]`` multi-word rows covering the full u8
+    topic space — the two must agree on W.
+
     Preconditions (validated): metadata arrays as long as ``payloads``; no
     payload longer than a frame slot; out arrays contiguous with matching
-    dtypes. ``out_valid`` must be uint8 (written 0/1).
+    dtypes. ``out_valid`` must be uint8 (written 0/1). The out arrays may
+    be sliced views starting at a ring's cursor (C-contiguous slices along
+    axis 0), so a partially-filled ring can batch-pack into its tail.
     """
     lib = _get()
     if lib is None:
@@ -102,6 +108,11 @@ def pack_frames_into(payloads: list[bytes], kinds: np.ndarray,
     n_in = len(payloads)
     if not (len(kinds) == len(tmasks) == len(dests) == n_in):
         raise ValueError("payloads/kinds/tmasks/dests length mismatch")
+    words = 1 if out_tmask.ndim == 1 else out_tmask.shape[1]
+    in_words = 1 if np.ndim(tmasks) == 1 else np.shape(tmasks)[1]
+    if words != in_words:
+        raise ValueError(
+            f"tmasks width {in_words} != out_tmask width {words}")
     capacity, frame_bytes = out_frames.shape
     offsets = np.zeros(n_in, np.int64)
     lengths = np.zeros(n_in, np.int32)
@@ -123,7 +134,7 @@ def pack_frames_into(payloads: list[bytes], kinds: np.ndarray,
         _ptr(np.ascontiguousarray(kinds, np.int32), ctypes.c_int32),
         _ptr(np.ascontiguousarray(tmasks, np.uint32), ctypes.c_uint32),
         _ptr(np.ascontiguousarray(dests, np.int32), ctypes.c_int32),
-        n_in, capacity, frame_bytes,
+        n_in, capacity, frame_bytes, words,
         _ptr(out_frames, ctypes.c_uint8), _ptr(out_kind, ctypes.c_int32),
         _ptr(out_len, ctypes.c_int32), _ptr(out_tmask, ctypes.c_uint32),
         _ptr(out_dest, ctypes.c_int32), _ptr(out_valid, ctypes.c_uint8))

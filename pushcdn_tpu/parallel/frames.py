@@ -233,17 +233,16 @@ class FrameRing:
     def push_batch(self, payloads: Sequence[bytes], kinds: Sequence[int],
                    tmasks: Sequence[int], dests: Sequence[int]) -> int:
         """Pack many messages in one call via the C++ framing kernel
-        (native/framing.cpp, writing straight into the ring's buffers;
-        falls back to the Python loop). Requires an empty ring (the batch
-        pump drains per step anyway).
+        (native/framing.cpp, writing straight into the ring's buffers at
+        the current cursor; falls back to the Python loop). Works on a
+        partially-filled ring — the batch lands after any singly-pushed
+        frames.
 
         Returns the number packed; fewer than ``len(payloads)`` means
         exactly "ring full — re-queue the rest". Oversized payloads raise
         ``ValueError`` up front (pre-filter them to the host path), so the
         return value is never ambiguous between full and unroutable.
         """
-        if self._used != 0:
-            raise ValueError("push_batch requires an empty ring")
         if not (len(kinds) == len(tmasks) == len(dests) == len(payloads)):
             raise ValueError("payloads/kinds/tmasks/dests length mismatch")
         for i, p in enumerate(payloads):
@@ -251,26 +250,33 @@ class FrameRing:
                 raise ValueError(
                     f"payload {i} is {len(p)} B > frame slot "
                     f"{self.frame_bytes} B; pre-filter to the host path")
+        from pushcdn_tpu import native
+        start = self._next
         kinds_a = np.asarray(kinds, np.int32)
         dests_a = np.asarray(dests, np.int32)
         if self.topic_words == 1:
-            from pushcdn_tpu import native
             tmasks_a = np.asarray(
                 [m & 0xFFFFFFFF for m in tmasks], np.uint32)
-            valid_u8 = np.zeros(self.slots, np.uint8)
-            n = native.pack_frames_into(
-                list(payloads), kinds_a, tmasks_a, dests_a,
-                self._bytes, self._kind, self._length, self._topic_mask,
-                self._dest, valid_u8)
-            if n is not None:
-                self._valid = valid_u8.astype(bool)
-                self._used = n
-                self._next = n % self.slots
-                return n
-        tmasks_a = list(tmasks)
+        else:
+            W = self.topic_words
+            tmasks_a = np.zeros((len(payloads), W), np.uint32)
+            for w in range(W):
+                shift = 32 * w
+                tmasks_a[:, w] = [(m >> shift) & 0xFFFFFFFF for m in tmasks]
+        valid_u8 = np.zeros(self.slots - start, np.uint8)
+        n = native.pack_frames_into(
+            list(payloads), kinds_a, tmasks_a, dests_a,
+            self._bytes[start:], self._kind[start:], self._length[start:],
+            self._topic_mask[start:], self._dest[start:], valid_u8)
+        if n is not None:
+            self._valid[start:start + n] = True
+            self._used += n
+            self._next += n
+            return n
         # Python fallback (identical semantics)
         n = 0
-        for payload, k, tm, d in zip(payloads, kinds_a, tmasks_a, dests_a):
+        for payload, k, tm, d in zip(payloads, kinds_a, list(tmasks),
+                                     dests_a):
             i = self._alloc()
             if i is None:
                 break
