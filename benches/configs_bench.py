@@ -64,12 +64,28 @@ def _p99(samples):
 
 
 async def _drain(client, n: int):
-    """Receive exactly ``n`` messages on ``client`` (one timeout scope for
-    the whole drain — a per-message ``wait_for`` costs more than the
-    pipeline itself at these rates)."""
+    """Receive exactly ``n`` messages on ``client`` via the batched
+    receive API (one timeout scope for the whole drain — per-message
+    wakeups cost more than the pipeline itself at these rates)."""
+    got = 0
     async with asyncio.timeout(30):
-        for _ in range(n):
-            await client.receive_message()
+        while got < n:
+            got += len(await client.receive_messages(n - got))
+
+
+async def _drain_raw(client, n: int):
+    """Count ``n`` delivered frames at the transport layer (no app-side
+    decode): the bad-connector-style load drain — measures what the CDN
+    delivered into the client process, decoupled from what the app then
+    does with each message."""
+    from pushcdn_tpu.proto.transport.base import FrameChunk
+    got = 0
+    conn = client._connection
+    async with asyncio.timeout(60):
+        while got < n:
+            for item in await conn.recv_frames(n - got):
+                got += item.remaining if type(item) is FrameChunk else 1
+                item.release()
 
 
 _wait_mesh_interest = wait_mesh_interest
@@ -239,6 +255,20 @@ async def bench_eight_broker_mesh(msgs: int):
         emit("configs3/mesh_broadcast_fanout", msgs * 16 / dt,
              "deliveries/s", msgs=msgs, brokers=8,
              publish_rate=round(msgs / dt, 1), frame=1024)
+
+        # transport-level delivery rate (raw twin of the line above; see
+        # _drain_raw), 2 publishers on different brokers
+        raw_msgs = msgs * 4
+        t0 = time.perf_counter()
+        drains = [asyncio.create_task(_drain_raw(c, raw_msgs))
+                  for c in clients]
+        for _ in range(raw_msgs // 2):
+            await clients[0].send_broadcast_message([0], payload)
+            await clients[1].send_broadcast_message([0], payload)
+        await asyncio.gather(*drains)
+        dt = time.perf_counter() - t0
+        emit("configs3/mesh_frame_delivery", raw_msgs * 16 / dt,
+             "frames/s", msgs=raw_msgs, brokers=8, frame=1024)
         for c in clients:
             c.close()
     finally:
@@ -251,7 +281,7 @@ async def bench_eight_broker_mesh(msgs: int):
 # BASELINE.json north-star path), zero host broker links
 # ---------------------------------------------------------------------------
 
-async def bench_eight_broker_device_mesh(msgs: int):
+async def bench_eight_broker_device_mesh(msgs: int, tput_msgs: int):
     import jax
     jax.config.update("jax_platforms", "cpu")
 
@@ -261,7 +291,7 @@ async def bench_eight_broker_device_mesh(msgs: int):
     tune_gc()  # re-freeze: this bench just pulled the jax heap in
 
     cluster = await MeshCluster(
-        num_shards=8, ring_slots=128, frame_bytes=2048,
+        num_shards=8, ring_slots=1024, frame_bytes=2048,
         batch_window_s=0.001, devices=jax.devices("cpu"), prefix="cfg3d",
     ).start(form_host_mesh=False)
     try:
@@ -283,15 +313,31 @@ async def bench_eight_broker_device_mesh(msgs: int):
              host_links=0, steps=cluster.group.steps)
 
         t0 = time.perf_counter()
-        drains = [asyncio.create_task(_drain(c, msgs)) for c in clients]
-        for _ in range(msgs):
-            await publisher.send_broadcast_message([0], payload)
+        drains = [asyncio.create_task(_drain(c, tput_msgs)) for c in clients]
+        for _ in range(tput_msgs // 2):
+            await clients[0].send_broadcast_message([0], payload)
+            await clients[1].send_broadcast_message([0], payload)
         await asyncio.gather(*drains)
         dt = time.perf_counter() - t0
-        emit("configs3/device_mesh_broadcast_fanout", msgs * 16 / dt,
-             "deliveries/s", msgs=msgs, brokers=8,
-             publish_rate=round(msgs / dt, 1), frame=1024,
+        emit("configs3/device_mesh_broadcast_fanout", tput_msgs * 16 / dt,
+             "deliveries/s", msgs=tput_msgs, brokers=8,
+             publish_rate=round(tput_msgs / dt, 1), frame=1024,
              host_links=0, mesh_routed=cluster.group.messages_routed)
+
+        # transport-level delivery rate (raw twin; 2 publishers on
+        # different shards so ingress rides two rings)
+        raw_msgs = tput_msgs * 2
+        t0 = time.perf_counter()
+        drains = [asyncio.create_task(_drain_raw(c, raw_msgs))
+                  for c in clients]
+        for _ in range(raw_msgs // 2):
+            await clients[0].send_broadcast_message([0], payload)
+            await clients[1].send_broadcast_message([0], payload)
+        await asyncio.gather(*drains)
+        dt = time.perf_counter() - t0
+        emit("configs3/device_mesh_frame_delivery", raw_msgs * 16 / dt,
+             "frames/s", msgs=raw_msgs, brokers=8, frame=1024,
+             host_links=0, steps=cluster.group.steps)
         for c in clients:
             c.close()
     finally:
@@ -313,7 +359,9 @@ async def amain(quick: bool):
         await bench_topic_pubsub(per_topic=16 if quick else 64,
                                  rounds=20 if quick else 100)
         await bench_eight_broker_mesh(msgs=100 if quick else 400)
-        await bench_eight_broker_device_mesh(msgs=100 if quick else 400)
+        await bench_eight_broker_device_mesh(
+            msgs=100 if quick else 400,
+            tput_msgs=1000 if quick else 6000)
     finally:
         Memory.set_duplex_window(prev_window)
 

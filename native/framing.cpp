@@ -118,4 +118,119 @@ int64_t pushcdn_encode_frames_ptrs(
   return pos;
 }
 
+// ---------------------------------------------------------------------------
+// Device-plane egress engine (SURVEY.md §7 stage 8; the socket side of the
+// socket⇄HBM pump). The router's delivery matrix says which (user, frame)
+// pairs deliver; these two passes turn a whole step's matrix into per-user
+// length-delimited byte streams with zero per-frame Python:
+//
+//   pass 1 (count):  per-user bytes + message totals,
+//   pass 2 (fill):   one contiguous stream per user at caller-computed
+//                    offsets (prefix sum over pass 1), each frame encoded
+//                    as u32-BE length ‖ payload — exactly what the wire
+//                    writer sends, so the stream is handed to the
+//                    connection's writer as-is (one flush per user).
+//
+// The matrix rows are scanned 8 bytes at a time (numpy bool_ is one byte
+// per cell; a zero uint64 word skips 8 frames), so sparse matrices cost
+// ~N/8 loads per user. Frame payloads live in `nb` equally-shaped blocks
+// (the per-shard host ring snapshots, in gather order): frame n is row
+// (n % rows_per_block) of block (n / rows_per_block) — egress reads the
+// SAME host buffers the step's H2D copy read, no device round-trip of
+// frame bytes (the delivery decision, not the payload, is what crosses
+// the mesh on the single-host topology).
+
+static inline uint64_t load_u64(const uint8_t* p) {
+  uint64_t w;
+  std::memcpy(&w, p, 8);
+  return w;
+}
+
+// Pass 1: per-user delivered bytes (4-byte prefix included) and counts.
+void pushcdn_egress_count(
+    const uint8_t* deliver,  // [U, N] row-major (numpy bool_)
+    int32_t U, int32_t N,
+    const int32_t* lengths,  // [N] frame payload lengths
+    int64_t* out_bytes,      // [U]
+    int32_t* out_msgs) {     // [U]
+  const int32_t nwords = N / 8;
+  for (int32_t u = 0; u < U; ++u) {
+    const uint8_t* row = deliver + (int64_t)u * N;
+    int64_t bytes = 0;
+    int32_t msgs = 0;
+    int32_t n = 0;
+    for (int32_t w = 0; w < nwords; ++w, n += 8) {
+      if (load_u64(row + n) == 0) continue;
+      for (int32_t k = 0; k < 8; ++k) {
+        if (row[n + k]) {
+          bytes += 4 + (int64_t)lengths[n + k];
+          ++msgs;
+        }
+      }
+    }
+    for (; n < N; ++n) {
+      if (row[n]) {
+        bytes += 4 + (int64_t)lengths[n];
+        ++msgs;
+      }
+    }
+    out_bytes[u] = bytes;
+    out_msgs[u] = msgs;
+  }
+}
+
+// Pass 2: fill per-user streams. Returns total bytes written, or -1 if any
+// user's stream would overrun out_capacity (callers size `out` from pass 1,
+// so -1 means the matrix changed between passes — it can't, both run on one
+// snapshot, but the guard keeps the ABI memory-safe regardless).
+int64_t pushcdn_egress_fill(
+    const uint8_t* deliver, int32_t U, int32_t N, const int32_t* lengths,
+    const uint8_t* const* blocks, int32_t nb, int32_t rows_per_block,
+    int64_t frame_stride,
+    const int64_t* offsets,  // [U] stream start offsets (prefix sum)
+    uint8_t* out, int64_t out_capacity) {
+  const int32_t nwords = N / 8;
+  int64_t total = 0;
+  for (int32_t u = 0; u < U; ++u) {
+    const uint8_t* row = deliver + (int64_t)u * N;
+    int64_t pos = offsets[u];
+    int32_t n = 0;
+    for (int32_t w = 0; w < nwords; ++w, n += 8) {
+      if (load_u64(row + n) == 0) continue;
+      for (int32_t k = 0; k < 8; ++k) {
+        const int32_t f = n + k;
+        if (!row[f]) continue;
+        const int32_t len = lengths[f];
+        if (pos + 4 + (int64_t)len > out_capacity) return -1;
+        out[pos] = (uint8_t)((uint32_t)len >> 24);
+        out[pos + 1] = (uint8_t)((uint32_t)len >> 16);
+        out[pos + 2] = (uint8_t)((uint32_t)len >> 8);
+        out[pos + 3] = (uint8_t)len;
+        const uint8_t* src =
+            blocks[f / rows_per_block] +
+            (int64_t)(f % rows_per_block) * frame_stride;
+        std::memcpy(out + pos + 4, src, (size_t)len);
+        pos += 4 + (int64_t)len;
+        total += 4 + (int64_t)len;
+      }
+    }
+    for (; n < N; ++n) {
+      if (!row[n]) continue;
+      const int32_t len = lengths[n];
+      if (pos + 4 + (int64_t)len > out_capacity) return -1;
+      out[pos] = (uint8_t)((uint32_t)len >> 24);
+      out[pos + 1] = (uint8_t)((uint32_t)len >> 16);
+      out[pos + 2] = (uint8_t)((uint32_t)len >> 8);
+      out[pos + 3] = (uint8_t)len;
+      const uint8_t* src =
+          blocks[n / rows_per_block] +
+          (int64_t)(n % rows_per_block) * frame_stride;
+      std::memcpy(out + pos + 4, src, (size_t)len);
+      pos += 4 + (int64_t)len;
+      total += 4 + (int64_t)len;
+    }
+  }
+  return total;
+}
+
 }  // extern "C"

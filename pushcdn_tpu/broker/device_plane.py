@@ -92,9 +92,15 @@ class DevicePlaneConfig:
     # space; 1 keeps compact masks (and the native batch packer) for
     # deployments with ≤32 topics
     topic_words: int = TOPIC_WORDS_FULL
-    # batch window: how long the pump waits to coalesce ingress into one
-    # step (the latency ↔ step-efficiency knob)
+    # Adaptive coalescing: a step fires immediately on a burst after idle
+    # (latency regime) or when >= coalesce_min_frames are staged; a steady
+    # trickle below the threshold waits batch_window_s to amortize step
+    # dispatch.
     batch_window_s: float = 0.001
+    coalesce_min_frames: int = 16
+    # prefix-slice shapes for sparse traffic (one extra cached jit
+    # specialization; collectives/D2H shrink ~ring/latency_slots x)
+    latency_slots: int = 8
 
     def lane_shapes(self):
         """All lanes as (frame_bytes, ring_slots), sorted ascending by
@@ -125,6 +131,15 @@ class DevicePlane:
         # users the slot table couldn't hold: broadcasts must stay on the
         # host path while any exist (they'd miss device-only fan-out)
         self._unmirrored: set[bytes] = set()
+        # mirror revision: device state re-uploads only when it changed
+        self._state_rev = 0
+        self._dev_rev = -1
+        self._dev_state = None
+        # cached device-side empty lane batches + byte stubs (frame bytes
+        # never ride the device on the single-shard plane: the delivery
+        # DECISION comes back, payloads egress from the host ring snapshot)
+        self._idle_dev_lanes = {}
+        self._byte_stubs = {}
         self.disabled = False
         # single-shard planes keep inter-broker traffic on host links, so
         # they never *need* overflow dialing — the attribute exists because
@@ -149,6 +164,7 @@ class DevicePlane:
             return
         self._owned[slot] = True
         self._masks[slot] = mask_row_of(topics, self.config.topic_words)
+        self._state_rev += 1
 
     def on_user_removed(self, public_key: bytes) -> None:
         self._unmirrored.discard(public_key)
@@ -157,6 +173,7 @@ class DevicePlane:
             return
         self._owned[slot] = False
         self._masks[slot] = 0
+        self._state_rev += 1
         # the slot index stays quarantined until the next step completes —
         # in-flight frames may still address it
         self._quarantine.append(slot)
@@ -166,6 +183,7 @@ class DevicePlane:
         if slot is None:
             return
         self._masks[slot] = mask_row_of(topics, self.config.topic_words)
+        self._state_rev += 1
 
     # ---- ingress ----------------------------------------------------------
 
@@ -273,16 +291,22 @@ class DevicePlane:
         await asyncio.to_thread(self._warmup)
         self._task = asyncio.create_task(self._pump(), name="device-pump")
 
+    U_ROUND = 64  # user-table slice granularity (see mesh_group)
+
     def _warmup(self) -> None:
+        from pushcdn_tpu.parallel.frames import slice_batch
         empty = [r.take_batch() for r in self.rings]
+        lat = [slice_batch(b, self.config.latency_slots) for b in empty]
+        u0 = min(self.config.num_user_slots, self.U_ROUND)
         try:
-            # compile the two common lane subsets off the hot path: all
-            # lanes busy, and base-lane-only (steady state for small
-            # messages); other subsets jit-compile on first use
-            self._run_step(empty, self._owned.copy(), self._masks.copy(),
-                           keep_idle_lanes=True)
-            self._run_step(empty[:1], self._owned.copy(), self._masks.copy(),
-                           keep_idle_lanes=True)
+            # compile the only two specializations the pump uses: all lanes
+            # at full shapes (idle lanes ride cached device empties) and
+            # the latency-sliced base lane; wider user buckets compile on
+            # first growth past the mark
+            self._run_step(empty, self._owned[:u0].copy(),
+                           self._masks[:u0].copy())
+            self._run_step(lat[:1], self._owned[:u0].copy(),
+                           self._masks[:u0].copy())
             self.steps -= 2  # warmup doesn't count
         except Exception:
             logger.exception("device-plane warmup step failed")
@@ -299,22 +323,50 @@ class DevicePlane:
                 logger.exception("device pump died during stop")
 
     async def _pump(self) -> None:
+        from pushcdn_tpu.broker.tasks.senders import egress_streams
+        from pushcdn_tpu.parallel.frames import slice_batch
+        c = self.config
+        loop = asyncio.get_running_loop()
+        last_step_t = -1e9
         while True:
             await self._kick.wait()
             self._kick.clear()
-            await asyncio.sleep(self.config.batch_window_s)  # coalesce
+            await asyncio.sleep(0)  # let same-tick stagers land
+            staged = sum(r.slots - r.free_slots for r in self.rings)
+            if staged and staged < c.coalesce_min_frames and \
+                    loop.time() - last_step_t < 4 * c.batch_window_s:
+                # steady trickle: coalesce one window; bursts after idle
+                # (the latency regime) and saturated pipelines step now
+                await asyncio.sleep(c.batch_window_s)
             if all(r.free_slots == r.slots for r in self.rings):
                 continue
+            lat = c.latency_slots
+            small = (all(r.slots - r.free_slots <= lat
+                         for r in self.rings[:1])
+                     and all(r.free_slots == r.slots
+                             for r in self.rings[1:]))
             # snapshot mirrors + all lane rings in ONE event-loop tick
             batches_np = [r.take_batch() for r in self.rings]
-            owned = self._owned.copy()
-            masks = self._masks.copy()
+            if small:
+                batches_np = [slice_batch(batches_np[0], lat)]
+            u_eff = min(c.num_user_slots,
+                        max(self.U_ROUND,
+                            -(-self.slots.high_water // self.U_ROUND)
+                            * self.U_ROUND))
+            owned = self._owned[:u_eff].copy()
+            masks = self._masks[:u_eff].copy()
+            rev = self._state_rev
             quarantined, self._quarantine = self._quarantine, []
             try:
-                lane_results = await asyncio.to_thread(
-                    self._run_step, batches_np, owned, masks)
-                for deliver, lengths, frames in lane_results:
-                    self._egress(deliver, lengths, frames)
+                jobs = await asyncio.to_thread(
+                    self._run_step, batches_np, owned, masks, rev)
+                last_step_t = loop.time()
+                for streams, d2, lengths, frames in jobs:
+                    if streams is not None:
+                        self.messages_routed += egress_streams(
+                            self.broker, self.slots, streams)
+                    else:
+                        self._egress(d2, lengths, frames)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -334,31 +386,76 @@ class DevicePlane:
                     self.slots.free_slot(slot)
 
     def _run_step(self, lane_batches, owned: np.ndarray, masks: np.ndarray,
-                  keep_idle_lanes: bool = False):
+                  state_rev=None):
         """Blocking device step (runs in a worker thread) against the
-        snapshotted mirrors. All busy lanes ride one jitted program; idle
-        lanes are dropped before the H2D transfer — an empty lane delivers
-        nothing, so skipping it is semantically free, and each lane subset
-        is its own (cached) jit specialization."""
+        snapshotted mirrors. All lanes ride one jitted program; idle lanes
+        reuse cached device-side empty batches (zero H2D, and the jit key
+        never depends on the traffic mix). Frame BYTES never touch the
+        device: zero-width stubs stand in for the byte tensors
+        (gather_bytes=False), only the delivery matrix comes back, and
+        egress encodes payloads from the host ring snapshots via the
+        native engine. Returns per-lane egress jobs: (EgressStreams, -, -,
+        -) on the native path or (None, deliver, lengths, frames) for the
+        Python fallback."""
         import jax.numpy as jnp
-        state = RouterState(
-            crdt=CrdtState(
-                owners=jnp.asarray(np.where(owned, 0, ABSENT).astype(np.int32)),
-                versions=jnp.asarray(owned.astype(np.uint32)),
-                identities=jnp.asarray(
-                    np.where(owned, 0, ABSENT).astype(np.int32)),
-            ),
-            topic_masks=jnp.asarray(masks))
-        batches = tuple(
-            IngressBatch(
-                jnp.asarray(b.bytes_), jnp.asarray(b.kind),
+        from pushcdn_tpu import native as native_mod
+        if state_rev is not None and state_rev == self._dev_rev \
+                and self._dev_state is not None:
+            state = self._dev_state
+        else:
+            state = RouterState(
+                crdt=CrdtState(
+                    owners=jnp.asarray(
+                        np.where(owned, 0, ABSENT).astype(np.int32)),
+                    versions=jnp.asarray(owned.astype(np.uint32)),
+                    identities=jnp.asarray(
+                        np.where(owned, 0, ABSENT).astype(np.int32)),
+                ),
+                topic_masks=jnp.asarray(masks))
+            if state_rev is not None:
+                self._dev_state, self._dev_rev = state, state_rev
+
+        def stub(n):
+            st = self._byte_stubs.get(n)
+            if st is None:
+                st = jnp.zeros((n, 0), jnp.uint8)
+                self._byte_stubs[n] = st
+            return st
+
+        def to_dev(li, b, busy):
+            key = (li, b.valid.shape[0])
+            if not busy:
+                cached = self._idle_dev_lanes.get(key)
+                if cached is not None:
+                    return cached
+            dev = IngressBatch(
+                stub(b.valid.shape[0]), jnp.asarray(b.kind),
                 jnp.asarray(b.length), jnp.asarray(b.topic_mask),
                 jnp.asarray(b.dest), jnp.asarray(b.valid))
-            for b in lane_batches if keep_idle_lanes or b.valid.any())
-        result = routing_step_lanes_single(state, batches)
+            if not busy:
+                self._idle_dev_lanes[key] = dev
+            return dev
+
+        busy = [bool(b.valid.any()) for b in lane_batches]
+        batches = tuple(to_dev(li, b, busy[li])
+                        for li, b in enumerate(lane_batches))
+        result = routing_step_lanes_single(state, batches,
+                                           gather_bytes=False)
         self.steps += 1
-        return [(np.asarray(lane.deliver), np.asarray(lane.gathered_length),
-                 np.asarray(lane.gathered_bytes)) for lane in result.lanes]
+        jobs = []
+        for li, lane in enumerate(result.lanes):
+            if not busy[li]:
+                continue  # an idle lane can't deliver: skip its D2H
+            deliver = np.asarray(lane.deliver)
+            if not deliver.any():
+                continue
+            b = lane_batches[li]
+            streams = native_mod.egress_encode(deliver, b.length, [b.bytes_])
+            if streams is not None:
+                jobs.append((streams, None, None, None))
+            else:
+                jobs.append((None, deliver, b.length, b.bytes_))
+        return jobs
 
     def _egress(self, deliver, lengths, frames) -> None:
         """Walk the delivery matrix and queue the original wire frames to

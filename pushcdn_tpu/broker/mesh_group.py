@@ -39,7 +39,10 @@ from typing import TYPE_CHECKING, Dict, List, Optional
 
 import numpy as np
 
-from pushcdn_tpu.broker.tasks.senders import egress_delivery_rows
+from pushcdn_tpu.broker.tasks.senders import (
+    egress_delivery_rows,
+    egress_streams,
+)
 from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
 from pushcdn_tpu.parallel.frames import (
     TOPIC_WORDS_FULL,
@@ -49,6 +52,8 @@ from pushcdn_tpu.parallel.frames import (
     mask_mirror_shape,
     mask_of_topics,
     mask_row_of,
+    slice_batch,
+    slice_direct_batch,
     stage_best_fit,
 )
 from pushcdn_tpu.parallel.router import (
@@ -82,7 +87,23 @@ class MeshGroupConfig:
     # u32 words per topic mask: 8 covers the reference's whole u8 topic
     # space; 1 keeps compact masks for deployments with ≤32 topics
     topic_words: int = TOPIC_WORDS_FULL
+    # Adaptive coalescing: a step fires immediately when staged traffic is
+    # at least ``coalesce_min_frames`` OR the pump has been idle (burst
+    # start — the latency regime pays no window at all); a steady trickle
+    # below the threshold waits ``batch_window_s`` to amortize step cost.
     batch_window_s: float = 0.001
+    coalesce_min_frames: int = 16
+    # When everything staged fits in the first ``latency_slots`` slots of
+    # every ring/bucket, the step runs on prefix-sliced shapes — a separate
+    # (cached) jit specialization whose collectives move ~1/16th the bytes,
+    # cutting sparse-traffic step latency several-fold.
+    latency_slots: int = 8
+    # Single-host groups skip the frame-byte collectives entirely: all
+    # shards' staged frames live in this process, so only the delivery
+    # DECISION rides the mesh; egress reads payloads from the host ring
+    # snapshots (router.routing_step_lanes gather_bytes docs). Multi-host
+    # deployments set this True.
+    gather_frame_bytes: bool = False
 
     def lane_shapes(self):
         """All lanes as (frame_bytes, ring_slots, direct_bucket_slots),
@@ -150,12 +171,21 @@ class MeshShardPlane:
 
 
 class MeshBrokerGroup:
+    # user-table slice granularity (jit keys move once per bucket)
+    U_ROUND = 64
+
     def __init__(self, mesh, config: MeshGroupConfig = None):
         self.mesh = mesh
         self.config = config or MeshGroupConfig()
         c = self.config
         self.num_shards = mesh.devices.size
-        self.step_fn = make_mesh_lane_step(mesh)
+        self.step_fn = make_mesh_lane_step(
+            mesh, gather_bytes=self.config.gather_frame_bytes)
+        # every step input is placed PRE-SHARDED over the broker axis:
+        # jit would otherwise silently reshard device-0-resident arrays
+        # inside every call (~0.5 ms/array on an 8-device CPU mesh)
+        from jax.sharding import NamedSharding, PartitionSpec
+        self._sharding = NamedSharding(mesh, PartitionSpec(BROKER_AXIS))
         self.brokers: List[Optional["Broker"]] = [None] * self.num_shards
         # lane_rings[lane][shard] — size-bucketed broadcast staging
         self.lane_rings = [
@@ -183,6 +213,17 @@ class MeshBrokerGroup:
         # dynamic membership over the static mesh (hard-part #3): a stopped
         # shard is masked dead in-step rather than re-forming the mesh
         self._liveness = np.zeros(self.num_shards, bool)
+        # mirror revision: bumped on any owner/mask/liveness mutation; the
+        # step thread re-uploads device state only when it changed (steady
+        # state pays zero H2D for the user table)
+        self._state_rev = 0
+        self._dev_rev = -1
+        self._dev_state = None      # cached device RouterState (stacked)
+        self._dev_liveness = None   # cached device liveness [B, B]
+        # cached device-side EMPTY lane batches: an idle lane re-uses its
+        # device arrays, paying zero stack/H2D per step (keying the jit
+        # cache on lane SUBSETS instead would recompile per traffic mix)
+        self._idle_dev_lanes: Dict = {}
         self.disabled = False
         # set when traffic falls outside what the mesh step can carry —
         # heartbeats then form host links even in mesh-only deployments
@@ -202,6 +243,7 @@ class MeshBrokerGroup:
         plane = MeshShardPlane(self, shard)
         self.brokers[shard] = broker
         self._liveness[shard] = True
+        self._state_rev += 1
         broker.device_plane = plane
         broker.connections.observer = plane
         self._member_idents = None  # recompute lazily
@@ -226,15 +268,24 @@ class MeshBrokerGroup:
         # empty, right shapes: [lane][shard]
         batches = [[r.take_batch() for r in rings] for rings in self.lane_rings]
         directs = [[b.take_batch() for b in bkts] for bkts in self.lane_buckets]
+        lat = self.config.latency_slots
+        small = [[slice_batch(b, lat) for b in lane] for lane in batches]
+        small_d = [[slice_direct_batch(d, lat) for d in lane]
+                   for lane in directs]
+        u0 = min(self.config.num_user_slots, self.U_ROUND)
         try:
-            # compile the two common lane subsets: everything busy, and
-            # base-lane-only (steady state for small messages)
-            self._run_step(batches, directs, self._owner.copy(),
-                           self._claim_version.copy(), self._masks.copy(),
-                           keep_idle_lanes=True)
-            self._run_step(batches[:1], directs[:1], self._owner.copy(),
-                           self._claim_version.copy(), self._masks.copy(),
-                           keep_idle_lanes=True)
+            # compile the ONLY two specializations the pump needs at first
+            # population (u_eff = first user bucket): all lanes at full
+            # shapes (idle lanes ride cached device-side empties, so
+            # traffic mix never changes the jit key), and the latency-
+            # sliced base lanes (sparse traffic); wider user buckets
+            # compile on first growth past the mark
+            self._run_step(batches, directs, self._owner[:u0].copy(),
+                           self._claim_version[:u0].copy(),
+                           self._masks[:u0].copy())
+            self._run_step(small[:1], small_d[:1], self._owner[:u0].copy(),
+                           self._claim_version[:u0].copy(),
+                           self._masks[:u0].copy())
             self.steps -= 2  # warmup doesn't count
         except Exception:
             logger.exception("mesh-group warmup step failed")
@@ -243,6 +294,7 @@ class MeshBrokerGroup:
     async def on_shard_stopped(self, shard: int) -> None:
         self.brokers[shard] = None
         self._liveness[shard] = False
+        self._state_rev += 1
         self._member_idents = None
         # Release every slot the dead shard still owned: a crashed broker
         # never fires per-user removals, and without this sweep directs to
@@ -314,6 +366,7 @@ class MeshBrokerGroup:
         self._owner[slot] = shard
         self._claim_version[slot] += 1
         self._masks[slot] = mask_row_of(topics, self.config.topic_words)
+        self._state_rev += 1
 
     def release_user(self, shard: int, public_key: bytes) -> None:
         self._unmirrored.pop(public_key, None)
@@ -325,11 +378,13 @@ class MeshBrokerGroup:
         self._claim_version[slot] += 1
         self._masks[slot] = 0
         self._quarantine.append(slot)
+        self._state_rev += 1
 
     def update_mask(self, shard: int, public_key: bytes, topics) -> None:
         slot = self.slots.slot_of(public_key)
         if slot is not None and int(self._owner[slot]) == shard:
             self._masks[slot] = mask_row_of(topics, self.config.topic_words)
+            self._state_rev += 1
 
     # ---- staging ----------------------------------------------------------
 
@@ -451,36 +506,81 @@ class MeshBrokerGroup:
 
     # ---- the pump ---------------------------------------------------------
 
+    def _staged_total(self) -> int:
+        return (sum(r.slots - r.free_slots
+                    for rings in self.lane_rings for r in rings)
+                + sum(b.total_used
+                      for bkts in self.lane_buckets for b in bkts))
+
     async def _pump(self) -> None:
+        c = self.config
+        loop = asyncio.get_running_loop()
+        last_step_t = -1e9
         while True:
             await self._kick.wait()
             self._kick.clear()
-            await asyncio.sleep(self.config.batch_window_s)
-            if not self._state_dirty and \
-                    all(r.free_slots == r.slots
-                        for rings in self.lane_rings for r in rings) and \
-                    all(b.total_used == 0
-                        for bkts in self.lane_buckets for b in bkts):
+            # one yield so every stager woken in this tick lands first
+            await asyncio.sleep(0)
+            staged = self._staged_total()
+            if staged and staged < c.coalesce_min_frames and \
+                    loop.time() - last_step_t < 4 * c.batch_window_s:
+                # steady trickle below the coalesce threshold: wait one
+                # window. A burst after idle (latency regime) and a
+                # saturated pipeline both step immediately.
+                await asyncio.sleep(c.batch_window_s)
+                staged = self._staged_total()
+            if not self._state_dirty and staged == 0:
                 continue
             self._state_dirty = False
+            # prefix-slice to the latency shapes when everything staged
+            # fits the base lanes' first ``latency_slots`` slots and the
+            # extra lanes are idle (collectives then move ~ring/lat× fewer
+            # bytes; one extra cached jit specialization)
+            lat = c.latency_slots
+            small = (all(r.slots - r.free_slots <= lat
+                         for r in self.lane_rings[0])
+                     and all(b.max_used <= lat
+                             for b in self.lane_buckets[0])
+                     and all(r.free_slots == r.slots
+                             for rings in self.lane_rings[1:] for r in rings)
+                     and all(b.total_used == 0
+                             for bkts in self.lane_buckets[1:] for b in bkts))
             # one-tick snapshot: all lanes' rings + buckets + mirrors
             batches = [[r.take_batch() for r in rings]
                        for rings in self.lane_rings]
             directs = [[b.take_batch() for b in bkts]
                        for bkts in self.lane_buckets]
-            owner = self._owner.copy()
-            versions = self._claim_version.copy()
-            masks = self._masks.copy()
+            if small:
+                batches = [[slice_batch(b, lat) for b in batches[0]]]
+                directs = [[slice_direct_batch(d, lat) for d in directs[0]]]
+            # slice the user table to its high-water mark (rounded up so
+            # the jit key only moves every ``u_round`` users): delivery
+            # matrices, their D2H, and the egress scans all shrink with the
+            # actual population instead of paying for empty slots
+            u_round = self.U_ROUND
+            u_eff = min(self.config.num_user_slots,
+                        max(u_round, -(-self.slots.high_water // u_round)
+                            * u_round))
+            owner = self._owner[:u_eff].copy()
+            versions = self._claim_version[:u_eff].copy()
+            masks = self._masks[:u_eff].copy()
             liveness = self._liveness.copy()
+            rev = self._state_rev
             quarantined, self._quarantine = self._quarantine, []
             try:
-                lanes, direct_lanes = await asyncio.to_thread(
+                egress_jobs = await asyncio.to_thread(
                     self._run_step, batches, directs, owner, versions, masks,
-                    liveness)
-                for deliver, lengths, frames in lanes:
-                    self._egress(deliver, lengths, frames)
-                for deliver, lengths, frames in direct_lanes:
-                    self._egress(deliver, lengths, frames)
+                    liveness, rev)
+                last_step_t = loop.time()
+                for shard, streams, d2, lengths, frames in egress_jobs:
+                    broker = self.brokers[shard]
+                    if broker is None:
+                        continue
+                    if streams is not None:
+                        self.messages_routed += egress_streams(
+                            broker, self.slots, streams)
+                    else:
+                        self._egress_py(broker, d2, lengths, frames)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -505,78 +605,180 @@ class MeshBrokerGroup:
                     self.slots.free_slot(slot)
 
     def _run_step(self, batches, directs, owner, versions, masks,
-                  liveness=None, keep_idle_lanes: bool = False):
+                  liveness=None, state_rev=None):
         """Blocking multi-shard device step (worker thread). ``batches`` and
         ``directs`` are [lane][shard] host snapshots; busy lanes ride ONE
         jitted shard_map program with one shared CRDT merge. Lanes idle on
-        EVERY shard are dropped before the H2D transfer (an empty lane
-        delivers nothing; each lane subset is its own cached jit
-        specialization), so an idle wide lane costs no ICI traffic."""
-        import jax.numpy as jnp
+        EVERY shard ride cached device-side empty batches (zero stack/H2D
+        per step) so the jit key never depends on the traffic mix.
+
+        The device user table is re-uploaded only when ``state_rev`` moved
+        (steady state pays zero H2D for state), and egress payloads come
+        from the HOST snapshots when ``gather_frame_bytes`` is off — the
+        step returns per-shard egress jobs, each either a native
+        :class:`native.EgressStreams` (encoded right here, off the event
+        loop) or the Python-fallback (deliver, lengths, frames) triple."""
+        import jax
+        from pushcdn_tpu import native as native_mod
         B = self.num_shards
-        if not keep_idle_lanes:
-            batches = [lane for lane in batches
-                       if any(b.valid.any() for b in lane)]
-            directs = [lane for lane in directs
-                       if any(d.valid.any() for d in lane)]
-        # every shard's state row is the (shared) global view; on real
-        # multi-host pods these rows diverge and the in-step merge converges
-        # them — the device program is identical
-        owners_b = np.broadcast_to(owner, (B,) + owner.shape)
-        versions_b = np.broadcast_to(versions, (B,) + versions.shape)
-        ids_b = owners_b  # conflict identity = owning shard index
-        masks_b = np.broadcast_to(masks, (B,) + masks.shape)
-        state = RouterState(
-            crdt=CrdtState(jnp.asarray(owners_b), jnp.asarray(versions_b),
-                           jnp.asarray(ids_b)),
-            topic_masks=jnp.asarray(masks_b))
-        lane_batches = tuple(
-            IngressBatch(
-                jnp.asarray(np.stack([b.bytes_ for b in lane])),
-                jnp.asarray(np.stack([b.kind for b in lane])),
-                jnp.asarray(np.stack([b.length for b in lane])),
-                jnp.asarray(np.stack([b.topic_mask for b in lane])),
-                jnp.asarray(np.stack([b.dest for b in lane])),
-                jnp.asarray(np.stack([b.valid for b in lane])))
-            for lane in batches)
-        lane_directs = tuple(
-            DirectIngress(
-                jnp.asarray(np.stack([d.bytes_ for d in lane])),
-                jnp.asarray(np.stack([d.length for d in lane])),
-                jnp.asarray(np.stack([d.dest for d in lane])),
-                jnp.asarray(np.stack([d.valid for d in lane])))
-            for lane in directs)
+        put = lambda a: jax.device_put(a, self._sharding)
         live = (np.ones(B, bool) if liveness is None else liveness)
-        result = self.step_fn(state, lane_batches, lane_directs,
-                              jnp.asarray(np.broadcast_to(live, (B, B))))
+        if state_rev is not None and state_rev == self._dev_rev \
+                and self._dev_state is not None:
+            state = self._dev_state
+            live_dev = self._dev_liveness
+        else:
+            # every shard's state row is the (shared) global view; on real
+            # multi-host pods these rows diverge and the in-step merge
+            # converges them — the device program is identical
+            owners_b = np.broadcast_to(owner, (B,) + owner.shape)
+            versions_b = np.broadcast_to(versions, (B,) + versions.shape)
+            masks_b = np.broadcast_to(masks, (B,) + masks.shape)
+            state = RouterState(
+                crdt=CrdtState(put(owners_b),
+                               put(versions_b),
+                               put(owners_b)),  # identity = shard
+                topic_masks=put(masks_b))
+            live_dev = put(np.broadcast_to(live, (B, B)))
+            if state_rev is not None:
+                self._dev_state, self._dev_liveness = state, live_dev
+                self._dev_rev = state_rev
+        def put_rows(key, rows, busy_rows):
+            """Assemble the [B, ...] byte tensor per device: busy shards
+            H2D their own block; idle shards reuse a cached device-side
+            zero block (their ``valid`` masks are False, so stale content
+            can never deliver). Stack+upload cost is ∝ TRAFFIC, not lane
+            geometry — with one busy shard this moves 1/B of the bytes a
+            full-stack would."""
+            devices = self.mesh.devices.reshape(-1)
+            shards = []
+            zero_key = ("z", key, rows[0].shape)
+            zeros = self._idle_dev_lanes.get(zero_key)
+            if zeros is None:
+                zeros = [
+                    jax.device_put(np.zeros((1,) + rows[0].shape, np.uint8),
+                                   d) for d in devices]
+                self._idle_dev_lanes[zero_key] = zeros
+            for i, row in enumerate(rows):
+                if busy_rows[i]:
+                    shards.append(jax.device_put(row[None], devices[i]))
+                else:
+                    shards.append(zeros[i])
+            return jax.make_array_from_single_device_arrays(
+                (len(rows),) + rows[0].shape, self._sharding, shards)
+
+        def lane_to_dev(key, lane, busy):
+            """H2D one lane; an idle lane reuses its cached device-side
+            empty batch (zero stack/copy), keyed by (kind, index, shape)."""
+            if not busy:
+                cached = self._idle_dev_lanes.get(key)
+                if cached is not None:
+                    return cached
+            if key[0] == "b":
+                dev = IngressBatch(
+                    put_rows(key, [b.bytes_ for b in lane],
+                             [bool(b.valid.any()) for b in lane]),
+                    put(np.stack([b.kind for b in lane])),
+                    put(np.stack([b.length for b in lane])),
+                    put(np.stack([b.topic_mask for b in lane])),
+                    put(np.stack([b.dest for b in lane])),
+                    put(np.stack([b.valid for b in lane])))
+            else:
+                dev = DirectIngress(
+                    put_rows(key, [d.bytes_ for d in lane],
+                             [bool(d.valid.any()) for d in lane]),
+                    put(np.stack([d.length for d in lane])),
+                    put(np.stack([d.dest for d in lane])),
+                    put(np.stack([d.valid for d in lane])))
+            if not busy:
+                self._idle_dev_lanes[key] = dev
+            return dev
+
+        busy_b = [any(b.valid.any() for b in lane) for lane in batches]
+        busy_d = [any(d.valid.any() for d in lane) for lane in directs]
+        lane_batches = tuple(
+            lane_to_dev(("b", li, lane[0].valid.shape[0]), lane, busy_b[li])
+            for li, lane in enumerate(batches))
+        lane_directs = tuple(
+            lane_to_dev(("d", li, lane[0].valid.shape[1]), lane, busy_d[li])
+            for li, lane in enumerate(directs))
+        result = self.step_fn(state, lane_batches, lane_directs, live_dev)
         self.steps += 1
-        lanes = [(np.asarray(l.deliver), np.asarray(l.gathered_length),
-                  np.asarray(l.gathered_bytes)) for l in result.lanes]
-        direct_lanes = [(np.asarray(l.deliver), np.asarray(l.gathered_length),
-                         np.asarray(l.gathered_bytes))
-                        for l in result.direct_lanes]
-        return lanes, direct_lanes
-
-    def _egress(self, deliver, lengths, frames) -> None:
-        for shard in range(self.num_shards):
-            broker = self.brokers[shard]
-            if broker is None:
+        # ---- egress prep: decisions from the mesh, payloads from host ----
+        # (idle lanes can't deliver: skip their D2H entirely)
+        jobs = []
+        for li, l in enumerate(result.lanes):
+            if not busy_b[li]:
                 continue
-            users, frame_idx = np.nonzero(deliver[shard])
-            cache: Dict[int, Bytes] = {}
+            deliver = np.asarray(l.deliver)          # bool[B, U, N]
+            if self.config.gather_frame_bytes:
+                lengths = np.asarray(l.gathered_length[0])
+                blocks = [np.asarray(l.gathered_bytes[0])]
+                per_shard = None
+            else:
+                lane = batches[li]
+                lengths = np.concatenate([b.length for b in lane])
+                blocks = [b.bytes_ for b in lane]
+                per_shard = None
+            jobs.append((deliver, lengths, blocks, per_shard))
+        for li, l in enumerate(result.direct_lanes):
+            if not busy_d[li]:
+                continue
+            deliver = np.asarray(l.deliver)          # bool[B, U, B*C]
+            if self.config.gather_frame_bytes:
+                # all_to_all output DIFFERS per shard (unlike the broadcast
+                # all_gather): each shard's received bytes/lengths must pair
+                # with that shard's own delivery mask
+                lengths = np.asarray(l.gathered_length)   # [B, B*C]
+                blocks = np.asarray(l.gathered_bytes)     # [B, B*C, F]
+                jobs.append((deliver, lengths, blocks, "per-shard"))
+            else:
+                # the all_to_all transposes buckets: shard j receives, from
+                # each source shard, that source's bucket FOR j
+                lane = directs[li]
+                jobs.append((deliver, None, None, lane))
+        out = []
+        for deliver, lengths, blocks, direct_lane in jobs:
+            for shard in range(B):
+                if self.brokers[shard] is None:
+                    continue
+                d2 = deliver[shard]
+                if not d2.any():
+                    continue
+                if direct_lane == "per-shard":
+                    s_lengths = lengths[shard]
+                    s_blocks = [blocks[shard]]
+                elif direct_lane is not None:
+                    s_lengths = np.concatenate(
+                        [direct_lane[src].length[shard] for src in range(B)])
+                    s_blocks = [direct_lane[src].bytes_[shard]
+                                for src in range(B)]
+                else:
+                    s_lengths, s_blocks = lengths, blocks
+                streams = native_mod.egress_encode(d2, s_lengths, s_blocks)
+                if streams is not None:
+                    out.append((shard, streams, None, None, None))
+                else:  # no native library: per-frame Python fallback
+                    out.append((shard, None, d2, s_lengths,
+                                np.concatenate(s_blocks)))
+        return out
 
-            def frame_of(f: int) -> Bytes:
-                raw = cache.get(f)
-                if raw is None:
-                    raw = Bytes(
-                        frames[shard, f, :lengths[shard, f]].tobytes())
-                    cache[f] = raw
-                return raw
+    def _egress_py(self, broker, deliver2, lengths, frames) -> None:
+        """Per-frame fallback egress for one shard (native lib absent)."""
+        users, frame_idx = np.nonzero(deliver2)
+        cache: Dict[int, Bytes] = {}
 
-            self.messages_routed += egress_delivery_rows(
-                broker, self.slots, users, frame_idx, frame_of)
-            for raw in cache.values():
-                raw.release()
+        def frame_of(f: int) -> Bytes:
+            raw = cache.get(f)
+            if raw is None:
+                raw = Bytes(frames[f, :lengths[f]].tobytes())
+                cache[f] = raw
+            return raw
+
+        self.messages_routed += egress_delivery_rows(
+            broker, self.slots, users, frame_idx, frame_of)
+        for raw in cache.values():
+            raw.release()
 
     async def _host_fallback(self, batches) -> None:
         """Re-route every staged frame over the host plane (brokers keep

@@ -66,6 +66,39 @@ def try_send_frames_to_user_nowait(broker: "Broker", public_key: bytes,
         return 0
 
 
+def try_send_encoded_to_user_nowait(broker: "Broker", public_key: bytes,
+                                    data) -> bool:
+    """Queue a pre-framed egress stream (native.egress_encode output) to
+    one user — zero per-frame work here or in the writer; a failure
+    removes the user (failure-is-removal, as everywhere)."""
+    connection = broker.connections.get_user_connection(public_key)
+    if connection is None:
+        return False
+    try:
+        connection.send_encoded_nowait(data)
+        return True
+    except Exception as exc:
+        logger.info("encoded send to user %s failed (%r); removing",
+                    mnemonic(public_key), exc)
+        broker.connections.remove_user(public_key, reason="send failed")
+        broker.update_metrics()
+        return False
+
+
+def egress_streams(broker: "Broker", slots, streams) -> int:
+    """Deliver one step's native egress (:class:`native.EgressStreams`):
+    one pre-framed stream handoff per user with deliveries. Returns the
+    number of messages queued."""
+    routed = 0
+    for slot in streams.users:
+        key = slots.key_of(int(slot))
+        if key is None:  # released mid-step: user is gone, drop
+            continue
+        if try_send_encoded_to_user_nowait(broker, key, streams.stream(slot)):
+            routed += int(streams.msgs[slot])
+    return routed
+
+
 def egress_delivery_rows(broker: "Broker", slots, users, frame_idx,
                          frame_of) -> int:
     """Shared device-plane egress walk: deliver a (users, frame_idx)

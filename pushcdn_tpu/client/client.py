@@ -34,6 +34,7 @@ from pushcdn_tpu.proto.message import (
     Message,
     Subscribe,
     Unsubscribe,
+    deserialize_owned,
     serialize,
 )
 from pushcdn_tpu.proto.transport.base import Connection, Protocol
@@ -152,6 +153,46 @@ class Client:
         except Exception as exc:
             self._disconnect_on_error()
             bail(ErrorKind.CONNECTION, "receive failed; connection reset", exc)
+
+    async def receive_messages(self, max_messages: int = 1024
+                               ) -> List[Message]:
+        """Receive every message currently available (at least one; blocks
+        only when none are pending) in ONE wakeup — the batch twin of
+        :meth:`receive_message` for consumers that keep up with fan-out
+        rates: per-message task wakeups are what bound a single-process
+        drain loop, exactly like the transport's own batched reader
+        (transport/base.py). Same elastic semantics: any error (transport
+        OR a malformed frame) tears the connection down for lazy re-dial.
+
+        ``max_messages`` is approximate: the transport hands over whole
+        parse batches, so one call may return more than asked (never
+        fewer than 1)."""
+        from pushcdn_tpu.proto.transport.base import FrameChunk
+        conn = self._connection
+        if conn is None or conn.is_closed:
+            conn = await self._get_connection()
+        try:
+            items = await conn.recv_frames(max_messages)
+        except Exception as exc:
+            self._disconnect_on_error()
+            bail(ErrorKind.CONNECTION, "receive failed; connection reset", exc)
+        out = []
+        try:
+            for item in items:
+                if type(item) is FrameChunk:
+                    # whole-chunk batch decode off the shared buffer: one
+                    # payload copy per message, one release for the lot
+                    out.extend(item.decode_remaining())
+                else:
+                    out.append(deserialize_owned(item.data))
+        except Exception as exc:
+            self._disconnect_on_error()
+            bail(ErrorKind.CONNECTION,
+                 "malformed frame in receive batch; connection reset", exc)
+        finally:
+            for item in items:
+                item.release()
+        return out
 
     # -- subscriptions -------------------------------------------------------
 

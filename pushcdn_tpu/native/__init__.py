@@ -61,6 +61,14 @@ def _compile() -> Optional[ctypes.CDLL]:
     lib.pushcdn_encode_frames_ptrs.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), i32p,
         ctypes.c_int32, u8p, ctypes.c_int64]
+    lib.pushcdn_egress_count.restype = None
+    lib.pushcdn_egress_count.argtypes = [
+        u8p, ctypes.c_int32, ctypes.c_int32, i32p, i64p, i32p]
+    lib.pushcdn_egress_fill.restype = ctypes.c_int64
+    lib.pushcdn_egress_fill.argtypes = [
+        u8p, ctypes.c_int32, ctypes.c_int32, i32p,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int64, i64p, u8p, ctypes.c_int64]
     return lib
 
 
@@ -243,6 +251,76 @@ class FrameEncoder:
         if wrote < 0:
             return None
         return memoryview(self._out)[:wrote]
+
+
+class EgressStreams:
+    """One step's egress, encoded: per-user length-delimited streams laid
+    out back-to-back in one buffer. ``users`` lists the slots with at least
+    one delivery; ``stream(i)`` is the i-th listed user's bytes — already
+    wire-framed, handed to the connection writer as-is."""
+
+    __slots__ = ("buf", "users", "offsets", "nbytes", "msgs", "total_msgs")
+
+    def __init__(self, buf, users, offsets, nbytes, msgs):
+        self.buf = buf
+        self.users = users      # int list — user slots with deliveries
+        self.offsets = offsets  # int64[U] stream starts (all slots)
+        self.nbytes = nbytes    # int64[U] stream sizes (all slots)
+        self.msgs = msgs        # int32[U] delivered count (all slots)
+        self.total_msgs = int(msgs.sum())
+
+    def stream(self, slot: int) -> memoryview:
+        off = int(self.offsets[slot])
+        return memoryview(self.buf)[off:off + int(self.nbytes[slot])]
+
+
+def egress_encode(deliver: np.ndarray, lengths: np.ndarray,
+                  blocks: list) -> Optional[EgressStreams]:
+    """Encode a delivery matrix into per-user wire streams via the C++
+    engine (two passes: count → prefix-sum → fill). ``deliver`` is
+    bool[U, N] (numpy bool_, row-major); ``lengths`` int32[N]; ``blocks``
+    the per-shard frame tensors in gather order (each C-contiguous
+    uint8[rows, frame_bytes], equal shapes) — frame n is row
+    ``n % rows`` of block ``n // rows``. Returns None when the native
+    library is unavailable (callers fall back to the per-frame path)."""
+    lib = _get()
+    if lib is None:
+        return None
+    U, N = deliver.shape
+    rows = blocks[0].shape[0]
+    stride = blocks[0].strides[0]  # row pitch (rows themselves contiguous)
+    if rows * len(blocks) != N:
+        raise ValueError(f"blocks cover {rows * len(blocks)} frames, "
+                         f"deliver has {N}")
+    for b in blocks:
+        if b.shape[0] != rows or b.strides[0] != stride or b.strides[1] != 1:
+            raise ValueError("egress blocks must share shape/stride with "
+                             "byte-contiguous rows")
+    if deliver.dtype == np.bool_ and deliver.flags.c_contiguous:
+        deliver = deliver.view(np.uint8)  # free: bool_ is 1 byte/cell
+    else:
+        deliver = np.ascontiguousarray(deliver, np.uint8)
+    lengths = np.ascontiguousarray(lengths, np.int32)
+    per_bytes = np.zeros(U, np.int64)
+    per_msgs = np.zeros(U, np.int32)
+    lib.pushcdn_egress_count(
+        _ptr(deliver, ctypes.c_uint8), U, N,
+        _ptr(lengths, ctypes.c_int32),
+        _ptr(per_bytes, ctypes.c_int64), _ptr(per_msgs, ctypes.c_int32))
+    total = int(per_bytes.sum())
+    offsets = np.zeros(U, np.int64)
+    np.cumsum(per_bytes[:-1], out=offsets[1:])
+    out = np.empty(total if total else 1, np.uint8)
+    block_ptrs = (ctypes.c_void_p * len(blocks))(
+        *(b.ctypes.data for b in blocks))
+    wrote = lib.pushcdn_egress_fill(
+        _ptr(deliver, ctypes.c_uint8), U, N, _ptr(lengths, ctypes.c_int32),
+        block_ptrs, len(blocks), rows, stride,
+        _ptr(offsets, ctypes.c_int64), _ptr(out, ctypes.c_uint8), total)
+    if wrote != total:  # can't happen on one snapshot; stay safe
+        return None
+    users = np.nonzero(per_msgs)[0].tolist()
+    return EgressStreams(out, users, offsets, per_bytes, per_msgs)
 
 
 def encode_frames(payloads: list[bytes]) -> Optional[bytes]:

@@ -94,6 +94,11 @@ class UserSlots:
         self._key_to_slot: Dict[bytes, int] = {}
         self._slot_to_key: List[Optional[bytes]] = [None] * capacity
         self._free: List[int] = list(range(capacity - 1, -1, -1))
+        # 1 + highest slot ever assigned (lowest-free allocation order keeps
+        # this tight): the device planes slice their state/delivery tensors
+        # to this mark, so a 1024-slot table with 16 users costs 16-user
+        # matrices, not 1024-user ones
+        self.high_water = 0
 
     def assign(self, public_key: bytes) -> int:
         slot = self._key_to_slot.get(public_key)
@@ -105,6 +110,8 @@ class UserSlots:
         slot = self._free.pop()
         self._key_to_slot[public_key] = slot
         self._slot_to_key[slot] = public_key
+        if slot + 1 > self.high_water:
+            self.high_water = slot + 1
         return slot
 
     def release(self, public_key: bytes) -> None:
@@ -344,6 +351,12 @@ class DirectBuckets:
     def total_used(self) -> int:
         return int(self._used.sum())
 
+    @property
+    def max_used(self) -> int:
+        """Largest per-destination fill — the latency-slice eligibility
+        check (every bucket's frames must fit the prefix slice)."""
+        return int(self._used.max())
+
     def push(self, dest_shard: int, payload: bytes, dest_slot: int) -> bool:
         if len(payload) > self.frame_bytes:
             bail(ErrorKind.EXCEEDED_SIZE,
@@ -386,6 +399,22 @@ def empty_direct_batch(num_shards: int, capacity: int,
         dest=np.full((num_shards, capacity), -1, np.int32),
         valid=np.zeros((num_shards, capacity), bool),
     )
+
+
+def slice_batch(b: FrameBatch, n: int) -> FrameBatch:
+    """Prefix-slice a batch to its first ``n`` slots (views, no copies) —
+    the latency-shape path: rings fill sequentially from slot 0, so when
+    ``used <= n`` the prefix holds every staged frame."""
+    return FrameBatch(
+        bytes_=b.bytes_[:n], kind=b.kind[:n], length=b.length[:n],
+        topic_mask=b.topic_mask[:n], dest=b.dest[:n], valid=b.valid[:n])
+
+
+def slice_direct_batch(d: DirectBatch, n: int) -> DirectBatch:
+    """Prefix-slice every destination bucket to ``n`` slots (views)."""
+    return DirectBatch(
+        bytes_=d.bytes_[:, :n], length=d.length[:, :n],
+        dest=d.dest[:, :n], valid=d.valid[:, :n])
 
 
 def stage_best_fit(lanes, size: int, push) -> bool:

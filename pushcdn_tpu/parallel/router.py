@@ -105,7 +105,8 @@ def empty_router_state(num_users: int, topic_words: int = 1) -> RouterState:
 
 def _direct_route(direct: DirectIngress, now_local: jax.Array,
                   axis_name: Optional[str],
-                  liveness: Optional[jax.Array] = None):
+                  liveness: Optional[jax.Array] = None,
+                  gather_bytes: bool = True):
     """Exchange per-destination buckets and build the local delivery mask.
 
     ``all_to_all`` swaps the destination-shard axis for a source-shard
@@ -117,7 +118,8 @@ def _direct_route(direct: DirectIngress, now_local: jax.Array,
         r_bytes, r_length, r_dest, r_valid = (
             direct.frame_bytes, direct.length, direct.dest, direct.valid)
     else:
-        r_bytes = jax.lax.all_to_all(direct.frame_bytes, axis_name, 0, 0)
+        r_bytes = (jax.lax.all_to_all(direct.frame_bytes, axis_name, 0, 0)
+                   if gather_bytes else None)
         r_length = jax.lax.all_to_all(direct.length, axis_name, 0, 0)
         r_dest = jax.lax.all_to_all(direct.dest, axis_name, 0, 0)
         r_valid = jax.lax.all_to_all(direct.valid, axis_name, 0, 0)
@@ -133,7 +135,8 @@ def _direct_route(direct: DirectIngress, now_local: jax.Array,
     deliver = (valid_f[None, :]
                & (dest_f[None, :] == slots[:, None])
                & now_local[:, None])
-    return r_bytes.reshape(B * C, -1), r_length.reshape(B * C), deliver
+    return (None if r_bytes is None else r_bytes.reshape(B * C, -1),
+            r_length.reshape(B * C), deliver)
 
 
 def routing_step(state: RouterState, batch: IngressBatch,
@@ -197,6 +200,7 @@ def routing_step_lanes(state: RouterState,
                        axis_name: Optional[str],
                        directs: tuple = (),
                        liveness: Optional[jax.Array] = None,
+                       gather_bytes: bool = True,
                        ) -> MultiRouteResult:
     """One routing step over any number of size-bucketed lanes.
 
@@ -206,6 +210,16 @@ def routing_step_lanes(state: RouterState,
     merge runs ONCE; every lane's delivery matrix is computed against the
     same merged state, so cross-lane semantics are identical to a single
     ring — a lane is purely a shape bucket.
+
+    ``gather_bytes=False`` skips the frame-byte collectives entirely
+    (lanes come back with ``gathered_bytes=None``): on a single-host
+    multi-chip topology every shard's staged frames already live in the
+    one host's memory, so moving payload bytes over ICI and back through
+    D2H is pure waste — only the *delivery decision* needs the mesh. The
+    egress pump reads payloads from the host ring snapshots instead
+    (broker/mesh_group.py). Multi-host deployments keep the default: a
+    remote host's frame bytes exist nowhere locally except via the
+    step's collectives.
 
     ``liveness`` (bool[B], identical on every shard) is the dynamic-
     membership mask over the STATIC device mesh (SURVEY.md §7 hard-part
@@ -252,7 +266,7 @@ def routing_step_lanes(state: RouterState,
     # ---- per-lane inter-broker hop + delivery matrix ---------------------
     lanes = []
     for batch in batches:
-        g_bytes = gather(batch.frame_bytes)
+        g_bytes = gather(batch.frame_bytes) if gather_bytes else None
         g_kind = gather(batch.kind)
         g_length = gather(batch.length)
         g_tmask = gather(batch.topic_mask)
@@ -269,14 +283,16 @@ def routing_step_lanes(state: RouterState,
             masks, now_local, tmask_f, kind_f,
             g_dest.reshape(B * S), use_pallas=USE_PALLAS_DELIVERY)
         lanes.append(LaneDelivery(
-            gathered_bytes=g_bytes.reshape(B * S, -1),
+            gathered_bytes=(None if g_bytes is None
+                            else g_bytes.reshape(B * S, -1)),
             gathered_length=g_length.reshape(B * S),
             deliver=deliver))
 
     direct_lanes = []
     for direct in directs:
         d_bytes, d_length, d_deliver = _direct_route(
-            direct, now_local, axis_name, liveness)
+            direct, now_local, axis_name, liveness,
+            gather_bytes=gather_bytes)
         direct_lanes.append(LaneDelivery(
             gathered_bytes=d_bytes, gathered_length=d_length,
             deliver=d_deliver))
@@ -298,21 +314,31 @@ def routing_step_single(state: RouterState, batch: IngressBatch
     return routing_step(state, batch, jnp.int32(0), axis_name=None)
 
 
-@jax.jit
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("gather_bytes",))
 def routing_step_lanes_single(state: RouterState, batches: tuple,
-                              directs: tuple = ()) -> MultiRouteResult:
+                              directs: tuple = (),
+                              gather_bytes: bool = True
+                              ) -> MultiRouteResult:
     """Single-chip lane step (a change in the number of lanes is a pytree
-    structure change, so jit retraces per lane-set shape)."""
+    structure change, so jit retraces per lane-set shape).
+    ``gather_bytes=False`` keeps frame bytes out of the step entirely —
+    the single-shard plane's egress reads them from the host ring
+    snapshot, so only the delivery matrix crosses PCIe back."""
     return routing_step_lanes(state, batches, jnp.int32(0), axis_name=None,
-                              directs=directs)
+                              directs=directs, gather_bytes=gather_bytes)
 
 
-def make_mesh_lane_step(mesh: Mesh):
+def make_mesh_lane_step(mesh: Mesh, gather_bytes: bool = True):
     """Build the multi-chip lane step: every leaf of (state, batches,
     directs) is stacked on a leading broker axis and sharded over the mesh;
     one jitted shard_map program routes all lanes (per-lane all_gather /
     all_to_all over ICI, one shared CRDT merge). ``liveness`` is stacked
-    [B, B] (every shard carries the full membership mask)."""
+    [B, B] (every shard carries the full membership mask).
+    ``gather_bytes=False`` builds the single-host variant whose lanes skip
+    the frame-byte collectives (see :func:`routing_step_lanes`)."""
 
     def per_shard(state: RouterState, batches: tuple, directs: tuple,
                   liveness: jax.Array):
@@ -322,7 +348,8 @@ def make_mesh_lane_step(mesh: Mesh):
         my = jax.lax.axis_index(BROKER_AXIS).astype(jnp.int32)
         result = routing_step_lanes(state, batches, my,
                                     axis_name=BROKER_AXIS, directs=directs,
-                                    liveness=liveness[0])
+                                    liveness=liveness[0],
+                                    gather_bytes=gather_bytes)
         return jax.tree.map(lambda x: x[None], result)
 
     sharded = jax.shard_map(

@@ -90,36 +90,60 @@ class AuthenticateResponse:
     kind = KIND_AUTHENTICATE_RESPONSE
 
 
-@dataclass(frozen=True, slots=True)
 class Direct:
     """Point-to-point message to ``recipient`` (a serialized public key).
 
     Hot-path variant: ``message`` is the unprefixed frame tail (zero-copy).
-    Parity: message.rs Direct {recipient, message}.
+    Treat as immutable. Plain ``__slots__`` class, not a frozen dataclass:
+    these two are constructed once per received message, and the frozen
+    ``object.__setattr__`` ceremony was a top-3 line in the fan-out drain
+    profile. Parity: message.rs Direct {recipient, message}.
     """
 
-    recipient: bytes
-    message: BytesLike
+    __slots__ = ("recipient", "message")
 
     kind = KIND_DIRECT
 
+    def __init__(self, recipient: bytes, message: BytesLike):
+        self.recipient = recipient
+        self.message = message
 
-@dataclass(frozen=True, slots=True)
+    def __eq__(self, other):
+        return (type(other) is Direct and self.recipient == other.recipient
+                and self.message == other.message)
+
+    def __hash__(self):
+        return hash((KIND_DIRECT, self.recipient, self.message))
+
+    def __repr__(self):
+        return f"Direct(recipient={self.recipient!r}, <{len(self.message)} B>)"
+
+
 class Broadcast:
     """Publish to every subscriber of ``topics``.
 
     Hot-path variant: ``message`` is the unprefixed frame tail (zero-copy).
+    Treat as immutable (see :class:`Direct` on why not a dataclass).
     Parity: message.rs Broadcast {topics, message}.
     """
 
-    topics: Tuple[Topic, ...]
-    message: BytesLike
+    __slots__ = ("topics", "message")
 
     kind = KIND_BROADCAST
 
     def __init__(self, topics: Sequence[Topic], message: BytesLike):
-        object.__setattr__(self, "topics", tuple(topics))
-        object.__setattr__(self, "message", message)
+        self.topics = topics if type(topics) is tuple else tuple(topics)
+        self.message = message
+
+    def __eq__(self, other):
+        return (type(other) is Broadcast and self.topics == other.topics
+                and self.message == other.message)
+
+    def __hash__(self):
+        return hash((KIND_BROADCAST, self.topics, self.message))
+
+    def __repr__(self):
+        return f"Broadcast(topics={self.topics!r}, <{len(self.message)} B>)"
 
 
 @dataclass(frozen=True, slots=True)
@@ -339,7 +363,8 @@ def deserialize_owned(frame: BytesLike) -> Message:
     are), slicing it copies directly — one object construction and one copy
     instead of view + materialize + recopy. Convenience receive APIs use
     this; semantics are identical to the two-step path."""
-    if type(frame) is bytes:
+    t = type(frame)
+    if t is bytes or t is memoryview:
         n = len(frame)
         if 1 <= n <= MAX_MESSAGE_SIZE:
             kind = frame[0]
@@ -347,6 +372,10 @@ def deserialize_owned(frame: BytesLike) -> Message:
                 if kind == KIND_DIRECT:
                     (rlen,) = _U32.unpack_from(frame, 1)
                     if 5 + rlen <= n:
+                        if t is memoryview:  # chunk views: copy out here
+                            return Direct(
+                                recipient=bytes(frame[5:5 + rlen]),
+                                message=bytes(frame[5 + rlen:]))
                         return Direct(recipient=frame[5:5 + rlen],
                                       message=frame[5 + rlen:])
                     bail(ErrorKind.DESERIALIZE,
@@ -354,6 +383,10 @@ def deserialize_owned(frame: BytesLike) -> Message:
                 if kind == KIND_BROADCAST:
                     (ntopics,) = _U16.unpack_from(frame, 1)
                     if 3 + ntopics <= n:
+                        if t is memoryview:
+                            return Broadcast(
+                                topics=tuple(frame[3:3 + ntopics]),
+                                message=bytes(frame[3 + ntopics:]))
                         return Broadcast(topics=tuple(frame[3:3 + ntopics]),
                                          message=frame[3 + ntopics:])
                     bail(ErrorKind.DESERIALIZE,
@@ -364,7 +397,39 @@ def deserialize_owned(frame: BytesLike) -> Message:
                 # malformed-frame disconnect policy catches Error only
                 bail(ErrorKind.DESERIALIZE,
                      f"truncated frame for kind {kind}", exc)
-    return materialize(deserialize(frame))
+    return materialize(deserialize(bytes(frame) if t is memoryview
+                                   else frame))
+
+
+def decode_frames(buf: bytes, offs, lens, start: int = 0) -> list:
+    """Decode a parse batch's frames straight off the shared chunk buffer
+    (transport ``FrameChunk``) — the fan-out drain's hot loop. Inline
+    little-endian field reads replace per-frame memoryview + Struct calls;
+    payload/recipient slices of the ``bytes`` buffer are the single owned
+    copy. Cold kinds and malformed frames take the general path (which
+    raises the usual ``Error(DESERIALIZE)``)."""
+    out = []
+    append = out.append
+    for i in range(start, len(offs)):
+        o = offs[i]
+        n = lens[i]
+        if n >= 3:
+            kind = buf[o]
+            if kind == KIND_BROADCAST:
+                nt = buf[o + 1] | (buf[o + 2] << 8)
+                p = o + 3 + nt
+                if p <= o + n:
+                    append(Broadcast(tuple(buf[o + 3:p]), buf[p:o + n]))
+                    continue
+            elif kind == KIND_DIRECT and n >= 5:
+                rlen = (buf[o + 1] | (buf[o + 2] << 8)
+                        | (buf[o + 3] << 16) | (buf[o + 4] << 24))
+                p = o + 5 + rlen
+                if p <= o + n:
+                    append(Direct(buf[o + 5:p], buf[p:o + n]))
+                    continue
+        append(deserialize_owned(bytes(buf[o:o + n])))
+    return out
 
 
 def peek_kind(frame: BytesLike) -> int:

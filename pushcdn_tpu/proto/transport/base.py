@@ -33,6 +33,7 @@ from pushcdn_tpu.proto.error import Error, ErrorKind, bail
 from pushcdn_tpu.proto.limiter import Bytes, Limiter, NO_LIMIT
 from pushcdn_tpu.proto.message import (
     Message,
+    decode_frames,
     deserialize,
     deserialize_owned,
     materialize,
@@ -49,6 +50,90 @@ CONNECT_TIMEOUT_S = 5.0
 _LEN = struct.Struct(">I")
 
 _CLOSE = object()  # sentinel queued to ask the writer task to soft-close
+
+
+class FrameChunk:
+    """A run of complete frames parsed from ONE read chunk, sharing one
+    detached buffer and one pool permit — the receive-side twin of the
+    egress engine's per-user streams. The reader enqueues one of these per
+    parse batch instead of per-frame :class:`Bytes`, so a 250-frame chunk
+    costs one buffer copy and one queue put, not 250 of each.
+
+    Consumption modes:
+    - :meth:`take` materializes the next frame as a permit-sharing
+      :class:`Bytes` (compat path for ``recv_raw``/``recv_raw_many``);
+    - :meth:`views` hands out zero-copy memoryviews of every remaining
+      frame for whole-chunk consumers (``Client.receive_messages``), who
+      call :meth:`release` when done.
+
+    Pool accounting is deliberately chunk-granular: ONE permit covers the
+    whole batch, and a consumer retaining any single taken frame pins it
+    until that frame is released too. The coarser unit trades worst-case
+    precision (bounded by one read chunk per long-held frame) for not
+    paying a permit per frame; under pool pressure the reader falls back
+    to exact per-frame permits (see ``_reader_loop``).
+    """
+
+    __slots__ = ("buf", "offs", "lens", "_pos", "_master")
+
+    def __init__(self, buf: bytes, offs, lens, permit):
+        self.buf = buf
+        self.offs = offs
+        self.lens = lens
+        self._pos = 0
+        self._master = Bytes(buf, permit)
+
+    @property
+    def remaining(self) -> int:
+        return len(self.offs) - self._pos
+
+    def take(self) -> Bytes:
+        """Materialize the next frame (shares the chunk's permit via the
+        Bytes refcount: the permit frees when the chunk AND every taken
+        frame are released)."""
+        i = self._pos
+        self._pos = i + 1
+        o = self.offs[i]
+        b = self._master.clone()
+        b.data = self.buf[o:o + self.lens[i]]
+        if self._pos == len(self.offs):
+            self._master.release()  # fully handed out
+        return b
+
+    def views(self):
+        """Zero-copy memoryviews of every remaining frame; the caller owns
+        consumption and MUST call :meth:`release` afterwards."""
+        mv = memoryview(self.buf)
+        return [mv[self.offs[i]:self.offs[i] + self.lens[i]]
+                for i in range(self._pos, len(self.offs))]
+
+    def decode_remaining(self) -> list:
+        """Decode every remaining frame into Message objects (the batch
+        decoder runs straight over the shared buffer) and release the
+        chunk. The fan-out consumer's one-call drain."""
+        try:
+            return decode_frames(self.buf, self.offs, self.lens, self._pos)
+        finally:
+            self.release()
+
+    def release(self) -> None:
+        """Drop the untaken remainder (idempotent)."""
+        if self._pos < len(self.offs):
+            self._pos = len(self.offs)
+            self._master.release()
+
+
+class PreEncoded:
+    """An already-length-delimited byte stream: the writer sends it
+    verbatim, adding no framing. This is the device-plane egress handoff —
+    the native engine (native.egress_encode) encodes a whole step's worth
+    of frames for one user into one buffer, and the connection flushes it
+    with one write instead of re-framing per message."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data):
+        self.data = data  # bytes / memoryview over the step's egress buffer
 
 
 def _py_scan_frames(buf, max_frame_len: int):
@@ -172,12 +257,24 @@ class Connection:
     # frames above the limit are written directly, no extra copy.
     _BATCH_COALESCE_LIMIT = 64 * 1024
 
-    async def _flush(self, buf: bytearray) -> None:
+    async def _flush(self, buf) -> None:
         """One bounded write under its own timeout; BYTES_SENT counts only
         bytes that actually flushed."""
         async with asyncio.timeout(WRITE_TIMEOUT_S):
             await self._stream.write(buf)
         metrics_mod.BYTES_SENT.inc(len(buf))
+
+    async def _flush_chunked(self, data) -> None:
+        """Flush an already-framed stream (PreEncoded) in bounded chunks so
+        slow links get one timeout window per chunk, not one for the lot."""
+        n = len(data)
+        chunk = 4 * self._BATCH_COALESCE_LIMIT
+        if n <= chunk:
+            await self._flush(data)
+            return
+        view = memoryview(data)
+        for off in range(0, n, chunk):
+            await self._flush(view[off:off + chunk])
 
     async def _writer_loop(self) -> None:
         # the native batch encoder length-delimits a run of small frames in
@@ -199,6 +296,11 @@ class Connection:
                 # echo pays per message.
                 if self._send_q.empty():
                     payload, done = item
+                    if type(payload) is PreEncoded:
+                        await self._flush_chunked(payload.data)
+                        if done is not None and not done.done():
+                            done.set_result(None)
+                        continue
                     if type(payload) is not list:
                         data = payload.data if isinstance(payload, Bytes) \
                             else payload
@@ -253,6 +355,13 @@ class Connection:
                     i, nf = 0, len(frames)
                     while i < nf:
                         data = frames[i]
+                        if type(data) is PreEncoded:
+                            if buf:
+                                await self._flush(buf)
+                                buf = bytearray()
+                            await self._flush_chunked(data.data)
+                            i += 1
+                            continue
                         n = len(data)
                         if encoder is not None and type(data) is bytes \
                                 and n <= self._BATCH_COALESCE_LIMIT:
@@ -352,7 +461,7 @@ class Connection:
         try:
             await self._recv_q.put(item)
         except BaseException:
-            if type(item) is Bytes:
+            if type(item) is Bytes or type(item) is FrameChunk:
                 item.release()
             else:
                 for b in item:
@@ -373,6 +482,38 @@ class Connection:
                         chunk = await self._stream.read_some(self._READ_CHUNK)
                 else:
                     chunk = await self._stream.read_some(self._READ_CHUNK)
+
+                # Whole-chunk zero-copy fast path: when the carry buffer is
+                # empty and the read chunk ends exactly on a frame boundary
+                # (the steady state against a batching writer — one egress
+                # flush arrives as one chunk), the chunk object ITSELF
+                # becomes the FrameChunk buffer: no carry append, no detach
+                # copy. Frames' bytes are then copied exactly once end to
+                # end (at decode), like the reference's Bytes-slicing reader.
+                if not buf and len(chunk) >= 8 and type(chunk) is bytes:
+                    (first_len,) = _LEN.unpack_from(chunk, 0)
+                    if first_len <= MAX_MESSAGE_SIZE \
+                            and len(chunk) >= 4 + first_len:
+                        if scanner is not None and len(chunk) >= 4096:
+                            offs, lens, consumed, oversized = scanner.scan(
+                                chunk, MAX_MESSAGE_SIZE)
+                        else:
+                            offs, lens, consumed, oversized = _py_scan_frames(
+                                chunk, MAX_MESSAGE_SIZE)
+                        if consumed == len(chunk) and not oversized and not (
+                                scanner is not None
+                                and len(offs) == scanner.max_frames):
+                            chunk_permit = None
+                            if pool is not None \
+                                    and consumed <= pool.capacity:
+                                chunk_permit = pool.try_allocate(consumed)
+                            if pool is None or chunk_permit is not None:
+                                metrics_mod.BYTES_RECV.inc(consumed)
+                                await self._put_recv(FrameChunk(
+                                    chunk, offs, lens, chunk_permit))
+                                continue
+                            # pool pressure: the carry path's partial-
+                            # handoff machinery below handles it
                 buf += chunk
 
                 # Depth-1 fast path (the latency regime): the chunk completed
@@ -419,46 +560,52 @@ class Connection:
                             buf, MAX_MESSAGE_SIZE)
                     # The peek guarantees at least one complete frame, so the
                     # scan always yields offsets.
-                    batch: List[Bytes] = []
-                    try:
-                        mv = memoryview(buf)
+                    chunk_permit = None
+                    if pool is not None and consumed <= pool.capacity:
+                        chunk_permit = pool.try_allocate(consumed)
+                    if pool is None or chunk_permit is not None:
+                        # Fast path: ONE detached buffer + ONE permit for
+                        # the whole parse batch (per-frame Bytes/permits are
+                        # what bounded small-frame receive throughput).
+                        chunk = FrameChunk(bytes(memoryview(buf)[:consumed]),
+                                           offs, lens, chunk_permit)
+                        metrics_mod.BYTES_RECV.inc(consumed)
+                        del buf[:consumed]
+                        await self._put_recv(chunk)
+                    else:
+                        # Pool pressure: fall back to per-frame permits with
+                        # partial handoff — consumers releasing the frames
+                        # we already queued are what refill the pool, and a
+                        # blocked permit still stops further socket reads.
+                        batch: List[Bytes] = []
                         try:
-                            for o, ln in zip(offs, lens):
-                                # one copy detaches the payload from the
-                                # carry buffer
-                                payload = bytes(mv[o:o + ln])
-                                permit = None
-                                if pool is not None:
-                                    # sync fast path; when the pool is
-                                    # exhausted, hand over what we have
-                                    # FIRST (consumers releasing those
-                                    # frames are what refill the pool),
-                                    # then block — backpressure still
-                                    # stops the socket: no further
-                                    # read_some until we get through
+                            mv = memoryview(buf)
+                            try:
+                                for o, ln in zip(offs, lens):
+                                    payload = bytes(mv[o:o + ln])
                                     permit = pool.try_allocate(ln)
                                     if permit is None:
                                         if batch:
-                                            # hand ownership over BEFORE the
-                                            # await: a cancelled _put_recv
-                                            # releases the frames itself, and
-                                            # the outer handler must not see
-                                            # them again (double-release)
+                                            # hand ownership over BEFORE
+                                            # the await: a cancelled
+                                            # _put_recv releases the frames
+                                            # itself, and the outer handler
+                                            # must not see them again
                                             handoff, batch = batch, []
                                             await self._put_recv(handoff)
                                         permit = await pool.allocate(ln)
-                                batch.append(Bytes(payload, permit))
-                        finally:
-                            mv.release()
-                    except BaseException:
-                        for b in batch:
-                            b.release()
-                        raise
-                    metrics_mod.BYTES_RECV.inc(consumed)
-                    if batch:
-                        await self._put_recv(
-                            batch[0] if len(batch) == 1 else batch)
-                    del buf[:consumed]
+                                    batch.append(Bytes(payload, permit))
+                            finally:
+                                mv.release()
+                        except BaseException:
+                            for b in batch:
+                                b.release()
+                            raise
+                        metrics_mod.BYTES_RECV.inc(consumed)
+                        if batch:
+                            await self._put_recv(
+                                batch[0] if len(batch) == 1 else batch)
+                        del buf[:consumed]
                     if oversized:
                         # a LATER announced length beyond MAX_MESSAGE_SIZE ⇒
                         # peer violation (preceding good frames were
@@ -550,7 +697,7 @@ class Connection:
                     done.cancel()
         while self._recv_pending:
             item = self._recv_pending.popleft()
-            if isinstance(item, Bytes):
+            if isinstance(item, (Bytes, FrameChunk)):
                 item.release()
         while True:
             try:
@@ -560,7 +707,7 @@ class Connection:
             if isinstance(item, list):
                 for p in item:
                     p.release()
-            elif isinstance(item, Bytes):
+            elif isinstance(item, (Bytes, FrameChunk)):
                 item.release()
 
     def _check(self) -> None:
@@ -634,6 +781,18 @@ class Connection:
         if done is not None:
             await done
 
+    def send_encoded_nowait(self, data) -> None:
+        """Queue an ALREADY length-delimited byte stream (one or many
+        frames, each u32-BE-prefixed) to be written verbatim — the
+        device-plane egress path: the native engine frames a whole step's
+        deliveries per user in C, so the writer's only job is the flush.
+        ``data`` may be a memoryview over the step's shared egress buffer
+        (kept alive by this reference until written)."""
+        self._check()
+        self._send_q.put_nowait((PreEncoded(data), None))
+        if self._error is not None:
+            raise self._error
+
     def send_raw_many_nowait(self, raws: list) -> None:
         """Batch variant of :meth:`send_raw_nowait` (one entry, no await),
         with :meth:`send_raw_many`'s ownership rule: the frames are always
@@ -655,8 +814,7 @@ class Connection:
         receive buffer so the pool permit can be released immediately. Hot
         paths that fan raw frames out should use :meth:`recv_raw` and
         release after the last send instead."""
-        pending = self._recv_pending
-        raw = pending.popleft() if pending else await self.recv_raw()
+        raw = await self.recv_raw()
         try:
             return deserialize_owned(raw.data)
         finally:
@@ -671,6 +829,9 @@ class Connection:
             item = await self._recv_q.get()
             if type(item) is Bytes:  # depth-1 fast path: bare frame
                 return item
+            if type(item) is FrameChunk:
+                pending.append(item)
+                break
             if isinstance(item, Error):
                 # keep the poison visible to subsequent callers
                 try:
@@ -679,20 +840,23 @@ class Connection:
                     pass
                 raise item
             pending.extend(item)
+        head = pending[0]
+        if type(head) is FrameChunk:
+            b = head.take()
+            if head.remaining == 0:
+                pending.popleft()
+            return b
         return pending.popleft()
 
-    async def recv_raw_many(self, limit: int = 4096) -> List[Bytes]:
-        """Receive every frame currently available (at least one; blocks
-        only when none are pending). The routing loops drain with this so
-        one task wakeup routes a whole parse batch."""
+    async def _fill_pending(self, limit: int) -> None:
+        """Block until at least one frame is pending, then opportunistically
+        drain whatever else is already queued (up to ~``limit`` frames)."""
         pending = self._recv_pending
         while not pending:
             if self._error is not None and self._recv_q.empty():
                 raise self._error
             item = await self._recv_q.get()
-            if type(item) is Bytes:  # depth-1 fast path: bare frame
-                if self._recv_q.empty():
-                    return [item]
+            if type(item) is Bytes or type(item) is FrameChunk:
                 pending.append(item)
                 break
             if isinstance(item, Error):
@@ -702,14 +866,20 @@ class Connection:
                     pass
                 raise item
             pending.extend(item)
-        # opportunistically drain whatever else is already queued
-        while len(pending) < limit:
+        count = sum(i.remaining if type(i) is FrameChunk else 1
+                    for i in pending)
+        while count < limit:
             try:
                 item = self._recv_q.get_nowait()
             except asyncio.QueueEmpty:
                 break
             if type(item) is Bytes:
                 pending.append(item)
+                count += 1
+                continue
+            if type(item) is FrameChunk:
+                pending.append(item)
+                count += item.remaining
                 continue
             if isinstance(item, Error):
                 # deliver the batch first; the error surfaces on the next call
@@ -719,11 +889,40 @@ class Connection:
                     pass
                 break
             pending.extend(item)
-        if len(pending) <= limit:
-            out = list(pending)
-            pending.clear()
-        else:
-            out = [pending.popleft() for _ in range(limit)]
+            count += len(item)
+
+    async def recv_raw_many(self, limit: int = 4096) -> List[Bytes]:
+        """Receive every frame currently available (at least one; blocks
+        only when none are pending). The routing loops drain with this so
+        one task wakeup routes a whole parse batch."""
+        await self._fill_pending(limit)
+        pending = self._recv_pending
+        out: List[Bytes] = []
+        while pending and len(out) < limit:
+            head = pending[0]
+            if type(head) is FrameChunk:
+                while head.remaining and len(out) < limit:
+                    out.append(head.take())
+                if head.remaining == 0:
+                    pending.popleft()
+            else:
+                out.append(pending.popleft())
+        return out
+
+    async def recv_frames(self, limit: int = 4096) -> list:
+        """Receive pending traffic as a list of :class:`Bytes` and
+        :class:`FrameChunk` items — the zero-materialization drain for
+        consumers that process whole batches (``Client.receive_messages``).
+        ``limit`` is approximate: the last chunk is handed over whole.
+        The caller owns every item: ``release()`` each when done."""
+        await self._fill_pending(limit)
+        pending = self._recv_pending
+        out: list = []
+        count = 0
+        while pending and count < limit:
+            head = pending.popleft()
+            count += head.remaining if type(head) is FrameChunk else 1
+            out.append(head)
         return out
 
     async def soft_close(self) -> None:

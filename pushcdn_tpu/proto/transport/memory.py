@@ -10,6 +10,7 @@ This is the seam that lets whole-system integration tests (marshal + brokers
 from __future__ import annotations
 
 import asyncio
+from collections import deque
 from typing import Dict, Optional, Tuple
 
 from pushcdn_tpu.proto.error import Error, ErrorKind, bail
@@ -32,28 +33,40 @@ _duplex_window = _DUPLEX_BUFFER
 
 
 class _BoundedBuffer:
-    """A bounded in-process byte buffer with real backpressure: writers
-    block while ``len >= capacity`` (parity with the reference's 8192-byte
-    duplex halves — a fast producer cannot grow memory unboundedly)."""
+    """A bounded in-process byte pipe with real backpressure: writers
+    block while ``size >= capacity`` (parity with the reference's 8192-byte
+    duplex halves — a fast producer cannot grow memory unboundedly).
+
+    Storage is a deque of immutable byte chunks, not a flat bytearray:
+    a write appends (at most one copy, from the caller's possibly-reused
+    buffer), and ``read_some`` pops a whole chunk with ZERO copies — the
+    reader's whole-chunk scan path then parses frames out of that very
+    object, so a frame's bytes are copied once end-to-end through the
+    in-process transport instead of four times."""
 
     def __init__(self, capacity: Optional[int] = None):
         self.capacity = capacity if capacity is not None else _duplex_window
-        self._buf = bytearray()
+        self._chunks: "deque" = deque()
+        self._size = 0
         self._eof = False
         self._cond = asyncio.Condition()
 
-    async def write(self, data: bytes) -> None:
+    async def write(self, data) -> None:
         async with self._cond:
             # Chunk so a frame larger than the capacity still flows.
             view = memoryview(data)
-            while len(view):
-                while len(self._buf) >= self.capacity and not self._eof:
+            n = len(view)
+            off = 0
+            while off < n:
+                while self._size >= self.capacity and not self._eof:
                     await self._cond.wait()
                 if self._eof:
                     raise ConnectionResetError("memory stream closed")
-                room = max(self.capacity - len(self._buf), 1)
-                self._buf += view[:room]
-                view = view[room:]
+                room = max(self.capacity - self._size, 1)
+                piece = bytes(view[off:off + room])  # detach: caller's
+                off += len(piece)                    # buffer may be reused
+                self._chunks.append(piece)
+                self._size += len(piece)
                 self._cond.notify_all()
 
     async def read_exactly(self, n: int) -> bytes:
@@ -62,33 +75,40 @@ class _BoundedBuffer:
         out = bytearray()
         async with self._cond:
             while len(out) < n:
-                if not self._buf:
+                if not self._chunks:
                     if self._eof:
                         raise asyncio.IncompleteReadError(bytes(out), n)
                     await self._cond.wait()
                     continue
-                take = min(n - len(out), len(self._buf))
-                out += self._buf[:take]
-                del self._buf[:take]
+                head = self._chunks[0]
+                take = n - len(out)
+                if len(head) <= take:
+                    self._chunks.popleft()
+                    out += head
+                else:
+                    out += head[:take]
+                    self._chunks[0] = head[take:]
+                self._size -= min(take, len(head))
                 self._cond.notify_all()
             return bytes(out)
 
     async def read_some(self, max_n: int) -> bytes:
         async with self._cond:
-            while not self._buf:
+            while not self._chunks:
                 if self._eof:
                     raise asyncio.IncompleteReadError(b"", 1)
                 await self._cond.wait()
-            blen = len(self._buf)
-            if max_n >= blen:
-                # whole-buffer take: one copy, no O(n) del-compaction
-                out = bytes(self._buf)
-                self._buf.clear()
+            head = self._chunks[0]
+            if len(head) <= max_n:
+                # whole-chunk take: zero copies
+                self._chunks.popleft()
+                self._size -= len(head)
             else:
-                out = bytes(self._buf[:max_n])
-                del self._buf[:max_n]
+                self._chunks[0] = head[max_n:]
+                head = head[:max_n]
+                self._size -= max_n
             self._cond.notify_all()
-            return out
+            return head
 
     def set_eof(self) -> None:
         self._eof = True
@@ -119,7 +139,7 @@ class _PipeStream(RawStream):
     async def write(self, data) -> None:
         if self._closed:
             raise ConnectionResetError("memory stream closed")
-        await self._tx.write(bytes(data))
+        await self._tx.write(data)  # the buffer detaches per chunk itself
 
     async def close(self) -> None:
         self.abort()

@@ -36,7 +36,8 @@ class MeshCluster:
     def __init__(self, num_shards: int = 4, extra_lanes: tuple = (),
                  ring_slots: int = 32, frame_bytes: int = 1024,
                  num_user_slots: int = 64, batch_window_s: float = 0.002,
-                 devices=None, prefix: str = "mg"):
+                 devices=None, prefix: str = "mg",
+                 gather_frame_bytes: bool = False):
         self.uid = next(_UID)
         self.num_shards = num_shards
         self.extra_lanes = extra_lanes
@@ -44,6 +45,7 @@ class MeshCluster:
         self.frame_bytes = frame_bytes
         self.num_user_slots = num_user_slots
         self.batch_window_s = batch_window_s
+        self.gather_frame_bytes = gather_frame_bytes
         self.devices = devices
         self.prefix = f"{prefix}{self.uid}"
         self.db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-mesh-"),
@@ -63,7 +65,8 @@ class MeshCluster:
         self.group = MeshBrokerGroup(mesh, MeshGroupConfig(
             num_user_slots=self.num_user_slots, ring_slots=self.ring_slots,
             frame_bytes=self.frame_bytes, extra_lanes=self.extra_lanes,
-            batch_window_s=self.batch_window_s))
+            batch_window_s=self.batch_window_s,
+            gather_frame_bytes=self.gather_frame_bytes))
         for i in range(self.num_shards):
             ident = self._ident(i)
             b = await Broker.new(BrokerConfig(

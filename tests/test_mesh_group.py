@@ -63,6 +63,34 @@ async def test_cross_shard_direct_over_mesh_only():
         await cluster.stop()
 
 
+async def test_cross_shard_traffic_with_gathered_bytes():
+    """The multi-host configuration (gather_frame_bytes=True): frame bytes
+    ride the step's collectives and egress decodes from the DEVICE-gathered
+    tensors. The all_to_all direct output differs per shard — regression
+    for pairing shard j's delivery mask with shard 0's received bytes."""
+    cluster = await MeshCluster(
+        num_shards=4, gather_frame_bytes=True).start(form_host_mesh=False)
+    try:
+        alice = await cluster.place_client(seed=210, shard=0, topics=[0])
+        bob = await cluster.place_client(seed=211, shard=3, topics=[0])
+        carol = await cluster.place_client(seed=212, shard=1, topics=[0])
+
+        await alice.send_direct_message(bob.public_key, b"gathered 0 -> 3")
+        got = await asyncio.wait_for(bob.receive_message(), 10)
+        assert isinstance(got, Direct)
+        assert bytes(got.message) == b"gathered 0 -> 3"
+
+        await carol.send_broadcast_message([0], b"gathered bcast")
+        for c in (alice, bob, carol):
+            got = await asyncio.wait_for(c.receive_message(), 10)
+            assert isinstance(got, Broadcast)
+            assert bytes(got.message) == b"gathered bcast"
+        for c in (alice, bob, carol):
+            c.close()
+    finally:
+        await cluster.stop()
+
+
 async def test_in_group_double_connect_kick():
     """The same identity connecting at a second shard kicks the first
     session immediately (authoritative in-group claim)."""

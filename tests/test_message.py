@@ -50,7 +50,9 @@ def test_round_trip(msg):
     assert peek_kind(frame) == msg.kind
     out = deserialize(frame)
     assert type(out) is type(msg)
-    for f in msg.__dataclass_fields__:
+    fields = (msg.__dataclass_fields__ if hasattr(msg, "__dataclass_fields__")
+              else msg.__slots__)
+    for f in fields:
         a, b = getattr(msg, f), getattr(out, f)
         if isinstance(a, (bytes, memoryview)) or isinstance(b, (bytes, memoryview)):
             assert bytes(a) == bytes(b), f

@@ -89,3 +89,75 @@ def test_scan_flags_oversized_frame():
     frames, consumed, error = native.scan_frames(stream, max_frame_len=1000)
     assert error
     assert frames == []
+
+
+# ---------------------------------------------------------------------------
+# egress engine (pushcdn_egress_count / _fill via native.egress_encode)
+# ---------------------------------------------------------------------------
+
+def _egress_reference(deliver, lengths, blocks):
+    """Per-user wire streams, the obvious way: concat u32-BE len ‖ payload
+    for every delivered frame in frame order."""
+    import numpy as np
+    U, N = deliver.shape
+    rows = blocks[0].shape[0]
+    out = {}
+    for u in range(U):
+        stream = bytearray()
+        count = 0
+        for n in range(N):
+            if deliver[u, n]:
+                ln = int(lengths[n])
+                payload = bytes(blocks[n // rows][n % rows, :ln])
+                stream += struct.pack(">I", ln) + payload
+                count += 1
+        if count:
+            out[u] = (bytes(stream), count)
+    return out
+
+
+def test_egress_encode_matches_reference():
+    import numpy as np
+    rng = np.random.default_rng(7)
+    U, B, S, F = 16, 4, 9, 64  # S*B = 36: exercises the non-multiple-of-8 tail
+    blocks = [rng.integers(0, 256, (S, F), dtype=np.uint8) for _ in range(B)]
+    N = B * S
+    lengths = rng.integers(0, F + 1, N).astype(np.int32)
+    deliver = rng.random((U, N)) < 0.3
+    deliver[:, lengths == 0] = False  # empty slots never deliver
+    streams = native.egress_encode(deliver, lengths, blocks)
+    if streams is None:
+        pytest.skip("native library unavailable")
+    ref = _egress_reference(deliver, lengths, blocks)
+    assert sorted(streams.users) == sorted(ref)
+    for u in streams.users:
+        assert bytes(streams.stream(u)) == ref[u][0]
+        assert int(streams.msgs[u]) == ref[u][1]
+    assert streams.total_msgs == sum(c for _, c in ref.values())
+
+
+def test_egress_encode_empty_matrix():
+    import numpy as np
+    deliver = np.zeros((8, 16), bool)
+    lengths = np.zeros(16, np.int32)
+    blocks = [np.zeros((8, 32), np.uint8), np.zeros((8, 32), np.uint8)]
+    streams = native.egress_encode(deliver, lengths, blocks)
+    if streams is None:
+        pytest.skip("native library unavailable")
+    assert streams.users == []
+    assert streams.total_msgs == 0
+
+
+def test_egress_encode_dense_single_user():
+    import numpy as np
+    F = 16
+    block = np.arange(3 * F, dtype=np.uint8).reshape(3, F)
+    lengths = np.array([F, 5, 0], np.int32)
+    deliver = np.array([[True, True, False], [False, False, False]])
+    streams = native.egress_encode(deliver, lengths, [block])
+    if streams is None:
+        pytest.skip("native library unavailable")
+    assert streams.users == [0]
+    expect = (struct.pack(">I", F) + bytes(block[0]) +
+              struct.pack(">I", 5) + bytes(block[1, :5]))
+    assert bytes(streams.stream(0)) == expect
