@@ -93,18 +93,30 @@ def main() -> None:
     # throughput. best-of-N repeats because tunnel dispatch is noisy.
     @jax.jit
     def consume(acc, deliver):
-        # full on-device reduction: the whole matrix is in acc's
+        # decision-rate forcing: the whole matrix is in acc's
         # dependency cone, so no backend can elide any of it
         return acc + deliver.sum(dtype=jnp.int32)
 
+    @jax.jit
+    def consume_bytes(acc, deliver, frame_bytes):
+        # BYTE-TRUE forcing: every delivered frame's payload bytes enter
+        # the cone via a masked byte-reduction — the backend must read
+        # all S*F frame bytes from HBM, not just the routing metadata
+        delivered = deliver.any(axis=0)                     # [S]
+        masked = jnp.where(delivered[:, None], frame_bytes, 0)
+        return acc + masked.sum(dtype=jnp.int32)
+
     steps, repeats = 50, 3
-    best_dt = float("inf")
     acc = jnp.zeros((), jnp.int32)
-    acc = consume(acc, result.deliver)  # compile consume before timing
-    jax.block_until_ready(acc)
+    acc = consume(acc, result.deliver)          # compile before timing
+    accb = consume_bytes(acc, result.deliver, batch.frame_bytes)
+    jax.block_until_ready(accb)
     if args.profile:  # start AFTER warm-up so the trace is steady-state
         jax.profiler.start_trace(args.profile)
         print(f"# tracing to {args.profile}", file=sys.stderr)
+
+    # pass 1: routing-decision rate (metadata only — the historical number)
+    best_decision = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
         for _ in range(steps):
@@ -112,17 +124,68 @@ def main() -> None:
             state = result.state
             acc = consume(acc, result.deliver)
         jax.block_until_ready(acc)
-        best_dt = min(best_dt, time.perf_counter() - t0)
+        best_decision = min(best_decision, time.perf_counter() - t0)
+
+    # pass 2: byte-true rate — same steps, with every delivered frame's
+    # bytes materialized into the accumulator's dependency cone
+    best_bytes = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            result = routing_step_single(state, batch)
+            state = result.state
+            acc = consume_bytes(acc, result.deliver, batch.frame_bytes)
+        jax.block_until_ready(acc)
+        best_bytes = min(best_bytes, time.perf_counter() - t0)
     if args.profile:
         jax.profiler.stop_trace()
 
-    msgs_per_sec = steps * S / best_dt
-    print(json.dumps({
+    # host egress engine rate (native/framing.cpp): encode a bounded-fan-
+    # out delivery matrix (16 receivers x 16K frames) into per-user wire
+    # streams — the socket side of the pump, measured off-device
+    egress_rate = None
+    try:
+        from pushcdn_tpu import native
+        S_e = 16384
+        rng = np.random.default_rng(1)
+        deliver_e = np.zeros((U, S_e), bool)
+        for f in range(S_e):
+            deliver_e[rng.integers(0, U, 16), f] = True
+        lengths_e = np.full(S_e, F, np.int32)
+        blocks_e = [np.asarray(batch.frame_bytes)[:S_e]]
+        streams = native.egress_encode(deliver_e, lengths_e, blocks_e)
+        if streams is not None:
+            t0 = time.perf_counter()
+            streams = native.egress_encode(deliver_e, lengths_e, blocks_e)
+            egress_rate = streams.total_msgs / (time.perf_counter() - t0)
+    except Exception:
+        pass
+
+    msgs_per_sec = steps * S / best_bytes           # headline: byte-true
+    decision_rate = steps * S / best_decision
+    byte_rate = steps * S * F / best_bytes          # delivered bytes read
+    kind = jax.devices()[0].device_kind
+    # known per-chip HBM bandwidths (GB/s); the implied-fraction row is
+    # informative only when the kind is recognized
+    hbm_spec = {"TPU v4": 1228, "TPU v5 lite": 819, "TPU v5e": 819,
+                "TPU v5p": 2765, "TPU v6 lite": 1638, "TPU v6e": 1638}
+    spec = next((v for k, v in hbm_spec.items() if k in kind), None)
+    row = {
         "metric": "broadcast msgs/sec/chip",
         "value": round(msgs_per_sec, 1),
         "unit": "msgs/s",
         "vs_baseline": round(msgs_per_sec / TARGET_MSGS_PER_SEC, 4),
-    }))
+        # byte-true companion numbers (same elision-proofing note: all in
+        # the on-device accumulator's dependency cone)
+        "decision_rate_msgs_s": round(decision_rate, 1),
+        "frame_byte_rate_GBps": round(byte_rate / 1e9, 2),
+        "device_kind": kind,
+    }
+    if spec:
+        row["hbm_frac_of_spec"] = round(byte_rate / (spec * 1e9), 4)
+    if egress_rate is not None:
+        row["host_egress_msgs_s"] = round(egress_rate, 1)
+    print(json.dumps(row))
 
 
 if __name__ == "__main__":
