@@ -16,6 +16,11 @@ Capability parity with cdn-proto/src/connection/protocols/quic.rs:37-277
 - loss recovery: cumulative ACKs + timer-driven retransmission of the
   earliest unacked segment, and a byte-denominated send window so a slow
   receiver backpressures the sender,
+- congestion control (the analog of quinn's CC stack, quic.rs:37-146):
+  NewReno — slow start / congestion avoidance over an RFC 6298 RTT
+  estimator, 3-dup-ACK fast retransmit + fast recovery with partial-ACK
+  retransmission, RTO collapse to 2 segments, and token-bucket pacing at
+  ~1.25x cwnd/srtt so a window never lands on the path as one burst,
 - path-MTU probing (the analog of QUIC DPLPMTUD, RFC 9000 §14.3): each
   direction probes with padded datagrams and adopts the largest size the
   peer acknowledges — on loopback/jumbo paths segments grow from 1200 B
@@ -119,7 +124,13 @@ PROBE_INTERVAL_S = 0.15
 # off a smaller link (expected; the probed MTU was validated by PROBEACK) —
 # outside it, it's the path shrinking under DATA and the MTU must clamp
 PROBE_GRACE_S = 1.0
-SEND_WINDOW = 512 * 1024         # unacked bytes before write blocks (floor)
+SEND_WINDOW_MAX = 2 * 1024 * 1024  # flow-control cap on unacked bytes
+                                 # (kept under SOCK_BUF so one window
+                                 # can never overflow the peer's kernel
+                                 # buffer outright)
+CWND_INITIAL_SEGS = 16           # initial congestion window (segments)
+MIN_RTO_S = 0.05                 # RTO floor (srtt + 4*rttvar clamped here)
+PACE_SRTT_FLOOR_S = 0.005        # below this RTT pacing is a no-op (loopback)
 ACK_DELAY_S = 0.02               # delayed-ACK timer (in-order data)
 ACK_EVERY_BYTES = 64 * 1024      # ...or after this many unacked rx bytes
 SOCK_BUF = 4 * 1024 * 1024       # kernel socket buffers (burst absorption)
@@ -157,6 +168,25 @@ class _UdpStream(RawStream):
         self._dup_acks = 0
         self._mtu = MTU_PAYLOAD                  # grows via path-MTU probing
         self._last_probe_sent = 0.0
+
+        # congestion control: NewReno cwnd over the byte stream (the
+        # reference inherits quinn's CC stack, quic.rs:37-146 — without
+        # one, a static window floods lossy paths and collapses). Slow
+        # start doubles per RTT until ssthresh; 3 dup-ACKs => halve +
+        # fast recovery (dup-ACK inflation, partial-ACK retransmit); RTO
+        # => back to 2 segments. RTO itself comes from an RFC 6298-style
+        # srtt/rttvar estimator (Karn's rule: never sample retransmitted
+        # segments), and writes are paced at ~1.25x cwnd/srtt so a whole
+        # window never lands on the path as one burst.
+        self._cwnd = float(CWND_INITIAL_SEGS * MTU_PAYLOAD)
+        self._ssthresh = float("inf")
+        self._in_recovery = False
+        self._recover = 0                        # NewReno recovery point
+        self._srtt: Optional[float] = None
+        self._rttvar = 0.0
+        self._pace_tokens = self._cwnd
+        self._pace_last = time.monotonic()
+        self._last_retx_t = 0.0   # RTT-sample epoch (Karn, strengthened)
 
         # receive side
         self._expected = 0
@@ -222,28 +252,79 @@ class _UdpStream(RawStream):
                     self._mtu = max(self._mtu, plen - _DATA_OVERHEAD)
         elif ptype == _ACK:
             ack = _OFF.unpack_from(body)[0]
+            now = time.monotonic()
             if ack > self._acked:
+                newly = ack - self._acked
                 self._acked = ack
                 self._dup_acks = 0
-                self._rto = RTO_INITIAL_S
+                rtt_sample = None
                 while self._send_order:
                     off = self._send_order[0]
                     seg = self._unacked.get(off)
                     if seg is None or off + len(seg[0]) > ack:
                         break
+                    # Karn, strengthened: never-retransmitted AND sent
+                    # after the last loss event — a segment that sat in
+                    # the queue behind a repair measures sojourn, not RTT
+                    if seg[2] == 0 and seg[1] > self._last_retx_t:
+                        rtt_sample = now - seg[1]
                     self._send_order.popleft()
                     self._unacked.pop(off, None)
+                if rtt_sample is not None:
+                    self._rtt_update(rtt_sample)
+                if self._in_recovery:
+                    if ack >= self._recover:
+                        # full recovery: deflate to ssthresh
+                        self._in_recovery = False
+                        self._cwnd = max(self._ssthresh, 2.0 * self._mtu)
+                    elif self._send_order:
+                        # partial ACK: the next hole is also lost —
+                        # retransmit it now (NewReno)
+                        off = self._send_order[0]
+                        seg = self._unacked.get(off)
+                        if seg is not None:
+                            seg[1] = now
+                            seg[2] += 1
+                            self._last_retx_t = now
+                            self._tx(_DATA, _OFF.pack(off) + seg[0])
+                elif self._cwnd < self._ssthresh:
+                    self._cwnd += newly                       # slow start
+                else:                                         # avoidance
+                    self._cwnd += self._mtu * newly / self._cwnd
+                if not self._in_recovery and self._send_order:
+                    # ACK-clocked repair: an RTO-stale front hole is
+                    # resent NOW instead of waiting for the next 50 ms
+                    # timer tick — this is what drains a multi-hole
+                    # window at ACK speed after a burst loss
+                    off = self._send_order[0]
+                    seg = self._unacked.get(off)
+                    if seg is not None and now - seg[1] >= self._rto:
+                        seg[1] = now
+                        seg[2] += 1
+                        self._last_retx_t = now
+                        self._tx(_DATA, _OFF.pack(off) + seg[0])
                 self._wake_window()
             elif ack == self._acked and self._send_order:
                 # duplicate ACK: the peer is holding out-of-order data past a
-                # hole — fast-retransmit the earliest unacked segment
+                # hole — fast-retransmit the earliest unacked segment and
+                # enter fast recovery (halve the window once per loss event)
                 self._dup_acks += 1
-                if self._dup_acks >= DUP_ACK_FAST_RETX:
+                if self._in_recovery:
+                    self._cwnd += self._mtu   # dup-ACK inflation
+                    self._wake_window()
+                elif self._dup_acks >= DUP_ACK_FAST_RETX:
                     self._dup_acks = 0
+                    self._in_recovery = True
+                    self._recover = self._next_off
+                    self._ssthresh = max(self._inflight() / 2.0,
+                                         2.0 * self._mtu)
+                    self._cwnd = self._ssthresh + 3.0 * self._mtu
                     off = self._send_order[0]
                     seg = self._unacked.get(off)
                     if seg is not None:
-                        seg[1] = time.monotonic()
+                        seg[1] = now
+                        seg[2] += 1
+                        self._last_retx_t = now
                         self._tx(_DATA, _OFF.pack(off) + seg[0])
         elif ptype == _FIN:
             self._peer_fin = _OFF.unpack_from(body)[0]
@@ -298,6 +379,49 @@ class _UdpStream(RawStream):
 
     def _inflight(self) -> int:
         return self._next_off - self._acked
+
+    # -- congestion control --------------------------------------------------
+
+    def _rtt_update(self, sample: float) -> None:
+        """RFC 6298 srtt/rttvar; RTO = srtt + 4*rttvar, clamped."""
+        if self._srtt is None:
+            self._srtt = sample
+            self._rttvar = sample / 2.0
+        else:
+            self._rttvar = 0.75 * self._rttvar + \
+                0.25 * abs(self._srtt - sample)
+            self._srtt = 0.875 * self._srtt + 0.125 * sample
+        self._rto = min(max(MIN_RTO_S, self._srtt + 4.0 * self._rttvar),
+                        RTO_MAX_S)
+
+    def _window(self) -> float:
+        """Effective send window: congestion-bound, flow-capped."""
+        return min(SEND_WINDOW_MAX, max(self._cwnd, 2.0 * self._mtu))
+
+    async def _pace(self, nbytes: int) -> None:
+        """Token-bucket pacing at ~1.25x cwnd/srtt (burst cap = one cwnd).
+        Below PACE_SRTT_FLOOR_S the path is loopback-fast and pacing would
+        only cost event-loop wakeups — skip it."""
+        srtt = self._srtt
+        if srtt is None or srtt <= PACE_SRTT_FLOOR_S:
+            return
+        rate = 1.25 * max(self._cwnd, 2.0 * self._mtu) / srtt
+        # burst cap must cover at least one segment: a probed-up MTU can
+        # exceed a freshly-started cwnd, and a cwnd-only cap would make
+        # the bucket unfillable (pace deadlock)
+        cap = max(self._cwnd, 2.0 * self._mtu, float(nbytes))
+        now = time.monotonic()
+        self._pace_tokens = min(
+            cap, self._pace_tokens + (now - self._pace_last) * rate)
+        self._pace_last = now
+        while self._pace_tokens < nbytes and self._error is None \
+                and not self._closed:
+            await asyncio.sleep(min(0.01, (nbytes - self._pace_tokens) / rate))
+            now = time.monotonic()
+            self._pace_tokens = min(
+                cap, self._pace_tokens + (now - self._pace_last) * rate)
+            self._pace_last = now
+        self._pace_tokens -= nbytes
 
     # -- path-MTU probing ----------------------------------------------------
 
@@ -379,9 +503,23 @@ class _UdpStream(RawStream):
                                 "retransmits"))
                             return
                         self._rto = min(self._rto * 2, RTO_MAX_S)
+                        # congestion response to a timeout: whole-window
+                        # loss — collapse to 2 segments, re-enter slow
+                        # start toward half the flight size
+                        self._ssthresh = max(self._inflight() / 2.0,
+                                             2.0 * self._mtu)
+                        self._cwnd = 2.0 * self._mtu
+                        self._in_recovery = False
+                        # resend at most one (new) cwnd worth from the
+                        # front — the burst cap a static count can't give
+                        budget = max(int(self._cwnd), 2 * MTU_PAYLOAD)
+                        self._last_retx_t = now
                         for o in islice(self._send_order, RTO_BURST):
                             s = self._unacked.get(o)
                             if s is not None:
+                                if budget <= 0:
+                                    break
+                                budget -= len(s[0])
                                 s[1] = now
                                 self._tx(_DATA, _OFF.pack(o) + s[0])
                 # FIN retransmission until FINACK
@@ -442,8 +580,15 @@ class _UdpStream(RawStream):
         view = memoryview(bytes(data) if isinstance(data, (bytearray, memoryview)) else data)
         i = 0
         n = len(view)
+        burst = 0
         while i < n:
-            while self._inflight() >= max(SEND_WINDOW, 32 * self._mtu):
+            if burst >= 128 * 1024:
+                # yield between bursts: lets a same-event-loop peer (and
+                # our own ACK processing) run; without it one write could
+                # emit a full window before any datagram is consumed
+                burst = 0
+                await asyncio.sleep(0)
+            while self._inflight() >= self._window():
                 if self._error is not None:
                     raise self._error
                 fut = asyncio.get_running_loop().create_future()
@@ -455,7 +600,11 @@ class _UdpStream(RawStream):
             # segment that bounces off the shrunken path forever
             mtu = self._mtu
             seg = bytes(view[i:i + mtu])
+            await self._pace(len(seg))
+            if self._error is not None:
+                raise self._error
             i += len(seg)
+            burst += len(seg)
             off = self._next_off
             self._next_off += len(seg)
             self._unacked[off] = [seg, time.monotonic(), 0]

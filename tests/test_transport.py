@@ -148,8 +148,11 @@ async def test_quic_msgsize_clamp_and_resegment():
     sent = []
     stream = _UdpStream(1, sent.append)
     try:
-        # pretend probing negotiated a jumbo path
+        # pretend probing negotiated a jumbo path and the window has grown
+        # (nothing ACKs in this fixture; without the bump the congestion
+        # window would block the 40 KB write)
         stream._mtu = 16000
+        stream._cwnd = 1e6
         await stream.write(b"x" * 40000)
         big_segs = dict(stream._unacked)
         assert any(len(s[0]) > MTU_PAYLOAD for s in big_segs.values())
@@ -212,6 +215,12 @@ async def test_quic_recovers_from_datagram_loss():
             while len(got) < len(payload):
                 got += await b.read_some(65536)
         assert bytes(got) == payload
+        # recovery must not leave the window collapsed: after the transfer
+        # completes through 20% loss, the congestion controller has both
+        # cut (ssthresh finite — losses were seen) and RAMPED back up
+        # (cwnd grew past its post-loss floor of 2 segments)
+        assert a._ssthresh != float("inf")
+        assert a._cwnd > 2.0 * a._mtu
         # and the reverse direction too
         await b.write(b"pong" * 1000)
         back = bytearray()
@@ -222,6 +231,90 @@ async def test_quic_recovers_from_datagram_loss():
     finally:
         a.abort()
         b.abort()
+
+
+async def test_quic_pacer_handles_segment_larger_than_cwnd():
+    """Pace-deadlock regression: after MTU probing settles (~64 KB
+    segments) a fresh connection's cwnd (16 x 1200 B) is SMALLER than one
+    segment; the pacing bucket must still be fillable or the first jumbo
+    write hangs forever."""
+    from pushcdn_tpu.proto.transport.quic import _OFF, _UdpStream
+
+    sent: list[bytes] = []
+    a = _UdpStream(9, sent.append)
+    try:
+        a._mtu = 65000          # probed-up path
+        a._srtt = 0.05          # pacing active (above the loopback floor)
+        a._rttvar = 0.0
+
+        async def acker():
+            seen = 0
+            while a._next_off < 4 * 65000:
+                if a._next_off > seen:
+                    seen = a._next_off
+                    a.on_packet(4, _OFF.pack(seen))   # ACK everything sent
+                await asyncio.sleep(0.005)
+            a.on_packet(4, _OFF.pack(a._next_off))
+
+        t = asyncio.create_task(acker())
+        async with asyncio.timeout(10):
+            await a.write(b"z" * (4 * 65000))
+        await t
+        assert a._acked == 4 * 65000
+    finally:
+        a.abort()
+
+
+async def test_quic_congestion_controller_state_machine():
+    """NewReno unit check against a hand-driven ACK sequence: slow-start
+    growth, 3-dup-ACK halving + fast retransmit, partial-ACK retransmit
+    during recovery, full-ACK deflation, and RTO collapse to 2 segments."""
+    from pushcdn_tpu.proto.transport.quic import (
+        _OFF, _UdpStream, MTU_PAYLOAD, CWND_INITIAL_SEGS)
+
+    sent: list[bytes] = []
+    s = _UdpStream(7, sent.append)
+    try:
+        mtu = s._mtu
+        assert s._cwnd == CWND_INITIAL_SEGS * MTU_PAYLOAD
+        await s.write(b"x" * (8 * mtu))       # 8 segments in flight
+        base = len(sent)
+        cw0 = s._cwnd
+
+        # slow start: ACK of 2 segments grows cwnd by the acked bytes
+        s.on_packet(4, _OFF.pack(2 * mtu))    # 4 == _ACK
+        assert s._cwnd == cw0 + 2 * mtu
+        assert s._srtt is not None            # RTT estimator seeded
+
+        # 3 duplicate ACKs: fast retransmit of the earliest hole + halve
+        for _ in range(3):
+            s.on_packet(4, _OFF.pack(2 * mtu))
+        assert s._in_recovery
+        assert s._ssthresh == max(s._inflight() / 2.0, 2.0 * mtu)
+        assert len(sent) == base + 1          # exactly one fast retransmit
+        retx_off = _OFF.unpack_from(sent[-1], 9)[0]
+        assert retx_off == 2 * mtu
+
+        # partial ACK (below the recovery point): retransmit next hole
+        s.on_packet(4, _OFF.pack(3 * mtu))
+        assert s._in_recovery
+        assert len(sent) == base + 2
+        assert _OFF.unpack_from(sent[-1], 9)[0] == 3 * mtu
+
+        # full ACK: exit recovery, deflate to ssthresh
+        s.on_packet(4, _OFF.pack(8 * mtu))
+        assert not s._in_recovery
+        assert s._cwnd == max(s._ssthresh, 2.0 * mtu)
+
+        # RTO expiry: collapse to 2 segments, ssthresh = half the flight
+        s._cwnd = 8.0 * mtu                   # room for the whole write
+        await s.write(b"y" * (4 * mtu))
+        s._rto = 0.0                          # force immediate expiry
+        await asyncio.sleep(0.1)              # timer loop fires
+        assert s._cwnd == 2.0 * mtu
+        assert s._ssthresh >= 2.0 * mtu
+    finally:
+        s.abort()
 
 
 async def test_quic_wire_carries_no_plaintext():
