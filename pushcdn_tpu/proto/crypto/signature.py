@@ -172,5 +172,21 @@ class BlsBn254Scheme(SignatureScheme):
         except (AssertionError, TypeError):
             return False
 
+    @classmethod
+    def verify_batch(cls, items) -> bool:
+        """Batch-verify ``[(public_key, namespace, message, signature),
+        ...]`` with one shared pairing final-exponentiation (random
+        linear combination — the connection-storm path). Semantics match
+        verifying each item individually: True iff ALL verify."""
+        import os as _os
+        from pushcdn_tpu.native import bls
+        try:
+            return bls.verify_batch(
+                [(bytes(pk), _namespaced(ns, msg), bytes(sig))
+                 for pk, ns, msg, sig in items],
+                _os.urandom(32))
+        except (AssertionError, TypeError, ValueError):
+            return False
+
 
 DEFAULT_SCHEME = Ed25519Scheme

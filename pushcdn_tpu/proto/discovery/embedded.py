@@ -56,6 +56,10 @@ class Embedded(DiscoveryClient):
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         self._db.execute("PRAGMA busy_timeout=5000")
+        # Permits/heartbeats are ephemeral (30-60 s TTLs): losing the tail
+        # of the WAL on power loss only forces reconnects, so skip the
+        # per-commit fsync — it was most of the auth handshake's floor
+        self._db.execute("PRAGMA synchronous=NORMAL")
         self._db.executescript(_SCHEMA)
         self._db.commit()
 
@@ -150,6 +154,11 @@ class Embedded(DiscoveryClient):
             "INSERT OR IGNORE INTO whitelist (public_key) VALUES (?)",
             [(bytes(u),) for u in users])
         self._db.commit()
+        # The whitelist is DURABLE access control (an empty table admits
+        # everyone) — force the WAL to disk so synchronous=NORMAL's
+        # skipped fsync (fine for ephemeral permits/heartbeats) can't
+        # fail-open the broker after a power loss.
+        self._db.execute("PRAGMA wal_checkpoint(TRUNCATE)")
 
     async def check_whitelist(self, user: bytes) -> bool:
         n = self._db.execute("SELECT COUNT(*) FROM whitelist").fetchone()[0]

@@ -279,7 +279,13 @@ class _UdpStream(RawStream):
                         self._cwnd = max(self._ssthresh, 2.0 * self._mtu)
                     elif self._send_order:
                         # partial ACK: the next hole is also lost —
-                        # retransmit it now (NewReno)
+                        # retransmit it now and DEFLATE by the data the
+                        # ACK took out of flight, plus one segment
+                        # (RFC 6582 §3.2: without this, every partial
+                        # ACK releases a fresh burst into the congested
+                        # path on top of the retransmit)
+                        self._cwnd = max(self._cwnd - newly + self._mtu,
+                                         2.0 * self._mtu)
                         off = self._send_order[0]
                         seg = self._unacked.get(off)
                         if seg is not None:

@@ -120,3 +120,42 @@ async def test_end_to_end_cluster_on_bls():
         client.close()
     finally:
         await cluster.stop()
+
+
+def test_batch_verify_all_valid():
+    ns = Namespace.USER_MARSHAL_AUTH
+    items = []
+    for i in range(5):
+        kp = BlsBn254Scheme.generate_keypair(seed=400 + i)
+        msg = b"storm auth %d" % i
+        sig = BlsBn254Scheme.sign(kp.private_key, ns, msg)
+        items.append((kp.public_key, ns, msg, sig))
+    assert BlsBn254Scheme.verify_batch(items)
+
+
+def test_batch_verify_rejects_one_forgery():
+    ns = Namespace.USER_MARSHAL_AUTH
+    items = []
+    for i in range(4):
+        kp = BlsBn254Scheme.generate_keypair(seed=500 + i)
+        msg = b"storm auth %d" % i
+        sig = BlsBn254Scheme.sign(kp.private_key, ns, msg)
+        items.append([kp.public_key, ns, msg, sig])
+    # swap two signatures: each is individually valid for the OTHER
+    # message, so only a real pairing check catches it
+    items[1][3], items[2][3] = items[2][3], items[1][3]
+    assert not BlsBn254Scheme.verify_batch(
+        [tuple(it) for it in items])
+
+
+def test_batch_verify_matches_single_semantics():
+    ns = Namespace.USER_MARSHAL_AUTH
+    kp = BlsBn254Scheme.generate_keypair(seed=600)
+    msg = b"solo"
+    sig = BlsBn254Scheme.sign(kp.private_key, ns, msg)
+    assert BlsBn254Scheme.verify_batch([(kp.public_key, ns, msg, sig)])
+    assert BlsBn254Scheme.verify_batch([])  # vacuous truth
+    bad = bytearray(sig)
+    bad[7] ^= 1
+    assert not BlsBn254Scheme.verify_batch(
+        [(kp.public_key, ns, msg, bytes(bad))])
