@@ -400,6 +400,19 @@ class MeshBrokerGroup:
                 b.host_links_kick.set()
         return StageResult.INELIGIBLE
 
+    def _direct_route_info(self, recipient: bytes):
+        """Resolve a direct recipient to (device slot, owner shard), or
+        None when the mesh can't carry it (unknown/absent recipient — the
+        host path's job). The multi-host group overrides this with the
+        statically partitioned slot space + the discovery directory."""
+        slot = self.slots.slot_of(recipient)
+        if slot is None:
+            return None
+        owner = int(self._owner[slot])
+        if owner == ABSENT:
+            return None
+        return slot, owner
+
     def try_stage(self, shard: int, message, raw: Bytes):
         from pushcdn_tpu.broker.staging import StageResult
         if self.disabled:
@@ -420,13 +433,11 @@ class MeshBrokerGroup:
                 [rings[shard] for rings in self.lane_rings], len(frame),
                 lambda r: r.push_broadcast(frame, mask))
         elif isinstance(message, Direct):
-            slot = self.slots.slot_of(bytes(message.recipient))
-            if slot is None:
+            info = self._direct_route_info(bytes(message.recipient))
+            if info is None:
                 # outside the group: legitimately the host path's job
                 return self._overflow()
-            owner = int(self._owner[slot])
-            if owner == ABSENT:
-                return self._overflow()
+            slot, owner = info
             # one-hop ICI path: bucket by owner shard for the all_to_all
             ok = stage_best_fit(
                 [bkts[shard] for bkts in self.lane_buckets], len(frame),
@@ -479,11 +490,11 @@ class MeshBrokerGroup:
                 results[idx] = (StageResult.STAGED if placed
                                 else StageResult.FULL)
             elif isinstance(message, Direct):
-                slot = self.slots.slot_of(bytes(message.recipient))
-                owner = ABSENT if slot is None else int(self._owner[slot])
-                if slot is None or owner == ABSENT:
+                info = self._direct_route_info(bytes(message.recipient))
+                if info is None:
                     self._overflow()
                     continue
+                slot, owner = info
                 ok = stage_best_fit(
                     [bkts[shard] for bkts in self.lane_buckets], len(frame),
                     lambda b: b.push(owner, frame, slot))

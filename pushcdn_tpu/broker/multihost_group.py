@@ -1,0 +1,448 @@
+"""MultiHostBrokerGroup — the mesh broker group assembled across OS
+processes: one SPMD deployment, zero host broker links.
+
+This is SURVEY.md §2e scaled past one machine (ref mesh formation
+cdn-broker/src/tasks/broker/heartbeat.rs:69-103, replaced wholesale):
+every host process joins the jax.distributed runtime, builds the SAME
+global broker mesh (parallel/multihost.py), attaches its brokers to its
+LOCAL shards, and executes the routing step COLLECTIVELY — the
+all_gather/all_to_all hops ride ICI inside a slice and DCN across hosts.
+Inter-broker bytes never touch a socket this code owns.
+
+Differences from the single-host :class:`MeshBrokerGroup`:
+
+- **Lockstep stepping.** Collectives must be entered by every process the
+  same number of times with the same shapes, so the pump runs at a fixed
+  cadence (``batch_window_s``) and EVERY tick steps, traffic or not; the
+  adaptive coalescing/latency-slicing/u_eff tricks are disabled (they key
+  the jit cache on local state, which diverges across hosts). A tiny
+  collective stop barrier runs before each step so every host leaves the
+  loop on the same iteration — no process can strand a peer inside a
+  collective.
+- **Statically partitioned slot space.** Shard ``i`` owns user slots
+  ``[i*K, (i+1)*K)`` (K = num_user_slots / num_shards): a slot's owner
+  shard is ``slot // K`` by construction, so no host ever needs another
+  host's allocator. Claims still carry versions and converge through the
+  in-step CRDT merge exactly as on one host — each host authors only its
+  own shards' state rows; the gather assembles the global view on device.
+- **Frame bytes ride the collectives** (``gather_frame_bytes=True``): a
+  remote shard's payload exists nowhere locally except via the step, and
+  egress is host-local — each host encodes and flushes only to clients of
+  its own shards, from its addressable output shards.
+- **pk -> slot rendezvous via discovery.** Directs need the recipient's
+  device slot; cross-host that mapping travels through the discovery
+  registry's user-slot directory (heartbeat-style TTL re-publication,
+  eventually consistent like the reference's 10 s UserSync gossip). A
+  cross-host double-connect resolves through the same directory: the
+  newer claim wins and the older host kicks its session on refresh.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from pushcdn_tpu.broker.mesh_group import (
+    MeshBrokerGroup,
+    MeshGroupConfig,
+)
+from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
+from pushcdn_tpu.parallel.frames import UserSlots, mask_row_of
+from pushcdn_tpu.parallel.multihost import local_shard_indices
+from pushcdn_tpu.parallel.router import BROKER_AXIS, RouterState
+from pushcdn_tpu.proto.error import Error
+
+logger = logging.getLogger("pushcdn.broker.multihost")
+
+
+class PartitionedUserSlots(UserSlots):
+    """Slot allocator over a static per-shard partition: ``assign`` is
+    replaced by :meth:`assign_in_shard`, and freed slots return to their
+    shard's own list (the inherited pump calls ``free_slot``)."""
+
+    def __init__(self, capacity: int, num_shards: int,
+                 local_shards: List[int]):
+        super().__init__(capacity)
+        self._free = []  # the global list is never used here
+        self.slots_per_shard = capacity // num_shards
+        K = self.slots_per_shard
+        self.shard_free: Dict[int, List[int]] = {
+            s: list(range((s + 1) * K - 1, s * K - 1, -1))
+            for s in local_shards}
+
+    def assign_in_shard(self, public_key: bytes, shard: int) -> int:
+        existing = self.slot_of(public_key)
+        if existing is not None:
+            return existing
+        free = self.shard_free.get(shard)
+        if not free:
+            from pushcdn_tpu.proto.error import ErrorKind, bail
+            bail(ErrorKind.EXCEEDED_SIZE,
+                 f"shard {shard} slot range full")
+        slot = free.pop()
+        self.assign_slot(public_key, slot)
+        return slot
+
+    def free_slot(self, slot: int) -> None:
+        if self.key_of(slot) is None:
+            shard = slot // self.slots_per_shard
+            free = self.shard_free.get(shard)
+            if free is not None and slot not in free:
+                free.append(slot)
+
+
+class MultiHostBrokerGroup(MeshBrokerGroup):
+    def __init__(self, mesh, config: MeshGroupConfig = None,
+                 discovery=None, directory_refresh_s: float = 0.5):
+        config = config or MeshGroupConfig()
+        config.gather_frame_bytes = True  # bytes must cross hosts on-device
+        super().__init__(mesh, config)
+        self.local_shards = local_shard_indices(mesh)
+        self.slots = PartitionedUserSlots(
+            config.num_user_slots, self.num_shards, self.local_shards)
+        self.slots_per_shard = self.slots.slots_per_shard
+        # remote shards are live unless the control plane says otherwise
+        self._liveness[:] = True
+        self._state_rev += 1
+        self.discovery = discovery
+        self.directory_refresh_s = directory_refresh_s
+        self._remote_slots: Dict[bytes, int] = {}   # directory mirror
+        self._local_claim_ts: Dict[bytes, float] = {}
+        self._dir_task: Optional[asyncio.Task] = None
+        self._stop_requested = False
+        self._stop_barrier = self._make_stop_barrier(mesh)
+
+    # ---- collective stop barrier ----------------------------------------
+
+    @staticmethod
+    def _make_stop_barrier(mesh):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        def per_shard(x):
+            return jax.lax.psum(x[0], BROKER_AXIS)[None]
+
+        sharded = jax.shard_map(
+            per_shard, mesh=mesh, in_specs=(P(BROKER_AXIS),),
+            out_specs=P(BROKER_AXIS), check_vma=False)
+        return jax.jit(sharded)
+
+    def _collective_stop(self, want_stop: bool) -> bool:
+        """One tiny collective per tick: every host contributes its stop
+        intent; all hosts see the same total and leave the loop on the
+        same iteration."""
+        import jax
+        rows = {i: np.array([1 if want_stop else 0], np.int32)
+                for i in self.local_shards}
+        flags = self._make_global_rows(rows, (1,))
+        out = self._stop_barrier(flags)
+        shard0 = out.addressable_shards[0]
+        return int(np.asarray(shard0.data)[0, 0]) > 0
+
+    # ---- global array assembly (local shards only) ------------------------
+
+    def _make_global_rows(self, rows: Dict[int, np.ndarray], row_shape):
+        """Assemble a [B, ...] global array from THIS host's per-shard
+        rows (jax.make_array_from_single_device_arrays: each process
+        contributes only its addressable devices' blocks)."""
+        import jax
+        devices = self.mesh.devices.reshape(-1)
+        shards = [jax.device_put(np.ascontiguousarray(rows[i])[None],
+                                 devices[i])
+                  for i in self.local_shards]
+        return jax.make_array_from_single_device_arrays(
+            (self.num_shards,) + tuple(row_shape), self._sharding, shards)
+
+    # ---- user lifecycle ---------------------------------------------------
+
+    def claim_user(self, shard: int, public_key: bytes, topics) -> None:
+        existing = self.slots.slot_of(public_key)
+        if existing is not None and \
+                existing // self.slots_per_shard != shard:
+            # same-host cross-shard reconnect: the slot//K owner-by-
+            # construction invariant requires a slot in the NEW shard's
+            # range — kick the old session (which releases its slot via
+            # the observer) and fall through to a fresh assignment
+            old_shard = existing // self.slots_per_shard
+            old_broker = self.brokers[old_shard]
+            if old_broker is not None and \
+                    old_broker.connections.has_user(public_key):
+                logger.info("user reconnected at another local shard "
+                            "(%d -> %d); kicking", old_shard, shard)
+                old_broker.connections.remove_user(
+                    public_key, reason="user connected elsewhere")
+            else:  # stale mapping with no live session
+                self.release_user(old_shard, public_key)
+        try:
+            slot = self.slots.assign_in_shard(public_key, shard)
+        except Error:
+            self._unmirrored[public_key] = shard
+            logger.warning("shard %d slot range full; %d unmirrored",
+                           shard, len(self._unmirrored))
+            return
+        self._owner[slot] = shard
+        self._claim_version[slot] += 1
+        self._masks[slot] = mask_row_of(topics, self.config.topic_words)
+        self._local_claim_ts[public_key] = time.time()
+        self._state_rev += 1
+
+    def release_user(self, shard: int, public_key: bytes) -> None:
+        # only the host that believes it OWNS the claim may delete the
+        # directory entry — after a cross-host double-connect kick the
+        # entry already belongs to the winning host (the kick path clears
+        # _local_claim_ts first), and deleting it would blackhole directs
+        # until that host's next refresh
+        owned = self._local_claim_ts.pop(public_key, None) is not None
+        super().release_user(shard, public_key)
+        if owned and self.discovery is not None:
+            asyncio.ensure_future(
+                self.discovery.drop_user_slots([public_key]))
+
+    # ---- direct routing over the static partition -------------------------
+
+    def _direct_route_info(self, recipient: bytes):
+        slot = self.slots.slot_of(recipient)
+        if slot is None:
+            slot = self._remote_slots.get(recipient)
+        if slot is None:
+            return None
+        return slot, slot // self.slots_per_shard
+
+    # ---- directory refresh (heartbeat-style) ------------------------------
+
+    async def _directory_loop(self) -> None:
+        ttl = max(4 * self.directory_refresh_s, 2.0)
+        while True:
+            try:
+                entries = {pk: (self.slots.slot_of(pk), ts)
+                           for pk, ts in self._local_claim_ts.items()
+                           if self.slots.slot_of(pk) is not None}
+                if entries:
+                    await self.discovery.publish_user_slots(entries, ttl)
+                all_slots = await self.discovery.get_user_slots()
+                remote = {}
+                for pk, (slot, ts) in all_slots.items():
+                    local_slot = self.slots.slot_of(pk)
+                    if local_slot is None:
+                        remote[pk] = slot
+                    elif slot != local_slot and \
+                            ts > self._local_claim_ts.get(pk, 0.0):
+                        # cross-host double connect: the newer claim wins
+                        # (the reference's CRDT kick, via the directory)
+                        shard = local_slot // self.slots_per_shard
+                        broker = self.brokers[shard]
+                        if broker is not None and \
+                                broker.connections.has_user(pk):
+                            logger.info(
+                                "user connected on another host; kicking")
+                            # the winner's directory entry must survive
+                            # our release (see release_user)
+                            self._local_claim_ts.pop(pk, None)
+                            broker.connections.remove_user(
+                                pk, reason="user connected elsewhere")
+                self._remote_slots = remote
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("user-slot directory refresh failed")
+            await asyncio.sleep(self.directory_refresh_s)
+
+    # ---- the lockstep pump ------------------------------------------------
+
+    async def ensure_started(self) -> None:
+        if not self._started:
+            self._started = True
+            await asyncio.to_thread(self._warmup)
+            self._task = asyncio.create_task(self._pump(),
+                                             name="multihost-pump")
+            if self.discovery is not None:
+                self._dir_task = asyncio.create_task(
+                    self._directory_loop(), name="multihost-directory")
+
+    def _warmup(self) -> None:
+        # the ONE specialization the lockstep pump uses (full shapes);
+        # every host compiles it collectively here, so the first traffic
+        # tick pays no compile rendezvous
+        batches = [[r.take_batch() for r in rings]
+                   for rings in self.lane_rings]
+        directs = [[b.take_batch() for b in bkts]
+                   for bkts in self.lane_buckets]
+        try:
+            self._run_step(batches, directs, self._owner.copy(),
+                           self._claim_version.copy(), self._masks.copy(),
+                           self._liveness.copy())
+            self.steps -= 1
+        except Exception:
+            logger.exception("multi-host warmup step failed")
+            self.disabled = True
+
+    async def on_shard_stopped(self, shard: int) -> None:
+        # release local users of the stopped shard (same sweep as the
+        # single-host group, restricted to its range)
+        dropped = []
+        for slot in np.nonzero(self._owner == shard)[0]:
+            key = self.slots.key_of(int(slot))
+            if key is not None:
+                self.slots.unmap(key)
+                if self._local_claim_ts.pop(key, None) is not None:
+                    dropped.append(key)
+            self._owner[slot] = ABSENT
+            self._claim_version[slot] += 1
+            self._masks[slot] = 0
+            self._quarantine.append(int(slot))
+        if dropped and self.discovery is not None:
+            asyncio.ensure_future(self.discovery.drop_user_slots(dropped))
+        self.brokers[shard] = None
+        self._member_idents = None
+        self._state_rev += 1
+        # The collective stops only when THIS HOST fully retires (a single
+        # broker of several restarting keeps the deployment running); a
+        # retiring host necessarily stops the whole collective — SPMD
+        # steps need every process.
+        if any(self.brokers[s] is not None for s in self.local_shards):
+            return
+        self._stop_requested = True
+        if self._dir_task is not None:
+            self._dir_task.cancel()
+            self._dir_task = None
+        if self._task is not None:
+            try:
+                await asyncio.wait_for(self._task, timeout=10)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._task.cancel()
+            except Exception:
+                logger.exception("multihost pump died during stop")
+            self._task = None
+            self._started = False
+
+    async def _pump(self) -> None:
+        c = self.config
+        while True:
+            await asyncio.sleep(c.batch_window_s)
+            stop = await asyncio.to_thread(
+                self._collective_stop, self._stop_requested)
+            if stop:
+                # a peer host retired: the collective is over everywhere.
+                # Mark disabled so try_stage stops ACKing frames into rings
+                # nothing will ever drain (they'd be silently blackholed).
+                self.disabled = True
+                logger.info("multi-host group stopping (collective)")
+                return
+            batches = [[r.take_batch() for r in rings]
+                       for rings in self.lane_rings]
+            directs = [[b.take_batch() for b in bkts]
+                       for bkts in self.lane_buckets]
+            owner = self._owner.copy()
+            versions = self._claim_version.copy()
+            masks = self._masks.copy()
+            liveness = self._liveness.copy()
+            quarantined, self._quarantine = self._quarantine, []
+            try:
+                from pushcdn_tpu.broker.tasks.senders import egress_streams
+                jobs = await asyncio.to_thread(
+                    self._run_step, batches, directs, owner, versions,
+                    masks, liveness)
+                for shard, streams, d2, lengths, frames in jobs:
+                    broker = self.brokers[shard]
+                    if broker is None:
+                        continue
+                    if streams is not None:
+                        self.messages_routed += egress_streams(
+                            broker, self.slots, streams)
+                    else:
+                        self._egress_py(broker, d2, lengths, frames)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("multi-host step failed; group disabled "
+                                 "(no host fallback plane exists)")
+                self.disabled = True
+                self._stop_requested = True
+                # one last barrier so the peer hosts exit cleanly
+                try:
+                    await asyncio.to_thread(self._collective_stop, True)
+                except Exception:
+                    pass
+                return
+            finally:
+                for slot in quarantined:
+                    self.slots.free_slot(slot)
+
+    # ---- the collective step ---------------------------------------------
+
+    def _run_step(self, batches, directs, owner, versions, masks,
+                  liveness=None, state_rev=None):
+        """One collective routing step: this host authors its local
+        shards' state/lane rows, the step's collectives assemble the
+        global view on device, and outputs are consumed from the
+        addressable shards only (host-local egress)."""
+        from pushcdn_tpu import native as native_mod
+        B = self.num_shards
+        live = (np.ones(B, bool) if liveness is None else liveness)
+
+        state = RouterState(
+            crdt=CrdtState(
+                self._make_global_rows(
+                    {i: owner for i in self.local_shards}, owner.shape),
+                self._make_global_rows(
+                    {i: versions for i in self.local_shards},
+                    versions.shape),
+                self._make_global_rows(
+                    {i: owner for i in self.local_shards}, owner.shape)),
+            topic_masks=self._make_global_rows(
+                {i: masks for i in self.local_shards}, masks.shape))
+        live_dev = self._make_global_rows(
+            {i: live for i in self.local_shards}, live.shape)
+
+        from pushcdn_tpu.parallel.router import DirectIngress, IngressBatch
+
+        def gput(lane, attr):
+            rows = {s: getattr(lane[s], attr) for s in self.local_shards}
+            shape = next(iter(rows.values())).shape
+            return self._make_global_rows(rows, shape)
+
+        lane_batches = tuple(
+            IngressBatch(gput(lane, "bytes_"), gput(lane, "kind"),
+                         gput(lane, "length"), gput(lane, "topic_mask"),
+                         gput(lane, "dest"), gput(lane, "valid"))
+            for lane in batches)
+        lane_directs = tuple(
+            DirectIngress(gput(lane, "bytes_"), gput(lane, "length"),
+                          gput(lane, "dest"), gput(lane, "valid"))
+            for lane in directs)
+
+        result = self.step_fn(state, lane_batches, lane_directs, live_dev)
+        self.steps += 1
+
+        # ---- host-local egress from addressable output shards ------------
+        out = []
+        for lanes in (result.lanes, result.direct_lanes):
+            for l in lanes:
+                by_shard = {}
+                for sh in l.deliver.addressable_shards:
+                    by_shard[sh.index[0].start] = np.asarray(sh.data)[0]
+                g_len = {}
+                for sh in l.gathered_length.addressable_shards:
+                    g_len[sh.index[0].start] = np.asarray(sh.data)[0]
+                g_bytes = {}
+                for sh in l.gathered_bytes.addressable_shards:
+                    g_bytes[sh.index[0].start] = np.asarray(sh.data)[0]
+                for shard in self.local_shards:
+                    if self.brokers[shard] is None:
+                        continue
+                    d2 = by_shard[shard]
+                    if not d2.any():
+                        continue
+                    lengths = g_len[shard]
+                    blocks = [g_bytes[shard]]
+                    streams = native_mod.egress_encode(d2, lengths, blocks)
+                    if streams is not None:
+                        out.append((shard, streams, None, None, None))
+                    else:
+                        out.append((shard, None, d2, lengths, blocks[0]))
+        return out

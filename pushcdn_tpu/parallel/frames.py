@@ -114,6 +114,17 @@ class UserSlots:
             self.high_water = slot + 1
         return slot
 
+    def assign_slot(self, public_key: bytes, slot: int) -> None:
+        """Bind ``public_key`` to a SPECIFIC slot (multi-host planes
+        allocate from statically partitioned per-shard ranges and bind
+        here). The slot must be unbound."""
+        if self._slot_to_key[slot] is not None:
+            bail(ErrorKind.EXCEEDED_SIZE, f"slot {slot} already bound")
+        self._key_to_slot[public_key] = slot
+        self._slot_to_key[slot] = public_key
+        if slot + 1 > self.high_water:
+            self.high_water = slot + 1
+
     def release(self, public_key: bytes) -> None:
         slot = self.unmap(public_key)
         if slot is not None:

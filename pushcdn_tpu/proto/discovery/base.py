@@ -95,6 +95,27 @@ class DiscoveryClient(abc.ABC):
     @abc.abstractmethod
     async def set_whitelist(self, users: List[bytes]) -> None: ...
 
+    # -- user-slot directory (multi-host device planes) --------------------
+    # The single-host mesh group keeps pk -> device-slot in process memory;
+    # across hosts the mapping must rendezvous somewhere, and discovery is
+    # already the cross-host registry (the reference moves the same facts in
+    # its UserSync gossip, cdn-broker/src/tasks/broker/sync.rs). Backends
+    # without a directory inherit the empty default: remote directs then
+    # fall back to the host path.
+
+    async def publish_user_slots(self, entries, ttl_s: float) -> None:
+        """Publish this host's ``{public_key: slot}`` claims with a TTL;
+        re-published every directory refresh (heartbeat-style)."""
+
+    async def get_user_slots(self):
+        """Return ``{public_key: (slot, published_ts)}`` for every live
+        claim. Default: no directory."""
+        return {}
+
+    async def drop_user_slots(self, keys: List[bytes]) -> None:
+        """Remove claims for departed users."""
+
+
     @abc.abstractmethod
     async def check_whitelist(self, user: bytes) -> bool:
         """True if ``user`` may connect; an EMPTY whitelist admits everyone

@@ -40,6 +40,12 @@ CREATE TABLE IF NOT EXISTS permits (
 CREATE TABLE IF NOT EXISTS whitelist (
     public_key BLOB PRIMARY KEY
 );
+CREATE TABLE IF NOT EXISTS user_slots (
+    public_key BLOB PRIMARY KEY,
+    slot INTEGER NOT NULL,
+    ts REAL NOT NULL,
+    expiry REAL NOT NULL
+);
 """
 
 
@@ -53,7 +59,12 @@ class Embedded(DiscoveryClient):
         # global_permits: permits redeemable at any broker (the reference's
         # `global-permits` cargo feature, threaded through discovery/auth)
         self.global_permits = global_permits
-        self._db = sqlite3.connect(path, check_same_thread=False)
+        # autocommit: every statement is its own WAL transaction, so no
+        # connection can hold the cross-process write lock between event-
+        # loop turns (python's legacy implicit transactions did, and a
+        # second process then hits 'database is locked' past busy_timeout)
+        self._db = sqlite3.connect(path, check_same_thread=False,
+                                   isolation_level=None)
         self._db.execute("PRAGMA journal_mode=WAL")
         self._db.execute("PRAGMA busy_timeout=5000")
         # Permits/heartbeats are ephemeral (30-60 s TTLs): losing the tail
@@ -149,11 +160,19 @@ class Embedded(DiscoveryClient):
     # -- whitelist ----------------------------------------------------------
 
     async def set_whitelist(self, users: List[bytes]) -> None:
-        self._db.execute("DELETE FROM whitelist")
-        self._db.executemany(
-            "INSERT OR IGNORE INTO whitelist (public_key) VALUES (?)",
-            [(bytes(u),) for u in users])
-        self._db.commit()
+        # the one compound write that must stay atomic under autocommit: a
+        # reader between the DELETE and the INSERTs would see an empty
+        # whitelist (= admit everyone)
+        self._db.execute("BEGIN IMMEDIATE")
+        try:
+            self._db.execute("DELETE FROM whitelist")
+            self._db.executemany(
+                "INSERT OR IGNORE INTO whitelist (public_key) VALUES (?)",
+                [(bytes(u),) for u in users])
+            self._db.execute("COMMIT")
+        except BaseException:
+            self._db.execute("ROLLBACK")
+            raise
         # The whitelist is DURABLE access control (an empty table admits
         # everyone) — force the WAL to disk so synchronous=NORMAL's
         # skipped fsync (fine for ephemeral permits/heartbeats) can't
@@ -168,6 +187,28 @@ class Embedded(DiscoveryClient):
             "SELECT 1 FROM whitelist WHERE public_key = ?",
             (bytes(user),)).fetchone()
         return row is not None
+
+    # -- user-slot directory (multi-host device planes) ---------------------
+
+    async def publish_user_slots(self, entries, ttl_s: float) -> None:
+        now = time.time()
+        self._db.executemany(
+            "INSERT INTO user_slots (public_key, slot, ts, expiry) "
+            "VALUES (?, ?, ?, ?) ON CONFLICT(public_key) DO UPDATE SET "
+            "slot=excluded.slot, ts=excluded.ts, expiry=excluded.expiry",
+            [(bytes(pk), int(slot), float(ts), now + ttl_s)
+             for pk, (slot, ts) in entries.items()])
+
+    async def get_user_slots(self):
+        now = time.time()
+        self._db.execute("DELETE FROM user_slots WHERE expiry < ?", (now,))
+        rows = self._db.execute(
+            "SELECT public_key, slot, ts FROM user_slots").fetchall()
+        return {bytes(r[0]): (int(r[1]), float(r[2])) for r in rows}
+
+    async def drop_user_slots(self, keys) -> None:
+        self._db.executemany("DELETE FROM user_slots WHERE public_key = ?",
+                             [(bytes(k),) for k in keys])
 
     async def close(self) -> None:
         self._db.close()

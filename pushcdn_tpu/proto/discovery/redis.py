@@ -29,6 +29,7 @@ from pushcdn_tpu.proto.error import ErrorKind, bail
 _PREFIX_BROKER = "broker:"
 _PREFIX_PERMIT = "permit:"
 _KEY_WHITELIST = "whitelist"
+_PREFIX_USLOT = "uslot:"
 
 
 class Redis(DiscoveryClient):
@@ -122,6 +123,39 @@ class Redis(DiscoveryClient):
         if await self._client.scard(_KEY_WHITELIST) == 0:
             return True
         return bool(await self._client.sismember(_KEY_WHITELIST, bytes(user)))
+
+    # -- user-slot directory (multi-host device planes) ---------------------
+
+    async def publish_user_slots(self, entries, ttl_s: float) -> None:
+        pipe = self._client.pipeline(transaction=True)
+        for pk, (slot, ts) in entries.items():
+            pipe.set(f"{_PREFIX_USLOT}{bytes(pk).hex()}",
+                     f"{int(slot)}:{float(ts)}", ex=max(1, int(ttl_s)))
+        await pipe.execute()
+
+    async def get_user_slots(self):
+        names = []
+        async for key in self._client.scan_iter(match=f"{_PREFIX_USLOT}*"):
+            names.append(key.decode() if isinstance(key, bytes) else key)
+        if not names:
+            return {}
+        out = {}
+        # one MGET for the lot: the directory refresh runs on every host
+        # every ~0.5 s, so per-key round trips would dominate Redis load
+        values = await self._client.mget(names)
+        for k, raw in zip(names, values):
+            if raw is None:
+                continue
+            v = raw.decode() if isinstance(raw, bytes) else raw
+            slot_s, ts_s = v.split(":", 1)
+            out[bytes.fromhex(k[len(_PREFIX_USLOT):])] = (int(slot_s),
+                                                          float(ts_s))
+        return out
+
+    async def drop_user_slots(self, keys) -> None:
+        if keys:
+            await self._client.delete(
+                *(f"{_PREFIX_USLOT}{bytes(k).hex()}" for k in keys))
 
     async def close(self) -> None:
         await self._client.aclose()

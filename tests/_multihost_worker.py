@@ -1,0 +1,187 @@
+"""Worker for the two-process multi-host DEPLOYMENT test (run via
+subprocess, not pytest).
+
+Each of two OS processes hosts 4 virtual CPU devices and runs a REAL
+slice of the system — jax.distributed runtime, the global 8-shard broker
+mesh, its own marshal (stateless, parity: many marshals per deployment),
+one TCP broker attached to a local mesh shard (``form_mesh=False``: no
+host broker links ever form), and one TCP client authenticated through
+its marshal. Asserts the VERDICT deployment criterion end to end:
+
+- a broadcast published by host 0's client is delivered to host 1's
+  client purely over the device mesh (zero host broker links on both
+  sides, checked);
+- a direct message from host 1's client to host 0's client routes
+  cross-host after the discovery user-slot directory propagates;
+- both brokers report ``connections.num_brokers == 0`` throughout.
+
+Usage: _multihost_worker.py <rank> <base_port> <discovery_db_path>
+"""
+
+import asyncio
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # sitecustomize may override env
+
+rank = int(sys.argv[1])
+base = int(sys.argv[2])
+db = sys.argv[3]
+
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{base}",
+                           num_processes=2, process_id=rank)
+assert jax.process_count() == 2
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pushcdn_tpu.broker.broker import Broker, BrokerConfig  # noqa: E402
+from pushcdn_tpu.broker.mesh_group import MeshGroupConfig  # noqa: E402
+from pushcdn_tpu.broker.multihost_group import (  # noqa: E402
+    MultiHostBrokerGroup,
+)
+from pushcdn_tpu.client import Client, ClientConfig  # noqa: E402
+from pushcdn_tpu.marshal import Marshal, MarshalConfig  # noqa: E402
+from pushcdn_tpu.parallel.multihost import (  # noqa: E402
+    local_shard_indices,
+    pod_broker_mesh,
+)
+from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME  # noqa: E402
+from pushcdn_tpu.proto.def_ import testing_run_def  # noqa: E402
+from pushcdn_tpu.proto.discovery.base import BrokerIdentifier  # noqa: E402
+from pushcdn_tpu.proto.discovery.embedded import Embedded  # noqa: E402
+from pushcdn_tpu.proto.message import Broadcast, Direct  # noqa: E402
+from pushcdn_tpu.proto.transport import Tcp  # noqa: E402
+
+N_SHARDS = 8
+MARSHAL_PORT = base + 1 + rank
+BROKER_PUB = base + 10 + 10 * rank
+BROKER_PRIV = BROKER_PUB + 1
+
+# deterministic client identities: each host can derive the OTHER's key
+CLIENT_SEED = [61_000, 62_000]
+
+
+async def main() -> None:
+    mesh = pod_broker_mesh(N_SHARDS)
+    local = local_shard_indices(mesh)
+    my_shard = local[0]
+
+    rd = testing_run_def(broker_protocol=Tcp, user_protocol=Tcp)
+    group = MultiHostBrokerGroup(
+        mesh,
+        MeshGroupConfig(num_user_slots=64, ring_slots=8, frame_bytes=1024,
+                        extra_lanes=(), direct_bucket_slots=4,
+                        batch_window_s=0.05),
+        discovery=await Embedded.new(db),
+        directory_refresh_s=0.3)
+
+    ident = BrokerIdentifier(f"127.0.0.1:{BROKER_PUB}",
+                             f"127.0.0.1:{BROKER_PRIV}")
+    broker = await Broker.new(BrokerConfig(
+        run_def=rd, keypair=DEFAULT_SCHEME.generate_keypair(seed=50 + rank),
+        discovery_endpoint=db,
+        public_advertise_endpoint=ident.public_advertise_endpoint,
+        public_bind_endpoint=f"127.0.0.1:{BROKER_PUB}",
+        private_advertise_endpoint=ident.private_advertise_endpoint,
+        private_bind_endpoint=f"127.0.0.1:{BROKER_PRIV}",
+        heartbeat_interval_s=0.5, sync_interval_s=3600,
+        whitelist_interval_s=3600, form_mesh=False))
+    group.attach(broker, my_shard)
+    await broker.start()
+
+    marshal = await Marshal.new(MarshalConfig(
+        run_def=rd, discovery_endpoint=db,
+        bind_endpoint=f"127.0.0.1:{MARSHAL_PORT}"))
+    await marshal.start()
+
+    # pin placement: THIS host's marshal always assigns THIS host's broker
+    # (production load-balances; the test needs the cross-host topology)
+    async def pinned():
+        return ident
+    marshal.discovery.get_with_least_connections = pinned
+
+    client = Client(ClientConfig(
+        marshal_endpoint=f"127.0.0.1:{MARSHAL_PORT}",
+        keypair=DEFAULT_SCHEME.generate_keypair(seed=CLIENT_SEED[rank]),
+        protocol=Tcp, subscribed_topics={0}))
+    await client.ensure_initialized()
+    for _ in range(100):  # registration completes just after the auth ack
+        if broker.connections.num_users == 1:
+            break
+        await asyncio.sleep(0.05)
+    assert broker.connections.num_users == 1
+
+    # rendezvous: wait until the user-slot directory shows BOTH clients
+    # (this also phase-syncs the two processes)
+    for _ in range(200):
+        slots = await group.discovery.get_user_slots()
+        if len(slots) >= 2:
+            break
+        await asyncio.sleep(0.1)
+    else:
+        raise AssertionError("user-slot directory never converged")
+
+    # ---- cross-host broadcast (the VERDICT 'Done' criterion) -------------
+    if rank == 0:
+        await client.send_broadcast_message([0], b"cross-host hello")
+    got = await asyncio.wait_for(client.receive_message(), 30)
+    assert isinstance(got, Broadcast), got
+    assert bytes(got.message) == b"cross-host hello"
+    assert broker.connections.num_brokers == 0  # zero host broker links
+
+    # ---- cross-host direct (via the slot directory) ----------------------
+    peer_pk = DEFAULT_SCHEME.generate_keypair(
+        seed=CLIENT_SEED[1 - rank]).public_key
+    # directs are fire-and-forget (reference parity): wait until THIS
+    # host's directory mirror has the peer's slot before sending, or the
+    # frame legitimately drops as unroutable
+    for _ in range(100):
+        if group._direct_route_info(bytes(peer_pk)) is not None:
+            break
+        await asyncio.sleep(0.1)
+    else:
+        raise AssertionError("peer slot never reached the local mirror")
+    if rank == 1:
+        await client.send_direct_message(peer_pk, b"direct across hosts")
+        # host 0 answers so BOTH directions are proven
+        got = await asyncio.wait_for(client.receive_message(), 30)
+        assert isinstance(got, Direct)
+        assert bytes(got.message) == b"ack from host 0"
+    else:
+        got = await asyncio.wait_for(client.receive_message(), 30)
+        assert isinstance(got, Direct), got
+        assert bytes(got.message) == b"direct across hosts"
+        await client.send_direct_message(peer_pk, b"ack from host 0")
+
+    assert broker.connections.num_brokers == 0
+    assert group.steps > 0
+    assert not group.disabled
+
+    # end-of-test rendezvous: neither host may stop the collective pump
+    # until BOTH have seen their final deliveries (the directory doubles
+    # as the phase barrier)
+    await group.discovery.publish_user_slots(
+        {b"done-%d" % rank: (0, 0.0)}, 60)
+    for _ in range(200):
+        slots = await group.discovery.get_user_slots()
+        if b"done-0" in slots and b"done-1" in slots:
+            break
+        await asyncio.sleep(0.1)
+    else:
+        raise AssertionError("peer never reached the done barrier")
+
+    client.close()
+    await marshal.stop()
+    await broker.stop()   # triggers the collective stop barrier
+    await group.discovery.close()
+    jax.distributed.shutdown()
+    print(f"rank {rank}: MULTIHOST OK (steps={group.steps}, "
+          f"routed={group.messages_routed}, host_links=0)")
+
+
+asyncio.run(main())
