@@ -216,6 +216,44 @@ async def bench_e2e_echo(iters: int):
     await broker.stop()
 
 
+async def bench_device_echo(iters: int):
+    """Device-plane direct-echo latency, both policies (BASELINE.md device-
+    latency row): with the depth-1 idle bypass (the default — sparse
+    traffic host-routes, so the device plane costs the latency regime
+    nothing) and with the bypass disabled (the raw staged step path, the
+    floor a device-routed message pays)."""
+    from pushcdn_tpu.broker.device_plane import DevicePlaneConfig
+    from pushcdn_tpu.testing import Cluster
+
+    for label, bypass in (("bypass", 2), ("staged", 0)):
+        cluster = await Cluster(num_brokers=1,
+                                device_plane=DevicePlaneConfig(
+                                    ring_slots=64, frame_bytes=16384,
+                                    extra_lanes=(),
+                                    bypass_max_items=bypass)).start()
+        try:
+            client = cluster.client(seed=77, topics=[0])
+            await client.ensure_initialized()
+            payload = os.urandom(10 * 1024)
+            # warm the path (first step compiles nothing further; warmup
+            # ran at broker start, but prime caches anyway)
+            for _ in range(5):
+                await client.send_direct_message(client.public_key, payload)
+                await client.receive_message()
+            lat = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                await client.send_direct_message(client.public_key, payload)
+                await client.receive_message()
+                lat.append((time.perf_counter() - t0) * 1e6)
+            emit(f"e2e/device_echo_10KB_{label}", statistics.median(lat),
+                 "us_median", p99=_p99(lat),
+                 steps=cluster.brokers[0].device_plane.steps)
+            client.close()
+        finally:
+            await cluster.stop()
+
+
 def _p99(lat):
     return round(sorted(lat)[max(0, int(len(lat) * 0.99) - 1)], 1)
 
@@ -239,6 +277,7 @@ async def amain(quick: bool):
                               min(budget // 4, max(4 * size, floor // 2)))
     await bench_routing(iters=100 if quick else 500)
     await bench_e2e_echo(iters=200 if quick else 1000)
+    await bench_device_echo(iters=100 if quick else 300)
 
 
 def main():

@@ -101,6 +101,13 @@ class DevicePlaneConfig:
     # prefix-slice shapes for sparse traffic (one extra cached jit
     # specialization; collectives/D2H shrink ~ring/latency_slots x)
     latency_slots: int = 8
+    # Depth-1 bypass: when the plane is COMPLETELY idle (no step in
+    # flight, rings empty) and at most this many messages arrive in one
+    # batch, route them on the host path immediately — the device's step
+    # dispatch is a latency floor the sparse regime should never pay,
+    # and the single-shard plane's host path covers exactly the same
+    # local users. 0 disables (tests of staging mechanics do).
+    bypass_max_items: int = 2
 
     def lane_shapes(self):
         """All lanes as (frame_bytes, ring_slots), sorted ascending by
@@ -147,6 +154,7 @@ class DevicePlane:
         self.overflow_seen = False
         self._kick = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
+        self._step_inflight = False
         self.steps = 0
         self.messages_routed = 0
 
@@ -187,11 +195,22 @@ class DevicePlane:
 
     # ---- ingress ----------------------------------------------------------
 
+    def _idle_bypass(self, n_items: int) -> bool:
+        """True when the latency regime should skip the device entirely:
+        nothing staged, no step in flight, and the arriving batch is
+        small — host-routing now beats waiting a step dispatch."""
+        return (n_items <= self.config.bypass_max_items
+                and not self._step_inflight
+                and all(r.free_slots == r.slots for r in self.rings))
+
     def try_stage(self, message, raw: Bytes) -> StageResult:
         """Stage a decoded message's WIRE FRAME for device routing.
         INELIGIBLE ⇒ host path (too big, unknown recipient, unmirrored
-        users present); FULL ⇒ slot-credit backpressure, caller retries."""
+        users present, or the depth-1 idle bypass); FULL ⇒ slot-credit
+        backpressure, caller retries."""
         if self.disabled:
+            return StageResult.INELIGIBLE
+        if self._idle_bypass(1):
             return StageResult.INELIGIBLE
         frame = bytes(raw.data)
         if len(frame) > self.rings[-1].frame_bytes:
@@ -229,7 +248,7 @@ class DevicePlane:
         per-item ``StageResult`` aligned with ``items``; FULL items are
         the ring-backpressure leftovers the caller retries singly."""
         results = [StageResult.INELIGIBLE] * len(items)
-        if self.disabled:
+        if self.disabled or self._idle_bypass(len(items)):
             return results
         # (ring -> [(item_idx, frame, kind, mask, dest), ...])
         groups: dict[int, list] = {}
@@ -358,8 +377,12 @@ class DevicePlane:
             rev = self._state_rev
             quarantined, self._quarantine = self._quarantine, []
             try:
-                jobs = await asyncio.to_thread(
-                    self._run_step, batches_np, owned, masks, rev)
+                self._step_inflight = True
+                try:
+                    jobs = await asyncio.to_thread(
+                        self._run_step, batches_np, owned, masks, rev)
+                finally:
+                    self._step_inflight = False
                 last_step_t = loop.time()
                 for streams, d2, lengths, frames in jobs:
                     if streams is not None:

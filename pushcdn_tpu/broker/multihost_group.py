@@ -233,7 +233,11 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
                     elif slot != local_slot and \
                             ts > self._local_claim_ts.get(pk, 0.0):
                         # cross-host double connect: the newer claim wins
-                        # (the reference's CRDT kick, via the directory)
+                        # (the reference's CRDT kick, via the directory).
+                        # ts is host wall-clock: hosts must be NTP-synced
+                        # with skew below the reconnect gap — the same
+                        # assumption the auth protocol's +-5 s signed-
+                        # timestamp window already imposes on a deployment
                         shard = local_slot // self.slots_per_shard
                         broker = self.brokers[shard]
                         if broker is not None and \
@@ -295,6 +299,10 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
             self._claim_version[slot] += 1
             self._masks[slot] = 0
             self._quarantine.append(int(slot))
+        # a dead shard's unmirrored users must not pin broadcasts to the
+        # (nonexistent cross-host) overflow path forever
+        for key in [k for k, sh in self._unmirrored.items() if sh == shard]:
+            del self._unmirrored[key]
         if dropped and self.discovery is not None:
             asyncio.ensure_future(self.discovery.drop_user_slots(dropped))
         self.brokers[shard] = None
@@ -331,7 +339,7 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
                 # Mark disabled so try_stage stops ACKing frames into rings
                 # nothing will ever drain (they'd be silently blackholed).
                 self.disabled = True
-                logger.info("multi-host group stopping (collective)")
+                self._halt_aux("peer host retired")
                 return
             batches = [[r.take_batch() for r in rings]
                        for rings in self.lane_rings]
@@ -363,6 +371,7 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
                                  "(no host fallback plane exists)")
                 self.disabled = True
                 self._stop_requested = True
+                self._halt_aux("step failure")
                 # one last barrier so the peer hosts exit cleanly
                 try:
                     await asyncio.to_thread(self._collective_stop, True)
@@ -372,6 +381,23 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
             finally:
                 for slot in quarantined:
                     self.slots.free_slot(slot)
+
+    def _halt_aux(self, why: str) -> None:
+        """Stop republishing claims and account for frames that were
+        ACKed STAGED but will never be stepped (no cross-host fallback
+        plane exists — log the loss rather than hide it)."""
+        if self._dir_task is not None:
+            self._dir_task.cancel()
+            self._dir_task = None
+        stranded = (sum(r.slots - r.free_slots
+                        for rings in self.lane_rings for r in rings)
+                    + sum(b.total_used
+                          for bkts in self.lane_buckets for b in bkts))
+        if stranded:
+            logger.warning(
+                "multi-host group halted (%s) with %d staged frame(s) "
+                "undeliverable — no host fallback plane exists", why,
+                stranded)
 
     # ---- the collective step ---------------------------------------------
 
@@ -423,23 +449,23 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
         out = []
         for lanes in (result.lanes, result.direct_lanes):
             for l in lanes:
-                by_shard = {}
-                for sh in l.deliver.addressable_shards:
-                    by_shard[sh.index[0].start] = np.asarray(sh.data)[0]
-                g_len = {}
-                for sh in l.gathered_length.addressable_shards:
-                    g_len[sh.index[0].start] = np.asarray(sh.data)[0]
-                g_bytes = {}
-                for sh in l.gathered_bytes.addressable_shards:
-                    g_bytes[sh.index[0].start] = np.asarray(sh.data)[0]
+                d_sh = {sh.index[0].start: sh
+                        for sh in l.deliver.addressable_shards}
+                len_sh = {sh.index[0].start: sh
+                          for sh in l.gathered_length.addressable_shards}
+                byt_sh = {sh.index[0].start: sh
+                          for sh in l.gathered_bytes.addressable_shards}
                 for shard in self.local_shards:
                     if self.brokers[shard] is None:
                         continue
-                    d2 = by_shard[shard]
+                    d2 = np.asarray(d_sh[shard].data)[0]
                     if not d2.any():
                         continue
-                    lengths = g_len[shard]
-                    blocks = [g_bytes[shard]]
+                    # lazily pull the (large) gathered byte tensor ONLY
+                    # for shards that actually deliver this tick — the
+                    # lockstep pump fires every window, traffic or not
+                    lengths = np.asarray(len_sh[shard].data)[0]
+                    blocks = [np.asarray(byt_sh[shard].data)[0]]
                     streams = native_mod.egress_encode(d2, lengths, blocks)
                     if streams is not None:
                         out.append((shard, streams, None, None, None))

@@ -192,10 +192,14 @@ class Embedded(DiscoveryClient):
 
     async def publish_user_slots(self, entries, ttl_s: float) -> None:
         now = time.time()
+        # newest claim wins: a loser host's TTL re-publication must not
+        # overwrite the winning host's newer claim (claim ts is fixed at
+        # claim time; refreshes carry the same ts and still bump expiry)
         self._db.executemany(
             "INSERT INTO user_slots (public_key, slot, ts, expiry) "
             "VALUES (?, ?, ?, ?) ON CONFLICT(public_key) DO UPDATE SET "
-            "slot=excluded.slot, ts=excluded.ts, expiry=excluded.expiry",
+            "slot=excluded.slot, ts=excluded.ts, expiry=excluded.expiry "
+            "WHERE excluded.ts >= user_slots.ts",
             [(bytes(pk), int(slot), float(ts), now + ttl_s)
              for pk, (slot, ts) in entries.items()])
 

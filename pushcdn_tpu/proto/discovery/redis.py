@@ -127,10 +127,19 @@ class Redis(DiscoveryClient):
     # -- user-slot directory (multi-host device planes) ---------------------
 
     async def publish_user_slots(self, entries, ttl_s: float) -> None:
+        # newest claim wins (read-compare-write; the tiny race window is
+        # closed by the next refresh, since the loser's claim ts never
+        # grows while the winner's republication carries the newer one)
+        names = {f"{_PREFIX_USLOT}{bytes(pk).hex()}": (pk, v)
+                 for pk, v in entries.items()}
+        current = await self._client.mget(list(names)) if names else []
         pipe = self._client.pipeline(transaction=True)
-        for pk, (slot, ts) in entries.items():
-            pipe.set(f"{_PREFIX_USLOT}{bytes(pk).hex()}",
-                     f"{int(slot)}:{float(ts)}", ex=max(1, int(ttl_s)))
+        for (key, (pk, (slot, ts))), raw in zip(names.items(), current):
+            if raw is not None:
+                v = raw.decode() if isinstance(raw, bytes) else raw
+                if float(v.split(":", 1)[1]) > float(ts):
+                    continue  # a newer claim exists elsewhere
+            pipe.set(key, f"{int(slot)}:{float(ts)}", ex=max(1, int(ttl_s)))
         await pipe.execute()
 
     async def get_user_slots(self):

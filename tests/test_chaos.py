@@ -1,0 +1,183 @@
+"""Automated chaos tier: broker churn + connection storm + 9 MB firehose
+against a live in-process cluster, asserting ZERO loss for survivors.
+
+The reference ships this tier as manual load binaries — bad-broker
+(cdn-broker/src/binaries/bad-broker.rs:57-97: joins the mesh, dies,
+rejoins, forever), bad-connector (cdn-client: connect/disconnect churn)
+and bad-sender (9 MB message firehose) — run by hand against a cluster.
+Here the same three antagonists run INSIDE one pytest for ~8 s while a
+survivor publisher streams sequenced messages, and afterwards every
+survivor subscriber must hold the complete, in-order sequence: churn of
+an unrelated broker, auth-storm load on the marshal, and giant frames
+sharing every pipe must not cost one message between healthy peers.
+"""
+
+import asyncio
+import os
+
+from pushcdn_tpu.broker.broker import Broker, BrokerConfig
+from pushcdn_tpu.broker.tasks.heartbeat import heartbeat_once
+from pushcdn_tpu.proto.message import Broadcast
+from pushcdn_tpu.testing import Cluster, wait_mesh_interest, wait_until
+
+CHAOS_SECONDS = 8.0
+SEQ_MSGS = 300          # survivor stream: steady sequenced broadcasts
+FIREHOSE_BYTES = 9 * 1024 * 1024  # parity: bad-sender's 9 MB default
+
+
+async def _churn_bad_broker(cluster: Cluster, stop: asyncio.Event,
+                            stats: dict) -> None:
+    """bad-broker.rs parity: join the mesh, live briefly, die without
+    goodbye, rejoin — forever (until the window closes)."""
+    i = 0
+    while not stop.is_set():
+        pub = f"chaos{cluster.uid}-bad-pub-{i}"
+        priv = f"chaos{cluster.uid}-bad-priv-{i}"
+        bad = await Broker.new(BrokerConfig(
+            run_def=cluster.run_def, keypair=cluster.broker_keypair,
+            discovery_endpoint=cluster.db,
+            public_advertise_endpoint=pub, public_bind_endpoint=pub,
+            private_advertise_endpoint=priv, private_bind_endpoint=priv,
+            heartbeat_interval_s=3600, sync_interval_s=3600,
+            whitelist_interval_s=3600))
+        await bad.start()
+        await heartbeat_once(bad)          # dial into the mesh
+        for b in cluster.brokers:
+            await heartbeat_once(b)        # survivors learn of it
+        await asyncio.sleep(0.4)           # live briefly under load
+        await bad.stop()                   # die (no goodbye protocol)
+        stats["churn_cycles"] = i = i + 1
+        await asyncio.sleep(0.1)
+
+
+async def _connection_storm(cluster: Cluster, stop: asyncio.Event,
+                            stats: dict) -> None:
+    """bad-connector parity: authenticate through the marshal, hold the
+    session a moment, vanish; repeat as fast as the marshal allows."""
+    seed = 0
+    while not stop.is_set():
+        seed += 1
+        c = cluster.client(seed=80_000 + seed, topics=[3])
+        try:
+            async with asyncio.timeout(5):
+                await c.ensure_initialized()
+            stats["storm_ok"] = stats.get("storm_ok", 0) + 1
+        except Exception:
+            # a storm connect landing on the dying broker IS the chaos
+            stats["storm_fail"] = stats.get("storm_fail", 0) + 1
+        finally:
+            c.close()
+        await asyncio.sleep(0)
+
+
+async def _firehose(sender, sink, stop: asyncio.Event,
+                    stats: dict) -> None:
+    """bad-sender parity: 9 MB broadcasts, back to back, on their own
+    topic so the survivor stream shares pipes but not subscriptions.
+    Both clients were connected to SURVIVOR brokers before churn began;
+    transient resets (chaos is chaos) reconnect and continue."""
+    blob = os.urandom(FIREHOSE_BYTES)
+    while not stop.is_set():
+        try:
+            await sender.send_broadcast_message([5], blob)
+            got = await asyncio.wait_for(sink.receive_message(), 10)
+            assert len(bytes(got.message)) == FIREHOSE_BYTES
+            stats["firehose_msgs"] = stats.get("firehose_msgs", 0) + 1
+        except (Exception, asyncio.TimeoutError):
+            stats["firehose_resets"] = stats.get("firehose_resets", 0) + 1
+            await asyncio.sleep(0.2)
+
+
+async def test_chaos_survivors_lose_nothing():
+    from pushcdn_tpu.proto.topic import TopicSpace
+    cluster = await Cluster(num_brokers=3,
+                            topics=TopicSpace.range(8)).start()
+    try:
+        # survivors: 6 subscribed clients, 2 per broker, all on topic 0
+        survivors = []
+        for i in range(6):
+            await cluster.place_on(i % 3)
+            c = cluster.client(seed=70_000 + i, topics=[0])
+            await c.ensure_initialized()
+            survivors.append(c)
+        await wait_until(
+            lambda: sum(b.connections.num_users
+                        for b in cluster.brokers) == 6)
+        await wait_mesh_interest(cluster, topic=0, links=2)
+
+        # firehose clients connect BEFORE churn begins so they live on
+        # survivor brokers (a load-0 churn broker wins placement ties)
+        fh_sender = cluster.client(seed=90_001, topics=[])
+        await cluster.place_on(2)
+        fh_sink = cluster.client(seed=90_002, topics=[5])
+        await fh_sender.ensure_initialized()
+        await fh_sink.ensure_initialized()
+        # every broker must be able to route topic 5 (local user or an
+        # interested mesh link) before the first giant frame flies
+        await wait_until(
+            lambda: all(any(b.connections
+                            .get_interested_by_topic([5], False)[j]
+                            for j in (0, 1))
+                        for b in cluster.brokers), timeout=30)
+
+        publisher = survivors[0]
+        received = [[] for _ in survivors]
+
+        async def drain(idx: int) -> None:
+            while len(received[idx]) < SEQ_MSGS:
+                for m in await survivors[idx].receive_messages():
+                    assert isinstance(m, Broadcast)
+                    received[idx].append(
+                        int.from_bytes(bytes(m.message)[:4], "big"))
+
+        stop = asyncio.Event()
+        stats: dict = {}
+        chaos = [
+            asyncio.create_task(_churn_bad_broker(cluster, stop, stats)),
+            asyncio.create_task(_connection_storm(cluster, stop, stats)),
+            asyncio.create_task(_firehose(fh_sender, fh_sink, stop,
+                                          stats)),
+        ]
+        drains = [asyncio.create_task(drain(i))
+                  for i in range(len(survivors))]
+
+        # the survivor stream: sequenced broadcasts spread over the window
+        interval = CHAOS_SECONDS / SEQ_MSGS
+        payload_tail = os.urandom(512)
+        for seq in range(SEQ_MSGS):
+            await publisher.send_broadcast_message(
+                [0], seq.to_bytes(4, "big") + payload_tail)
+            await asyncio.sleep(interval)
+
+        async with asyncio.timeout(60):
+            await asyncio.gather(*drains)
+        stop.set()
+        chaos_results = await asyncio.gather(*chaos, return_exceptions=True)
+        for r in chaos_results:
+            assert not isinstance(r, BaseException) \
+                or isinstance(r, asyncio.CancelledError), r
+
+        # ---- the zero-loss assertion ---------------------------------
+        for idx, seqs in enumerate(received):
+            assert seqs == list(range(SEQ_MSGS)), (
+                f"survivor {idx} lost/reordered messages: "
+                f"got {len(seqs)}, first miss at "
+                f"{next((i for i, s in enumerate(seqs) if s != i), '?')}")
+
+        # chaos actually happened
+        assert stats.get("churn_cycles", 0) >= 2, stats
+        assert stats.get("storm_ok", 0) >= 10, stats
+        assert stats.get("firehose_msgs", 0) >= 3, stats
+
+        # ---- convergence: the dead broker aged out of the mesh -------
+        for b in cluster.brokers:
+            await heartbeat_once(b)
+        await wait_until(
+            lambda: all(b.connections.num_brokers == 2
+                        for b in cluster.brokers), timeout=30)
+        for c in survivors:
+            c.close()
+        fh_sender.close()
+        fh_sink.close()
+    finally:
+        await cluster.stop()

@@ -297,7 +297,7 @@ async def test_device_plane_routes_broker_traffic():
 
     cluster = await Cluster(num_brokers=1, device_plane=DevicePlaneConfig(
         num_user_slots=64, ring_slots=64, frame_bytes=1024,
-        batch_window_s=0.005)).start()
+        batch_window_s=0.005, bypass_max_items=0)).start()
     try:
         alice = cluster.client(seed=61, topics=[0])
         bob = cluster.client(seed=62, topics=[0])
@@ -355,7 +355,7 @@ async def test_device_plane_routes_high_topics():
         num_brokers=1,
         device_plane=DevicePlaneConfig(
             num_user_slots=64, ring_slots=64, frame_bytes=1024,
-            batch_window_s=0.005),
+            batch_window_s=0.005, bypass_max_items=0),
         topics=TopicSpace.range(256)).start()
     try:
         alice = cluster.client(seed=71, topics=[200])
@@ -393,7 +393,7 @@ async def test_device_plane_compact_topic_words():
         num_brokers=1,
         device_plane=DevicePlaneConfig(
             num_user_slots=32, ring_slots=32, frame_bytes=1024,
-            topic_words=1, batch_window_s=0.005),
+            topic_words=1, batch_window_s=0.005, bypass_max_items=0),
         topics=TopicSpace.range(256)).start()
     try:
         alice = cluster.client(seed=81, topics=[3, 40])
