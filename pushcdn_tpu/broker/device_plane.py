@@ -46,6 +46,11 @@ from typing import TYPE_CHECKING, List, Optional, Tuple
 
 import numpy as np
 
+from pushcdn_tpu.broker.pump_common import (
+    CoalesceGate,
+    RevCache,
+    effective_users,
+)
 from pushcdn_tpu.broker.staging import StageResult
 from pushcdn_tpu.broker.tasks.senders import egress_delivery_rows
 from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
@@ -139,9 +144,9 @@ class DevicePlane:
         # host path while any exist (they'd miss device-only fan-out)
         self._unmirrored: set[bytes] = set()
         # mirror revision: device state re-uploads only when it changed
+        # (pump_common.RevCache holds the device copy)
         self._state_rev = 0
-        self._dev_rev = -1
-        self._dev_state = None
+        self._state_cache = RevCache()
         # cached device-side empty lane batches + byte stubs (frame bytes
         # never ride the device on the single-shard plane: the delivery
         # DECISION comes back, payloads egress from the host ring snapshot)
@@ -310,13 +315,11 @@ class DevicePlane:
         await asyncio.to_thread(self._warmup)
         self._task = asyncio.create_task(self._pump(), name="device-pump")
 
-    U_ROUND = 64  # user-table slice granularity (see mesh_group)
-
     def _warmup(self) -> None:
         from pushcdn_tpu.parallel.frames import slice_batch
         empty = [r.take_batch() for r in self.rings]
         lat = [slice_batch(b, self.config.latency_slots) for b in empty]
-        u0 = min(self.config.num_user_slots, self.U_ROUND)
+        u0 = effective_users(0, self.config.num_user_slots)
         try:
             # compile the only two specializations the pump uses: all lanes
             # at full shapes (idle lanes ride cached device empties) and
@@ -346,17 +349,17 @@ class DevicePlane:
         from pushcdn_tpu.parallel.frames import slice_batch
         c = self.config
         loop = asyncio.get_running_loop()
-        last_step_t = -1e9
+        gate = CoalesceGate(c.batch_window_s, c.coalesce_min_frames)
         while True:
             await self._kick.wait()
             self._kick.clear()
             await asyncio.sleep(0)  # let same-tick stagers land
             staged = sum(r.slots - r.free_slots for r in self.rings)
-            if staged and staged < c.coalesce_min_frames and \
-                    loop.time() - last_step_t < 4 * c.batch_window_s:
+            wait = gate.wait_s(staged, loop.time())
+            if wait:
                 # steady trickle: coalesce one window; bursts after idle
                 # (the latency regime) and saturated pipelines step now
-                await asyncio.sleep(c.batch_window_s)
+                await asyncio.sleep(wait)
             if all(r.free_slots == r.slots for r in self.rings):
                 continue
             lat = c.latency_slots
@@ -368,10 +371,8 @@ class DevicePlane:
             batches_np = [r.take_batch() for r in self.rings]
             if small:
                 batches_np = [slice_batch(batches_np[0], lat)]
-            u_eff = min(c.num_user_slots,
-                        max(self.U_ROUND,
-                            -(-self.slots.high_water // self.U_ROUND)
-                            * self.U_ROUND))
+            u_eff = effective_users(self.slots.high_water,
+                                    c.num_user_slots)
             owned = self._owned[:u_eff].copy()
             masks = self._masks[:u_eff].copy()
             rev = self._state_rev
@@ -383,7 +384,7 @@ class DevicePlane:
                         self._run_step, batches_np, owned, masks, rev)
                 finally:
                     self._step_inflight = False
-                last_step_t = loop.time()
+                gate.stepped(loop.time())
                 for streams, d2, lengths, frames in jobs:
                     if streams is not None:
                         self.messages_routed += egress_streams(
@@ -422,11 +423,9 @@ class DevicePlane:
         Python fallback."""
         import jax.numpy as jnp
         from pushcdn_tpu import native as native_mod
-        if state_rev is not None and state_rev == self._dev_rev \
-                and self._dev_state is not None:
-            state = self._dev_state
-        else:
-            state = RouterState(
+
+        def build_state():
+            return RouterState(
                 crdt=CrdtState(
                     owners=jnp.asarray(
                         np.where(owned, 0, ABSENT).astype(np.int32)),
@@ -435,8 +434,8 @@ class DevicePlane:
                         np.where(owned, 0, ABSENT).astype(np.int32)),
                 ),
                 topic_masks=jnp.asarray(masks))
-            if state_rev is not None:
-                self._dev_state, self._dev_rev = state, state_rev
+
+        state = self._state_cache.get(state_rev, build_state)
 
         def stub(n):
             st = self._byte_stubs.get(n)

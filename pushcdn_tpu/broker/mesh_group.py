@@ -39,6 +39,11 @@ from typing import TYPE_CHECKING, Dict, List, Optional
 
 import numpy as np
 
+from pushcdn_tpu.broker.pump_common import (
+    CoalesceGate,
+    RevCache,
+    effective_users,
+)
 from pushcdn_tpu.broker.tasks.senders import (
     egress_delivery_rows,
     egress_streams,
@@ -171,9 +176,6 @@ class MeshShardPlane:
 
 
 class MeshBrokerGroup:
-    # user-table slice granularity (jit keys move once per bucket)
-    U_ROUND = 64
-
     def __init__(self, mesh, config: MeshGroupConfig = None):
         self.mesh = mesh
         self.config = config or MeshGroupConfig()
@@ -217,9 +219,7 @@ class MeshBrokerGroup:
         # step thread re-uploads device state only when it changed (steady
         # state pays zero H2D for the user table)
         self._state_rev = 0
-        self._dev_rev = -1
-        self._dev_state = None      # cached device RouterState (stacked)
-        self._dev_liveness = None   # cached device liveness [B, B]
+        self._state_cache = RevCache()  # (RouterState, liveness) on device
         # cached device-side EMPTY lane batches: an idle lane re-uses its
         # device arrays, paying zero stack/H2D per step (keying the jit
         # cache on lane SUBSETS instead would recompile per traffic mix)
@@ -272,7 +272,7 @@ class MeshBrokerGroup:
         small = [[slice_batch(b, lat) for b in lane] for lane in batches]
         small_d = [[slice_direct_batch(d, lat) for d in lane]
                    for lane in directs]
-        u0 = min(self.config.num_user_slots, self.U_ROUND)
+        u0 = effective_users(0, self.config.num_user_slots)
         try:
             # compile the ONLY two specializations the pump needs at first
             # population (u_eff = first user bucket): all lanes at full
@@ -526,19 +526,19 @@ class MeshBrokerGroup:
     async def _pump(self) -> None:
         c = self.config
         loop = asyncio.get_running_loop()
-        last_step_t = -1e9
+        gate = CoalesceGate(c.batch_window_s, c.coalesce_min_frames)
         while True:
             await self._kick.wait()
             self._kick.clear()
             # one yield so every stager woken in this tick lands first
             await asyncio.sleep(0)
             staged = self._staged_total()
-            if staged and staged < c.coalesce_min_frames and \
-                    loop.time() - last_step_t < 4 * c.batch_window_s:
+            wait = gate.wait_s(staged, loop.time())
+            if wait:
                 # steady trickle below the coalesce threshold: wait one
                 # window. A burst after idle (latency regime) and a
                 # saturated pipeline both step immediately.
-                await asyncio.sleep(c.batch_window_s)
+                await asyncio.sleep(wait)
                 staged = self._staged_total()
             if not self._state_dirty and staged == 0:
                 continue
@@ -568,10 +568,8 @@ class MeshBrokerGroup:
             # the jit key only moves every ``u_round`` users): delivery
             # matrices, their D2H, and the egress scans all shrink with the
             # actual population instead of paying for empty slots
-            u_round = self.U_ROUND
-            u_eff = min(self.config.num_user_slots,
-                        max(u_round, -(-self.slots.high_water // u_round)
-                            * u_round))
+            u_eff = effective_users(self.slots.high_water,
+                                    self.config.num_user_slots)
             owner = self._owner[:u_eff].copy()
             versions = self._claim_version[:u_eff].copy()
             masks = self._masks[:u_eff].copy()
@@ -582,7 +580,7 @@ class MeshBrokerGroup:
                 egress_jobs = await asyncio.to_thread(
                     self._run_step, batches, directs, owner, versions, masks,
                     liveness, rev)
-                last_step_t = loop.time()
+                gate.stepped(loop.time())
                 for shard, streams, d2, lengths, frames in egress_jobs:
                     broker = self.brokers[shard]
                     if broker is None:
@@ -634,26 +632,22 @@ class MeshBrokerGroup:
         B = self.num_shards
         put = lambda a: jax.device_put(a, self._sharding)
         live = (np.ones(B, bool) if liveness is None else liveness)
-        if state_rev is not None and state_rev == self._dev_rev \
-                and self._dev_state is not None:
-            state = self._dev_state
-            live_dev = self._dev_liveness
-        else:
+
+        def build_state():
             # every shard's state row is the (shared) global view; on real
             # multi-host pods these rows diverge and the in-step merge
             # converges them — the device program is identical
             owners_b = np.broadcast_to(owner, (B,) + owner.shape)
             versions_b = np.broadcast_to(versions, (B,) + versions.shape)
             masks_b = np.broadcast_to(masks, (B,) + masks.shape)
-            state = RouterState(
+            return (RouterState(
                 crdt=CrdtState(put(owners_b),
                                put(versions_b),
                                put(owners_b)),  # identity = shard
-                topic_masks=put(masks_b))
-            live_dev = put(np.broadcast_to(live, (B, B)))
-            if state_rev is not None:
-                self._dev_state, self._dev_liveness = state, live_dev
-                self._dev_rev = state_rev
+                topic_masks=put(masks_b)),
+                put(np.broadcast_to(live, (B, B))))
+
+        state, live_dev = self._state_cache.get(state_rev, build_state)
         def put_rows(key, rows, busy_rows):
             """Assemble the [B, ...] byte tensor per device: busy shards
             H2D their own block; idle shards reuse a cached device-side

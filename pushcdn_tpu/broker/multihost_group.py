@@ -371,7 +371,11 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
                                  "(no host fallback plane exists)")
                 self.disabled = True
                 self._stop_requested = True
-                self._halt_aux("step failure")
+                taken = (sum(int(b.valid.sum()) for lane in batches
+                             for b in lane)
+                         + sum(int(d.valid.sum()) for lane in directs
+                               for d in lane))
+                self._halt_aux("step failure", taken=taken)
                 # one last barrier so the peer hosts exit cleanly
                 try:
                     await asyncio.to_thread(self._collective_stop, True)
@@ -382,17 +386,16 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
                 for slot in quarantined:
                     self.slots.free_slot(slot)
 
-    def _halt_aux(self, why: str) -> None:
+    def _halt_aux(self, why: str, taken: int = 0) -> None:
         """Stop republishing claims and account for frames that were
         ACKed STAGED but will never be stepped (no cross-host fallback
-        plane exists — log the loss rather than hide it)."""
+        plane exists — log the loss rather than hide it). ``taken``
+        counts frames already drained out of the rings for a step that
+        then failed — the loss most certain to have happened."""
         if self._dir_task is not None:
             self._dir_task.cancel()
             self._dir_task = None
-        stranded = (sum(r.slots - r.free_slots
-                        for rings in self.lane_rings for r in rings)
-                    + sum(b.total_used
-                          for bkts in self.lane_buckets for b in bkts))
+        stranded = self._staged_total() + taken
         if stranded:
             logger.warning(
                 "multi-host group halted (%s) with %d staged frame(s) "

@@ -177,7 +177,25 @@ async def main() -> None:
 
     client.close()
     await marshal.stop()
-    await broker.stop()   # triggers the collective stop barrier
+    if rank == 0:
+        await broker.stop()   # triggers the collective stop barrier
+    else:
+        # peer retirement must stop the collective HERE too (same barrier
+        # iteration) and flip disabled, so staging fail-fasts instead of
+        # ACKing frames into rings nothing will ever drain
+        for _ in range(200):
+            if group.disabled:
+                break
+            await asyncio.sleep(0.05)
+        assert group.disabled, "peer retirement never disabled the group"
+        from pushcdn_tpu.broker.staging import StageResult
+        from pushcdn_tpu.proto.limiter import Bytes as _Bytes
+        from pushcdn_tpu.proto.message import serialize
+        late = Broadcast(topics=[0], message=b"late")
+        raw = _Bytes(serialize(late))
+        assert group.try_stage(my_shard, late, raw) == \
+            StageResult.INELIGIBLE
+        await broker.stop()
     await group.discovery.close()
     jax.distributed.shutdown()
     print(f"rank {rank}: MULTIHOST OK (steps={group.steps}, "

@@ -141,17 +141,22 @@ async def test_chaos_survivors_lose_nothing():
         drains = [asyncio.create_task(drain(i))
                   for i in range(len(survivors))]
 
-        # the survivor stream: sequenced broadcasts spread over the window
-        interval = CHAOS_SECONDS / SEQ_MSGS
-        payload_tail = os.urandom(512)
-        for seq in range(SEQ_MSGS):
-            await publisher.send_broadcast_message(
-                [0], seq.to_bytes(4, "big") + payload_tail)
-            await asyncio.sleep(interval)
+        try:
+            # the survivor stream: sequenced broadcasts over the window
+            interval = CHAOS_SECONDS / SEQ_MSGS
+            payload_tail = os.urandom(512)
+            for seq in range(SEQ_MSGS):
+                await publisher.send_broadcast_message(
+                    [0], seq.to_bytes(4, "big") + payload_tail)
+                await asyncio.sleep(interval)
 
-        async with asyncio.timeout(60):
-            await asyncio.gather(*drains)
-        stop.set()
+            async with asyncio.timeout(60):
+                await asyncio.gather(*drains)
+        finally:
+            # a failing drain must not leave churn running into teardown
+            stop.set()
+            for t in drains:
+                t.cancel()
         chaos_results = await asyncio.gather(*chaos, return_exceptions=True)
         for r in chaos_results:
             assert not isinstance(r, BaseException) \
