@@ -204,3 +204,7 @@ class Broker:
     def update_metrics(self) -> None:
         broker_metrics.NUM_USERS_CONNECTED.set(self.connections.num_users)
         broker_metrics.NUM_BROKERS_CONNECTED.set(self.connections.num_brokers)
+        plane = self.device_plane
+        if plane is not None:
+            broker_metrics.DEVICE_STEPS.set(plane.steps)
+            broker_metrics.DEVICE_MESSAGES_ROUTED.set(plane.messages_routed)

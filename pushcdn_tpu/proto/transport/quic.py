@@ -129,7 +129,14 @@ SEND_WINDOW_MAX = 2 * 1024 * 1024  # flow-control cap on unacked bytes
                                  # can never overflow the peer's kernel
                                  # buffer outright)
 CWND_INITIAL_SEGS = 16           # initial congestion window (segments)
-MIN_RTO_S = 0.05                 # RTO floor (srtt + 4*rttvar clamped here)
+MIN_RTO_S = 0.2                  # RTO floor (srtt + 4*rttvar clamped here).
+                                 # Generous on purpose: a same-process
+                                 # receiver stalls its event loop tens of ms
+                                 # on big memcpys/TLS records, and a floor
+                                 # below that fires spurious RTOs that
+                                 # collapse cwnd repeatedly (RFC 6298 uses
+                                 # a 1 s floor; fast loss recovery is the
+                                 # dup-ACK path's job, not the timer's)
 PACE_SRTT_FLOOR_S = 0.005        # below this RTT pacing is a no-op (loopback)
 ACK_DELAY_S = 0.02               # delayed-ACK timer (in-order data)
 ACK_EVERY_BYTES = 64 * 1024      # ...or after this many unacked rx bytes

@@ -130,3 +130,37 @@ async def test_idle_bypass_routes_on_host_path():
         c.close()
     finally:
         await cluster.stop()
+
+
+def test_pump_common_helpers():
+    """The shared pump machinery (broker/pump_common.py) both planes use."""
+    from pushcdn_tpu.broker.pump_common import (
+        CoalesceGate, RevCache, effective_users)
+
+    # user-table slice mark: bucket-rounded, clamped, never zero
+    assert effective_users(0, 1024) == 64
+    assert effective_users(1, 1024) == 64
+    assert effective_users(64, 1024) == 64
+    assert effective_users(65, 1024) == 128
+    assert effective_users(5000, 1024) == 1024
+    assert effective_users(10, 32) == 32  # capacity below one bucket
+
+    # coalescing gate: burst-after-idle and saturation step immediately,
+    # a recent-step trickle waits one window
+    g = CoalesceGate(batch_window_s=0.001, coalesce_min_frames=16)
+    assert g.wait_s(1, now=100.0) == 0          # idle: no window
+    g.stepped(100.0)
+    assert g.wait_s(1, now=100.001) == 0.001    # trickle: coalesce
+    assert g.wait_s(16, now=100.001) == 0       # saturated: step now
+    assert g.wait_s(0, now=100.001) == 0        # nothing staged
+    assert g.wait_s(1, now=100.5) == 0          # idle again
+
+    # revision cache: builds once per revision; None never caches
+    cache = RevCache()
+    calls = []
+    assert cache.get(1, lambda: calls.append(1) or "a") == "a"
+    assert cache.get(1, lambda: calls.append(2) or "b") == "a"
+    assert cache.get(2, lambda: calls.append(3) or "c") == "c"
+    assert calls == [1, 3]
+    assert cache.get(None, lambda: calls.append(4) or "w") == "w"
+    assert cache.get(2, lambda: calls.append(5) or "x") == "c"

@@ -305,3 +305,41 @@ async def test_dead_shard_sweep_releases_slots():
     assert not group._liveness[1]
     # swept slot is quarantined until the next step, then reusable
     assert len(group._quarantine) == 1
+
+
+async def test_mid_session_subscribe_over_mesh():
+    """A subscription added AFTER connect must reach the device mirrors
+    (update_mask) and start delivering cross-shard broadcasts; an
+    unsubscribe stops them."""
+    cluster = await MeshCluster(num_shards=2).start(form_host_mesh=False)
+    try:
+        pub = await cluster.place_client(seed=950, shard=0, topics=[0])
+        sub = await cluster.place_client(seed=951, shard=1, topics=[])
+
+        # not subscribed yet: only the publisher (topic 0) receives
+        await pub.send_broadcast_message([1], b"before subscribe")
+        pending = asyncio.create_task(sub.receive_message())
+        await asyncio.sleep(0.3)
+        assert not pending.done(), "unsubscribed client received a broadcast"
+
+        await sub.subscribe([1])
+        await wait_until(lambda: bool(
+            cluster.group._masks[
+                cluster.group.slots.slot_of(sub.public_key)].any()))
+        await pub.send_broadcast_message([1], b"after subscribe")
+        got = await asyncio.wait_for(pending, 10)
+        assert bytes(got.message) == b"after subscribe"
+
+        await sub.unsubscribe([1])
+        await wait_until(lambda: not
+            cluster.group._masks[
+                cluster.group.slots.slot_of(sub.public_key)].any())
+        await pub.send_broadcast_message([1], b"after unsubscribe")
+        late = asyncio.create_task(sub.receive_message())
+        await asyncio.sleep(0.3)
+        assert not late.done(), "unsubscribed client still receives"
+        late.cancel()
+        pub.close()
+        sub.close()
+    finally:
+        await cluster.stop()
