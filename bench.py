@@ -106,7 +106,7 @@ def main() -> None:
         masked = jnp.where(delivered[:, None], frame_bytes, 0)
         return acc + masked.sum(dtype=jnp.int32)
 
-    steps, repeats = 50, 3
+    steps, repeats = 50, 5   # best-of-5: the tunneled chip is noisy
     acc = jnp.zeros((), jnp.int32)
     acc = consume(acc, result.deliver)          # compile before timing
     accb = consume_bytes(acc, result.deliver, batch.frame_bytes)

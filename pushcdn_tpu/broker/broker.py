@@ -151,6 +151,7 @@ class Broker:
         """Spawn the five supervised tasks (lib.rs:269-318)."""
         if self.device_plane is not None:
             await self.device_plane.start()
+        metrics_mod.PRE_RENDER_HOOKS.append(self.update_metrics)
         spawn = asyncio.create_task
         self._tasks = [
             spawn(heartbeat_task.run_heartbeat_task(self), name="heartbeat"),
@@ -176,6 +177,8 @@ class Broker:
 
     async def stop(self) -> None:
         self._stopped.set()
+        if self.update_metrics in metrics_mod.PRE_RENDER_HOOKS:
+            metrics_mod.PRE_RENDER_HOOKS.remove(self.update_metrics)
         if self.device_plane is not None:
             await self.device_plane.stop()
         for t in self._tasks:
@@ -202,6 +205,10 @@ class Broker:
     # -- convenience (used by tasks) ---------------------------------------
 
     def update_metrics(self) -> None:
+        """Refresh the process-global gauges; runs on connection events
+        AND as a metrics pre-render hook, so device-plane counters that
+        move per pump step are current at scrape time without any
+        hot-loop pushes."""
         broker_metrics.NUM_USERS_CONNECTED.set(self.connections.num_users)
         broker_metrics.NUM_BROKERS_CONNECTED.set(self.connections.num_brokers)
         plane = self.device_plane

@@ -391,7 +391,6 @@ class DevicePlane:
                             self.broker, self.slots, streams)
                     else:
                         self._egress(d2, lengths, frames)
-                self.broker.update_metrics()  # steps/routed move per step
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -590,9 +590,6 @@ class MeshBrokerGroup:
                             broker, self.slots, streams)
                     else:
                         self._egress_py(broker, d2, lengths, frames)
-                for b in self.brokers:
-                    if b is not None:
-                        b.update_metrics()  # steps/routed move per step
             except asyncio.CancelledError:
                 raise
             except Exception:

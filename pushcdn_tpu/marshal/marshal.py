@@ -48,6 +48,10 @@ class Marshal:
         self.certificate: Optional[Certificate] = None
         self._accept_task: Optional[asyncio.Task] = None
         self._metrics_server = None
+        # amortize concurrent pairing checks under connection storms
+        # (no-op pass-through for schemes without verify_batch)
+        from pushcdn_tpu.proto.crypto.batch import BatchVerifier
+        self.batch_verifier = BatchVerifier(config.run_def.user_def.scheme)
 
     @classmethod
     async def new(cls, config: MarshalConfig) -> "Marshal":
@@ -83,7 +87,8 @@ class Marshal:
             async with asyncio.timeout(self.config.auth_timeout_s):
                 public_key, permit = await marshal_auth.verify_user(
                     connection, self.discovery,
-                    self.run_def.user_def.scheme)
+                    self.run_def.user_def.scheme,
+                    verifier=self.batch_verifier)
             await connection.soft_close()
         except (Error, asyncio.TimeoutError) as exc:
             logger.info("marshal auth failed: %r", exc)

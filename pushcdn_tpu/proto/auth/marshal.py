@@ -35,20 +35,30 @@ async def _reject(connection: Connection, reason: str):
 
 
 async def verify_user(connection: Connection, discovery: DiscoveryClient,
-                      scheme: Type[SignatureScheme]) -> Tuple[bytes, int]:
+                      scheme: Type[SignatureScheme],
+                      verifier=None) -> Tuple[bytes, int]:
     """Run the marshal side of the handshake on one fresh connection.
 
     Returns ``(user_public_key, permit)`` after replying with the permit and
-    the chosen broker's public endpoint.
+    the chosen broker's public endpoint. ``verifier`` (an optional
+    crypto.batch.BatchVerifier) amortizes concurrent pairing checks under
+    connection storms; semantics are identical to ``scheme.verify``.
     """
     message = await connection.recv_message()
     if not isinstance(message, AuthenticateWithKey):
         await _reject(connection, "expected AuthenticateWithKey")
 
     # signature over the timestamp, namespaced (marshal.rs:66-83)
-    if not scheme.verify(message.public_key, Namespace.USER_MARSHAL_AUTH,
-                         signable_timestamp(message.timestamp),
-                         message.signature):
+    if verifier is not None:
+        sig_ok = await verifier.verify(
+            message.public_key, Namespace.USER_MARSHAL_AUTH,
+            signable_timestamp(message.timestamp), message.signature)
+    else:
+        sig_ok = scheme.verify(message.public_key,
+                               Namespace.USER_MARSHAL_AUTH,
+                               signable_timestamp(message.timestamp),
+                               message.signature)
+    if not sig_ok:
         await _reject(connection, "invalid signature")
     if abs(int(time.time()) - message.timestamp) > TIMESTAMP_TOLERANCE_S:
         await _reject(connection, "timestamp too old")

@@ -1,0 +1,115 @@
+"""Adaptive batching signature verifier for connection storms.
+
+A marshal under a storm verifies many signatures in the same few
+milliseconds; pairing schemes amortize dramatically when those checks
+share one final exponentiation (``BlsBn254Scheme.verify_batch``, ~2.1 ms
+single vs ~1.4 ms/sig at n=6 and falling). Batching here is ADAPTIVE —
+no coalescing timer: the first arrival verifies immediately (an isolated
+auth pays zero extra latency), and anything arriving while a
+verification is in flight queues and runs as the next batch. Under a
+storm the crypto itself is the window.
+
+Semantics are identical to per-item verification:
+
+- batch accepts ⇒ every item is individually valid (random-linear-
+  combination soundness, failure probability 2^-128 per forged item);
+- batch rejects ⇒ at least one item is invalid ⇒ items are re-checked
+  individually IN PARALLEL, so a single forged signature costs the
+  honest co-batched users ~one extra verify of latency, not a serialized
+  sweep (and can never deny them service).
+
+Schemes without ``verify_batch`` (Ed25519 — already microseconds) pass
+straight through. All crypto runs off the event loop (ctypes releases
+the GIL), so a storm's pairings never stall the accept loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Set, Tuple
+
+
+class BatchVerifier:
+    def __init__(self, scheme, max_batch: int = 32):
+        self.scheme = scheme
+        self.max_batch = max_batch
+        self._batchable = hasattr(scheme, "verify_batch")
+        self._inflight = False
+        self._pending: List[Tuple[tuple, asyncio.Future]] = []
+        # strong refs: the loop holds only weak refs to tasks, and a
+        # GC'd batch task would leave _inflight wedged True forever
+        self._tasks: Set[asyncio.Task] = set()
+        # observability (tested, and handy when sizing a deployment)
+        self.batches = 0
+        self.batched_items = 0
+        self.singles = 0
+
+    async def verify(self, public_key: bytes, namespace, message: bytes,
+                     signature: bytes) -> bool:
+        if not self._batchable:
+            # microsecond schemes (Ed25519): a thread handoff would cost
+            # 10x the verify itself — run inline
+            self.singles += 1
+            return self.scheme.verify(public_key, namespace, message,
+                                      signature)
+        item = (public_key, namespace, message, signature)
+        if self._inflight:
+            fut = asyncio.get_running_loop().create_future()
+            self._pending.append((item, fut))
+            return await fut
+        # idle: verify NOW (no window to wait out); arrivals during this
+        # call accumulate into the next batch
+        self._inflight = True
+        try:
+            self.singles += 1
+            return await asyncio.to_thread(self.scheme.verify, *item)
+        finally:
+            self._drain()
+
+    def _drain(self) -> None:
+        """Kick the queued batch (keeps ``_inflight`` until the queue is
+        empty, so a sustained storm stays in batch mode)."""
+        batch, self._pending = (self._pending[:self.max_batch],
+                                self._pending[self.max_batch:])
+        if batch:
+            task = asyncio.ensure_future(self._run(batch))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+        else:
+            self._inflight = False
+
+    async def _run(self, batch) -> None:
+        items = [item for item, _ in batch]
+        try:
+            try:
+                if len(items) == 1:
+                    self.singles += 1
+                    results = [await asyncio.to_thread(
+                        self.scheme.verify, *items[0])]
+                else:
+                    self.batches += 1
+                    self.batched_items += len(items)
+                    ok = await asyncio.to_thread(
+                        self.scheme.verify_batch, items)
+                    if ok:
+                        results = [True] * len(items)
+                    else:
+                        # at least one forgery: identify it in PARALLEL so
+                        # it cannot serialize the honest co-batched users
+                        results = await asyncio.gather(*(
+                            asyncio.to_thread(self.scheme.verify, *it)
+                            for it in items))
+                for (_, fut), ok in zip(batch, results):
+                    if not fut.done():
+                        fut.set_result(ok)
+            except BaseException as exc:
+                # includes CancelledError: waiters must never hang on a
+                # dead batch, and the drain below must still run
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(
+                            exc if isinstance(exc, Exception)
+                            else ConnectionError("batch verify cancelled"))
+                raise
+        finally:
+            self._drain()

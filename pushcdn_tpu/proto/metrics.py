@@ -13,6 +13,7 @@ endpoint is opt-in per binary, matching the reference's `metrics` feature.
 from __future__ import annotations
 
 import asyncio
+import logging
 import threading
 import time
 from typing import Dict, List, Optional
@@ -112,7 +113,27 @@ def observe_message_latency(seconds: float) -> None:
     LATENCY.observe(seconds)
 
 
+# Callables run before every render: components whose counters move on
+# hot paths (device-plane steps) register a refresh here instead of
+# pushing gauge updates from their pump loops.
+PRE_RENDER_HOOKS: list = []
+
+
+_hook_failures: set = set()
+
+
 def render_all() -> str:
+    for hook in list(PRE_RENDER_HOOKS):
+        try:
+            hook()
+        except Exception:
+            # a broken hook must not take down /metrics, but a silently
+            # frozen gauge is an operator trap — log each hook ONCE
+            if id(hook) not in _hook_failures:
+                _hook_failures.add(id(hook))
+                logging.getLogger("pushcdn.metrics").exception(
+                    "metrics pre-render hook %r failed; its gauges are "
+                    "stale from here on", hook)
     return "".join(m.render() for m in _REGISTRY.values())
 
 

@@ -159,3 +159,51 @@ def test_batch_verify_matches_single_semantics():
     bad[7] ^= 1
     assert not BlsBn254Scheme.verify_batch(
         [(kp.public_key, ns, msg, bytes(bad))])
+
+
+async def test_marshal_batches_storm_verifications():
+    """Under a connection storm the marshal amortizes pairing checks via
+    the micro-batching verifier (crypto/batch.py): concurrent auths share
+    one batched verification, and a forged item in a batch neither passes
+    nor denies service to the honest co-batched users."""
+    from pushcdn_tpu.testing import Cluster
+
+    cluster = await Cluster(num_brokers=1, scheme=BlsBn254Scheme).start()
+    try:
+        clients = [cluster.client(seed=95_000 + i, topics=[0])
+                   for i in range(10)]
+        await asyncio.gather(*(c.ensure_initialized() for c in clients))
+        bv = cluster.marshal.batch_verifier
+        # 10 auths fired in one gather: the first verifies solo and the
+        # rest overlap its ~2 ms pairing, so at least one real batch forms
+        assert bv.batches >= 1, (bv.batches, bv.singles)
+        assert bv.batched_items >= 2  # real amortization happened
+        # everyone actually authenticated end to end
+        await clients[0].send_broadcast_message([0], b"storm ok")
+        for c in clients:
+            got = await asyncio.wait_for(c.receive_message(), 10)
+            assert bytes(got.message) == b"storm ok"
+        for c in clients:
+            c.close()
+    finally:
+        await cluster.stop()
+
+
+async def test_batch_verifier_isolates_forgery():
+    from pushcdn_tpu.proto.crypto.batch import BatchVerifier
+    from pushcdn_tpu.proto.crypto.signature import Namespace
+
+    bv = BatchVerifier(BlsBn254Scheme, max_batch=8)
+    ns = Namespace.USER_MARSHAL_AUTH
+    async def one(seed, forge):
+        kp = BlsBn254Scheme.generate_keypair(seed=seed)
+        msg = b"storm %d" % seed
+        sig = BlsBn254Scheme.sign(kp.private_key, ns, msg)
+        if forge:
+            sig = bytes(sig[:-1]) + bytes([sig[-1] ^ 1])
+        return await bv.verify(kp.public_key, ns, msg, sig)
+    results = await asyncio.gather(
+        one(1, False), one(2, True), one(3, False), one(4, False))
+    assert results == [True, False, True, True]
+    # adaptive batching: the first verified solo, 2-4 batched behind it
+    assert bv.batches == 1 and bv.batched_items == 3
