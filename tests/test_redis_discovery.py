@@ -69,6 +69,9 @@ class FakeRedis:
     async def sismember(self, key, member):
         return member in self.sets.get(key, set())
 
+    async def mget(self, keys):
+        return [self._live(k) for k in keys]
+
     async def scan_iter(self, match="*"):
         for key in list(self.kv):
             if self._live(key) is not None and fnmatch.fnmatch(key, match):
@@ -184,3 +187,29 @@ async def test_no_brokers_is_an_error():
     marshal = make(fake, None)
     with pytest.raises(Error):
         await marshal.get_with_least_connections()
+
+
+async def test_user_slot_directory_roundtrip_and_newest_wins():
+    """The multi-host user-slot directory over Redis: publish/read/drop,
+    TTL aging, and the newest-claim-wins conflict rule (a loser host's
+    TTL republication must not overwrite the winner's newer claim)."""
+    fake = FakeRedis()
+    d = make(fake, None)
+    await d.publish_user_slots({b"alice": (3, 100.0)}, ttl_s=30)
+    assert await d.get_user_slots() == {b"alice": (3, 100.0)}
+
+    # stale republication (older ts) loses; newer claim wins
+    await d.publish_user_slots({b"alice": (9, 50.0)}, ttl_s=30)
+    assert (await d.get_user_slots())[b"alice"] == (3, 100.0)
+    await d.publish_user_slots({b"alice": (7, 200.0)}, ttl_s=30)
+    assert (await d.get_user_slots())[b"alice"] == (7, 200.0)
+
+    # TTL expiry ages claims out like broker heartbeats
+    fake.advance(31)
+    assert await d.get_user_slots() == {}
+
+    # explicit drop on release
+    await d.publish_user_slots({b"bob": (1, 1.0), b"carol": (2, 2.0)},
+                               ttl_s=30)
+    await d.drop_user_slots([b"bob"])
+    assert await d.get_user_slots() == {b"carol": (2, 2.0)}
