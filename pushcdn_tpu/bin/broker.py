@@ -36,6 +36,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--global-permits", action="store_true")
     p.add_argument("--scheme", default="ed25519",
                    help="signature scheme: ed25519 | bls-bn254")
+    # ---- device data plane (the TPU path) -----------------------------
+    p.add_argument("--device-plane", action="store_true",
+                   help="route eligible messages through the attached "
+                        "device (single-shard plane; see --multihost for "
+                        "the cross-host mesh group)")
+    p.add_argument("--device-ring-slots", type=int, default=1024)
+    p.add_argument("--device-frame-bytes", type=int, default=2048)
+    p.add_argument("--device-batch-window", type=float, default=0.001,
+                   help="seconds; the coalescing window for trickle "
+                        "traffic (bursts and idle arrivals skip it)")
+    # ---- multi-host SPMD mesh group (jax.distributed) -----------------
+    p.add_argument("--multihost-coordinator", default=None,
+                   help="host:port of the jax.distributed coordinator; "
+                        "enables the cross-host mesh broker group "
+                        "(auto-detected on Cloud TPU if flags are "
+                        "omitted but --mesh-shards is given)")
+    p.add_argument("--multihost-process-id", type=int, default=None)
+    p.add_argument("--multihost-num-processes", type=int, default=None)
+    p.add_argument("--mesh-shards", type=int, default=None,
+                   help="global broker-mesh shard count; this broker "
+                        "attaches to --mesh-shard (default: first local)")
+    p.add_argument("--mesh-shard", type=int, default=None)
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -44,6 +66,18 @@ async def amain(args: argparse.Namespace) -> None:
     run_def = run_def_from_args(args.broker_transport, args.user_transport,
                                 args.discovery_endpoint, args.num_topics,
                                 args.global_permits, scheme=args.scheme)
+    if args.device_plane and args.mesh_shards is not None:
+        raise SystemExit("--device-plane (single-shard) and --mesh-shards "
+                         "(mesh group) are mutually exclusive")
+    if args.mesh_shard is not None and args.mesh_shards is None:
+        raise SystemExit("--mesh-shard requires --mesh-shards")
+    device_plane = None
+    if args.device_plane:
+        from pushcdn_tpu.broker.device_plane import DevicePlaneConfig
+        device_plane = DevicePlaneConfig(
+            ring_slots=args.device_ring_slots,
+            frame_bytes=args.device_frame_bytes,
+            batch_window_s=args.device_batch_window)
     broker = await Broker.new(BrokerConfig(
         run_def=run_def,
         keypair=keypair_from_seed(args.key_seed, args.scheme),
@@ -55,7 +89,34 @@ async def amain(args: argparse.Namespace) -> None:
         metrics_bind_endpoint=args.metrics_bind_endpoint,
         ca_cert_path=args.ca_cert_path, ca_key_path=args.ca_key_path,
         global_memory_pool_size=args.global_memory_pool_size,
+        device_plane=device_plane,
+        # a mesh-group deployment's inter-broker plane is the device mesh
+        form_mesh=args.mesh_shards is None,
     ))
+    if args.mesh_shards is not None:
+        # cross-host SPMD mesh group: join the distributed runtime, build
+        # the global mesh, attach this broker to its shard
+        from pushcdn_tpu.broker.mesh_group import MeshGroupConfig
+        from pushcdn_tpu.broker.multihost_group import MultiHostBrokerGroup
+        from pushcdn_tpu.parallel import multihost
+        multihost.initialize(args.multihost_coordinator,
+                             args.multihost_num_processes,
+                             args.multihost_process_id)
+        mesh = multihost.pod_broker_mesh(args.mesh_shards)
+        group = MultiHostBrokerGroup(
+            mesh,
+            MeshGroupConfig(ring_slots=args.device_ring_slots,
+                            frame_bytes=args.device_frame_bytes,
+                            batch_window_s=args.device_batch_window),
+            discovery=broker.discovery)
+        shard = (args.mesh_shard if args.mesh_shard is not None
+                 else group.local_shards[0])
+        if shard not in group.local_shards:
+            raise SystemExit(
+                f"--mesh-shard {shard} is not local to this host "
+                f"(local shards: {group.local_shards}) — a non-local "
+                "attachment would silently blackhole traffic")
+        group.attach(broker, shard)
     await broker.run_until_failure()
 
 

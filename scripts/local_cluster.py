@@ -23,7 +23,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def spawn(name: str, *args: str) -> subprocess.Popen:
     env = dict(os.environ)
-    env.setdefault("PYTHONPATH", REPO)
+    env["PYTHONPATH"] = (REPO + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else REPO)
     proc = subprocess.Popen(
         [sys.executable, "-m", f"pushcdn_tpu.bin.{name}", *args],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
@@ -35,6 +36,9 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--duration", type=float, default=20.0)
     ap.add_argument("--base-port", type=int, default=21700)
+    ap.add_argument("--device-plane", action="store_true",
+                    help="brokers route eligible traffic on the attached "
+                         "device (single-shard planes)")
     args = ap.parse_args()
 
     db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-cluster-"), "cdn.sqlite")
@@ -51,6 +55,7 @@ def main() -> int:
                 "--private-bind-endpoint", f"127.0.0.1:{bp + i * 2 + 1}",
                 "--user-transport", "tcp",   # plain tcp for the local demo
                 "--metrics-bind-endpoint", f"127.0.0.1:{bp + 100 + i}",
+                *(["--device-plane"] if args.device_plane else []),
             )))
         time.sleep(1.5)  # brokers register + mesh up
         procs.append(("marshal", spawn(

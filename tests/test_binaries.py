@@ -1,0 +1,103 @@
+"""Binary smoke tier: the production CLIs must actually wire the flags
+they advertise. Runs the broker binary WITH --device-plane as a real OS
+process over TCP, authenticates a client through the marshal binary, and
+proves a burst routed on-device by scraping the broker's /metrics
+endpoint (cdn_device_messages_routed > 0) — CLI → plane → metrics, full
+circle. (The reference's process-compose tier is scripts/local_cluster.py;
+this is the always-on pytest slice of it.)"""
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n: int) -> list:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn(name: str, *args: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (REPO + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", f"pushcdn_tpu.bin.{name}", *args],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+async def test_broker_binary_device_plane_end_to_end(tmp_path):
+    db = str(tmp_path / "cdn.sqlite")
+    pub, priv, metrics, marshal_p = _free_ports(4)
+    procs = []
+    try:
+        procs.append(_spawn(
+            "broker", "--discovery-endpoint", db,
+            "--public-advertise-endpoint", f"127.0.0.1:{pub}",
+            "--public-bind-endpoint", f"127.0.0.1:{pub}",
+            "--private-advertise-endpoint", f"127.0.0.1:{priv}",
+            "--private-bind-endpoint", f"127.0.0.1:{priv}",
+            "--metrics-bind-endpoint", f"127.0.0.1:{metrics}",
+            "--user-transport", "tcp", "--device-plane",
+            "--device-ring-slots", "64"))
+        procs.append(_spawn(
+            "marshal", "--discovery-endpoint", db,
+            "--bind-endpoint", f"127.0.0.1:{marshal_p}",
+            "--user-transport", "tcp"))
+
+        from pushcdn_tpu.client import Client, ClientConfig
+        from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME
+        from pushcdn_tpu.proto.transport import Tcp
+
+        client = Client(ClientConfig(
+            marshal_endpoint=f"127.0.0.1:{marshal_p}",
+            keypair=DEFAULT_SCHEME.generate_keypair(seed=4242),
+            protocol=Tcp, subscribed_topics={0}))
+        async with asyncio.timeout(45):  # binaries cold-start + register
+            await client.ensure_initialized()
+
+        # a pipelined burst beats the idle bypass and rides the device
+        # (budgets stay under conftest's 120 s whole-test cap)
+        for _ in range(3):
+            await asyncio.gather(*(
+                client.send_broadcast_message([0], b"cli burst %d" % i)
+                for i in range(16)))
+            got = 0
+            async with asyncio.timeout(15):
+                while got < 16:
+                    got += len(await client.receive_messages(16 - got))
+            text = await asyncio.to_thread(
+                lambda: urllib.request.urlopen(
+                    f"http://127.0.0.1:{metrics}/metrics",
+                    timeout=5).read().decode())
+            routed = [l for l in text.splitlines()
+                      if l.startswith("cdn_device_messages_routed ")]
+            if routed and float(routed[0].split()[-1]) > 0:
+                break
+        else:
+            raise AssertionError(
+                f"device plane never routed via the CLI broker:\n{text}")
+        client.close()
+        for p in procs:
+            assert p.poll() is None, "a binary died during the test"
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -106,3 +106,54 @@ def test_max_size_enforced_on_deserialize(monkeypatch):
     with pytest.raises(Error) as ei:
         deserialize(b"\x08" + b"z" * 100)
     assert ei.value.kind == ErrorKind.EXCEEDED_SIZE
+
+
+def test_decode_frames_matches_deserialize_fuzz():
+    """The batch chunk decoder must agree with the canonical per-frame
+    path for every message kind and random shapes (it is the client
+    drain's hot loop — a divergence is silent corruption)."""
+    import random
+
+    from pushcdn_tpu.proto.message import decode_frames, deserialize_owned
+
+    rng = random.Random(1234)
+    msgs = []
+    for _ in range(200):
+        kind = rng.randrange(6)
+        if kind == 0:
+            msgs.append(Direct(recipient=rng.randbytes(rng.randrange(0, 64)),
+                               message=rng.randbytes(rng.randrange(0, 300))))
+        elif kind == 1:
+            msgs.append(Broadcast(
+                topics=[rng.randrange(256)
+                        for _ in range(rng.randrange(0, 5))],
+                message=rng.randbytes(rng.randrange(0, 300))))
+        elif kind == 2:
+            msgs.append(Subscribe([rng.randrange(256)
+                                   for _ in range(rng.randrange(0, 4))]))
+        elif kind == 3:
+            msgs.append(Unsubscribe([rng.randrange(256)]))
+        elif kind == 4:
+            msgs.append(UserSync(payload=rng.randbytes(rng.randrange(0, 64))))
+        else:
+            msgs.append(TopicSync(payload=rng.randbytes(rng.randrange(0, 64))))
+    frames = [serialize(m) for m in msgs]
+    # lay the frames out as one chunk buffer (offset/length spans)
+    buf = bytearray()
+    offs, lens = [], []
+    for f in frames:
+        offs.append(len(buf))
+        lens.append(len(f))
+        buf += f
+    decoded = decode_frames(bytes(buf), offs, lens)
+    assert len(decoded) == len(msgs)
+    for got, f in zip(decoded, frames):
+        want = deserialize_owned(f)
+        assert type(got) is type(want)
+        for field in getattr(want, "__slots__", None) or \
+                want.__dataclass_fields__:
+            a, b = getattr(got, field), getattr(want, field)
+            if isinstance(a, (bytes, bytearray, memoryview)):
+                assert bytes(a) == bytes(b), field
+            else:
+                assert a == b, field
