@@ -41,11 +41,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="route eligible messages through the attached "
                         "device (single-shard plane; see --multihost for "
                         "the cross-host mesh group)")
-    p.add_argument("--device-ring-slots", type=int, default=1024)
-    p.add_argument("--device-frame-bytes", type=int, default=2048)
-    p.add_argument("--device-batch-window", type=float, default=0.001,
-                   help="seconds; the coalescing window for trickle "
-                        "traffic (bursts and idle arrivals skip it)")
+    p.add_argument("--device-ring-slots", type=int, default=None,
+                   help="staging ring slots per step (defaults: 1024 "
+                        "single-shard, 256 mesh-group)")
+    p.add_argument("--device-frame-bytes", type=int, default=None,
+                   help="frame slot bytes (default 2048)")
+    p.add_argument("--device-batch-window", type=float, default=None,
+                   help="seconds. Single-shard: the coalescing window for "
+                        "trickle traffic (bursts and idle arrivals skip "
+                        "it; default 1 ms). Mesh group: the LOCKSTEP step "
+                        "cadence every host ticks at (default 1 ms)")
     # ---- multi-host SPMD mesh group (jax.distributed) -----------------
     p.add_argument("--multihost-coordinator", default=None,
                    help="host:port of the jax.distributed coordinator; "
@@ -71,13 +76,20 @@ async def amain(args: argparse.Namespace) -> None:
                          "(mesh group) are mutually exclusive")
     if args.mesh_shard is not None and args.mesh_shards is None:
         raise SystemExit("--mesh-shard requires --mesh-shards")
+    def _overrides():
+        out = {}
+        if args.device_ring_slots is not None:
+            out["ring_slots"] = args.device_ring_slots
+        if args.device_frame_bytes is not None:
+            out["frame_bytes"] = args.device_frame_bytes
+        if args.device_batch_window is not None:
+            out["batch_window_s"] = args.device_batch_window
+        return out
+
     device_plane = None
     if args.device_plane:
         from pushcdn_tpu.broker.device_plane import DevicePlaneConfig
-        device_plane = DevicePlaneConfig(
-            ring_slots=args.device_ring_slots,
-            frame_bytes=args.device_frame_bytes,
-            batch_window_s=args.device_batch_window)
+        device_plane = DevicePlaneConfig(**_overrides())
     broker = await Broker.new(BrokerConfig(
         run_def=run_def,
         keypair=keypair_from_seed(args.key_seed, args.scheme),
@@ -104,10 +116,7 @@ async def amain(args: argparse.Namespace) -> None:
                              args.multihost_process_id)
         mesh = multihost.pod_broker_mesh(args.mesh_shards)
         group = MultiHostBrokerGroup(
-            mesh,
-            MeshGroupConfig(ring_slots=args.device_ring_slots,
-                            frame_bytes=args.device_frame_bytes,
-                            batch_window_s=args.device_batch_window),
+            mesh, MeshGroupConfig(**_overrides()),
             discovery=broker.discovery)
         shard = (args.mesh_shard if args.mesh_shard is not None
                  else group.local_shards[0])

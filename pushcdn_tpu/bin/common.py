@@ -105,3 +105,24 @@ def run_def_from_args(broker_transport: str, user_transport: str,
 def keypair_from_seed(seed: Optional[int],
                       scheme: str = "ed25519") -> KeyPair:
     return scheme_by_name(scheme).generate_keypair(seed=seed)
+
+
+def spawn_binary(name: str, *args: str, env_extra=None):
+    """Launch ``pushcdn_tpu.bin.<name>`` as a child process with the repo
+    prepended to PYTHONPATH (setdefault breaks under any preexisting
+    PYTHONPATH, e.g. an accelerator site dir) — the one spawner the local
+    cluster runner and the binary smoke tests share."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (repo + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else repo)
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.Popen(
+        [sys.executable, "-m", f"pushcdn_tpu.bin.{name}", *args],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)

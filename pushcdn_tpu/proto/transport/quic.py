@@ -145,7 +145,7 @@ DUP_ACK_FAST_RETX = 3            # NewReno-style fast retransmit threshold
 RTO_BURST = 64                   # segments re-sent per RTO expiry
 RTO_INITIAL_S = 0.2
 RTO_MAX_S = 2.0
-MAX_RETX = 12                    # ~12 s of retries before declaring the peer dead
+MAX_RETX = 12                    # ~20 s of backoff retries before declaring the peer dead
 KEEPALIVE_S = 5.0                # parity: quinn keep_alive_interval 5 s
 IDLE_TIMEOUT_S = 30.0
 SOFT_CLOSE_WAIT_S = 3.0          # parity: quic.rs waits 3 s for `stopped`

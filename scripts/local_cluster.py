@@ -21,13 +21,12 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+sys.path.insert(0, REPO)
+from pushcdn_tpu.bin.common import spawn_binary  # noqa: E402
+
+
 def spawn(name: str, *args: str) -> subprocess.Popen:
-    env = dict(os.environ)
-    env["PYTHONPATH"] = (REPO + os.pathsep + env["PYTHONPATH"]
-                         if env.get("PYTHONPATH") else REPO)
-    proc = subprocess.Popen(
-        [sys.executable, "-m", f"pushcdn_tpu.bin.{name}", *args],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    proc = spawn_binary(name, *args)
     print(f"[cluster] {name} up (pid {proc.pid})")
     return proc
 

@@ -29,14 +29,10 @@ def _free_ports(n: int) -> list:
 
 
 def _spawn(name: str, *args: str) -> subprocess.Popen:
-    env = dict(os.environ)
-    env["PYTHONPATH"] = (REPO + os.pathsep + env["PYTHONPATH"]
-                         if env.get("PYTHONPATH") else REPO)
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    return subprocess.Popen(
-        [sys.executable, "-m", f"pushcdn_tpu.bin.{name}", *args],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True)
+    from pushcdn_tpu.bin.common import spawn_binary
+    return spawn_binary(name, *args,
+                        env_extra={"JAX_PLATFORMS":
+                                   os.environ.get("JAX_PLATFORMS", "cpu")})
 
 
 async def test_broker_binary_device_plane_end_to_end(tmp_path):
