@@ -343,3 +343,68 @@ async def test_mid_session_subscribe_over_mesh():
         sub.close()
     finally:
         await cluster.stop()
+
+
+async def test_mesh_chaos_shard_death_under_load():
+    """Device-mesh chaos tier: a shard dies MID-STREAM while a publisher
+    keeps sending; survivors receive every message published after the
+    death settles, and the group neither disables nor leaks the dead
+    shard's slots."""
+    cluster = await MeshCluster(num_shards=4, ring_slots=32).start(
+        form_host_mesh=False)
+    try:
+        pub = await cluster.place_client(seed=980, shard=0, topics=[0])
+        doomed = await cluster.place_client(seed=981, shard=2, topics=[0])
+        survivors = [pub,
+                     await cluster.place_client(seed=982, shard=1,
+                                                topics=[0]),
+                     await cluster.place_client(seed=983, shard=3,
+                                                topics=[0])]
+        received = [[] for _ in survivors]
+
+        async def drain(idx):
+            while True:
+                for m in await survivors[idx].receive_messages():
+                    received[idx].append(bytes(m.message))
+
+        drains = [asyncio.create_task(drain(i))
+                  for i in range(len(survivors))]
+        stop_stream = asyncio.Event()
+        sent = []
+
+        async def stream():
+            seq = 0
+            while not stop_stream.is_set():
+                payload = b"chaos-%06d" % seq
+                await pub.send_broadcast_message([0], payload)
+                sent.append(payload)
+                seq += 1
+                await asyncio.sleep(0.01)
+
+        try:
+            streamer = asyncio.create_task(stream())
+            await asyncio.sleep(0.3)             # traffic flowing
+            doomed.close()                       # client gone...
+            await cluster.brokers[2].stop()      # ...and its shard dies
+            await asyncio.sleep(0.5)             # group sweeps + settles
+            # every message sent AFTER the death must reach all survivors
+            post_death_from = len(sent)
+            await asyncio.sleep(1.0)
+            stop_stream.set()
+            await streamer
+            post = sent[post_death_from:]
+            assert post, "stream never progressed after the shard death"
+            await wait_until(
+                lambda: all(set(post) <= set(r) for r in received),
+                timeout=20)
+        finally:
+            stop_stream.set()
+            for t in drains:
+                t.cancel()
+        assert not cluster.group.disabled
+        # the dead shard's slots were swept (no leak pinning broadcasts)
+        assert cluster.group.slots.slot_of(doomed.public_key) is None
+        for c in survivors:
+            c.close()
+    finally:
+        await cluster.stop()
