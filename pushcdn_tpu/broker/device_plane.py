@@ -49,6 +49,7 @@ import numpy as np
 from pushcdn_tpu.broker.pump_common import (
     CoalesceGate,
     RevCache,
+    TopicMaskCache,
     effective_users,
 )
 from pushcdn_tpu.broker.staging import StageResult
@@ -59,7 +60,6 @@ from pushcdn_tpu.parallel.frames import (
     FrameRing,
     UserSlots,
     mask_mirror_shape,
-    mask_of_topics,
     mask_row_of,
     stage_best_fit,
 )
@@ -147,6 +147,7 @@ class DevicePlane:
         # (pump_common.RevCache holds the device copy)
         self._state_rev = 0
         self._state_cache = RevCache()
+        self._tmask_cache = TopicMaskCache(c.topic_words)
         # cached device-side empty lane batches + byte stubs (frame bytes
         # never ride the device on the single-shard plane: the delivery
         # DECISION comes back, payloads egress from the host ring snapshot)
@@ -223,10 +224,9 @@ class DevicePlane:
         if isinstance(message, Broadcast):
             if self._unmirrored:
                 return StageResult.INELIGIBLE  # would miss unmirrored users
-            if any(int(t) >= 32 * self.config.topic_words
-                   for t in message.topics):
+            mask, out_of_range = self._tmask_cache.resolve(message.topics)
+            if out_of_range:
                 return StageResult.INELIGIBLE  # beyond the configured space
-            mask = mask_of_topics(message.topics, self.config.topic_words)
             if mask == 0:
                 return StageResult.INELIGIBLE
             ok = stage_best_fit(self.rings, len(frame),
@@ -266,12 +266,9 @@ class DevicePlane:
             if isinstance(message, Broadcast):
                 if self._unmirrored:
                     continue
-                if any(int(t) >= 32 * self.config.topic_words
-                       for t in message.topics):
-                    continue
-                mask = mask_of_topics(message.topics,
-                                      self.config.topic_words)
-                if mask == 0:
+                mask, out_of_range = self._tmask_cache.resolve(
+                    message.topics)
+                if out_of_range or mask == 0:
                     continue
                 kind, dest = KIND_BROADCAST, -1
             elif isinstance(message, Direct):

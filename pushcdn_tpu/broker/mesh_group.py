@@ -42,6 +42,7 @@ import numpy as np
 from pushcdn_tpu.broker.pump_common import (
     CoalesceGate,
     RevCache,
+    TopicMaskCache,
     effective_users,
 )
 from pushcdn_tpu.broker.tasks.senders import (
@@ -55,7 +56,6 @@ from pushcdn_tpu.parallel.frames import (
     FrameRing,
     UserSlots,
     mask_mirror_shape,
-    mask_of_topics,
     mask_row_of,
     slice_batch,
     slice_direct_batch,
@@ -220,6 +220,7 @@ class MeshBrokerGroup:
         # state pays zero H2D for the user table)
         self._state_rev = 0
         self._state_cache = RevCache()  # (RouterState, liveness) on device
+        self._tmask_cache = TopicMaskCache(c.topic_words)
         # cached device-side EMPTY lane batches: an idle lane re-uses its
         # device arrays, paying zero stack/H2D per step (keying the jit
         # cache on lane SUBSETS instead would recompile per traffic mix)
@@ -423,10 +424,9 @@ class MeshBrokerGroup:
         if isinstance(message, Broadcast):
             if self._unmirrored:
                 return self._overflow()
-            if any(int(t) >= 32 * self.config.topic_words
-                   for t in message.topics):
+            mask, out_of_range = self._tmask_cache.resolve(message.topics)
+            if out_of_range:
                 return self._overflow()
-            mask = mask_of_topics(message.topics, self.config.topic_words)
             if mask == 0:
                 return StageResult.INELIGIBLE  # no valid topics: no-op send
             ok = stage_best_fit(
@@ -471,13 +471,14 @@ class MeshBrokerGroup:
                 self._overflow()
                 continue
             if isinstance(message, Broadcast):
-                if self._unmirrored or any(
-                        int(t) >= 32 * self.config.topic_words
-                        for t in message.topics):
+                if self._unmirrored:  # short-circuit before mask work
                     self._overflow()
                     continue
-                mask = mask_of_topics(message.topics,
-                                      self.config.topic_words)
+                mask, out_of_range = self._tmask_cache.resolve(
+                    message.topics)
+                if out_of_range:
+                    self._overflow()
+                    continue
                 if mask == 0:
                     continue  # no valid topics: no-op send
                 placed = False
