@@ -170,6 +170,27 @@ async def test_single_process_group_routes_and_directory(tmp_path):
             raise AssertionError("release never dropped the claim")
 
         assert not group.disabled
+
+        # partial retirement: one of the host's brokers stops — the
+        # collective keeps running (other local brokers depend on it)
+        await group.on_shard_stopped(0)
+        assert group._task is not None and not group._stop_requested
+        assert not group.disabled
+        # shard 2 still routes: a direct to bob from shard 2 delivers
+        bob_conn2 = FakeUserConnection()
+        brokers[1].connections.users[b"bob-pk"] = bob_conn2
+        group.claim_user(2, b"bob-pk", [0])
+        wire2 = serialize(Broadcast(topics=[0], message=b"after partial"))
+        assert planes[1].try_stage(
+            Broadcast(topics=[0], message=b"after partial"),
+            Bytes(wire2)) == StageResult.STAGED
+        for _ in range(100):
+            if bob_conn2.streams:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise AssertionError("group stopped routing after a partial "
+                                 "host retirement")
     finally:
         await group.on_shard_stopped(0)
         await group.on_shard_stopped(2)
