@@ -164,3 +164,47 @@ def test_pump_common_helpers():
     assert calls == [1, 3]
     assert cache.get(None, lambda: calls.append(4) or "w") == "w"
     assert cache.get(2, lambda: calls.append(5) or "x") == "c"
+
+
+async def test_device_plane_fail_open_to_host_path():
+    """A failing device step must not lose acked frames: the staged batch
+    re-routes over the host path, the plane disables itself, and the
+    broker keeps serving as a plain host broker (fail-open, matching the
+    reference's any-core-failure posture)."""
+    from pushcdn_tpu.broker.device_plane import DevicePlaneConfig
+
+    cluster = await Cluster(num_brokers=1, device_plane=DevicePlaneConfig(
+        num_user_slots=32, ring_slots=64, frame_bytes=1024,
+        batch_window_s=0.002, bypass_max_items=0)).start()
+    try:
+        a = cluster.client(seed=1100, topics=[0])
+        b = cluster.client(seed=1101, topics=[0])
+        await a.ensure_initialized()
+        await b.ensure_initialized()
+        device = cluster.brokers[0].device_plane
+
+        # sanity: the plane routes before the failure
+        await a.send_broadcast_message([0], b"pre-failure")
+        for c in (a, b):
+            got = await asyncio.wait_for(c.receive_message(), 10)
+            assert bytes(got.message) == b"pre-failure"
+
+        # break the step underneath the pump
+        def boom(*args, **kwargs):
+            raise RuntimeError("injected device failure")
+        device._run_step = boom
+
+        await a.send_broadcast_message([0], b"survives the failure")
+        for c in (a, b):
+            got = await asyncio.wait_for(c.receive_message(), 10)
+            assert bytes(got.message) == b"survives the failure"
+
+        await wait_until(lambda: device.disabled)
+        # the broker is now a plain host broker; traffic still flows
+        await b.send_direct_message(a.public_key, b"host path onward")
+        got = await asyncio.wait_for(a.receive_message(), 10)
+        assert bytes(got.message) == b"host path onward"
+        a.close()
+        b.close()
+    finally:
+        await cluster.stop()
