@@ -249,3 +249,35 @@ async def test_close_with_queued_bare_frame_returns_pool_bytes():
     assert pool_lim.pool.available == 32 * 1024
     client.close()
     await listener.close()
+
+
+async def test_send_encoded_nowait_bounded_queue_fails_fast():
+    """The device-plane egress handoff must FAIL (QueueFull), never block,
+    when a slow consumer's bounded send queue is full — that failure is
+    what triggers the sender-side removal policy, so one stalled client
+    cannot stall the pump."""
+    import asyncio
+
+    from pushcdn_tpu.proto.limiter import Limiter
+    from pushcdn_tpu.proto.transport.memory import (
+        gen_testing_connection_pair,
+    )
+
+    a, b = await gen_testing_connection_pair(
+        Limiter(None, per_connection_queue=2))
+    try:
+        # the peer never reads and the writer stalls on the tiny duplex
+        # window, so entries pile up in the bounded send queue
+        big = b"\x00" * 64 * 1024
+        for _ in range(8):
+            try:
+                a.send_encoded_nowait(
+                    len(big).to_bytes(4, "big") + big)
+            except asyncio.QueueFull:
+                break
+            await asyncio.sleep(0)
+        else:
+            raise AssertionError("bounded queue never filled")
+    finally:
+        a.close()
+        b.close()
