@@ -97,3 +97,14 @@ async def test_broker_binary_device_plane_end_to_end(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_every_binary_parses_help():
+    """All six CLIs must at least import and build their parsers — the
+    load binaries (bad_*) have no other automated exercise as modules."""
+    for name in ("broker", "marshal", "client",
+                 "bad_broker", "bad_connector", "bad_sender"):
+        p = _spawn(name, "--help")
+        out, _ = p.communicate(timeout=60)
+        assert p.returncode == 0, f"{name} --help failed:\n{out}"
+        assert "usage" in out.lower(), out[:200]
