@@ -85,26 +85,21 @@ class TopicMaskCache:
     """Per-plane memo of topic-list -> (mask, any_out_of_range): consensus
     traffic repeats a handful of topic sets per deployment, and the
     per-message mask_of_topics loop + range scan showed up in the ingest
-    profile. Bounded; cleared wholesale on overflow (sets are few)."""
+    profile. Bounds/eviction come from the shared BoundedTopicMemo
+    policy (proto.topic)."""
 
     __slots__ = ("words", "_memo")
 
     def __init__(self, topic_words: int):
+        from pushcdn_tpu.proto.topic import BoundedTopicMemo
         self.words = topic_words
-        self._memo = {}
+        self._memo = BoundedTopicMemo()
 
     def resolve(self, topics):
-        key = topics if type(topics) is tuple else tuple(topics)
-        hit = self._memo.get(key)
-        if hit is None:
-            limit = 32 * self.words
-            hit = (mask_of_topics(key, self.words),
-                   any(int(t) >= limit for t in key))
-            # cache only deployment-sized sets: the wire allows 65535
-            # topics per message, and retaining adversarial unique
-            # tuples would grow the memo into GiBs before the clear
-            if len(key) <= 16:
-                if len(self._memo) >= 4096:
-                    self._memo.clear()
-                self._memo[key] = hit
-        return hit
+        limit = 32 * self.words
+
+        def compute(key):
+            return (mask_of_topics(key, self.words),
+                    any(int(t) >= limit for t in key))
+
+        return self._memo.get(topics, compute)

@@ -23,6 +23,38 @@ class TestTopic(enum.IntEnum):
     DA = 1
 
 
+class BoundedTopicMemo:
+    """Bounded memo for pure functions of a topic tuple: only
+    deployment-sized keys (<=16 topics) are retained — the wire allows
+    65535 topics per message, and caching adversarial unique tuples
+    would grow a memo into GiBs — and the table clears wholesale at
+    4096 entries. One policy, shared by TopicSpace.prune and the device
+    planes' TopicMaskCache."""
+
+    __slots__ = ("_memo",)
+
+    MAX_KEY_TOPICS = 16
+    MAX_ENTRIES = 4096
+
+    def __init__(self):
+        self._memo = {}
+
+    def get(self, topics, compute):
+        """Return compute(key) memoized; ``key`` is the tuple form."""
+        key = topics if type(topics) is tuple else tuple(topics)
+        hit = self._memo.get(key)
+        if hit is None:
+            hit = compute(key)
+            if len(key) <= self.MAX_KEY_TOPICS:
+                if len(self._memo) >= self.MAX_ENTRIES:
+                    self._memo.clear()
+                self._memo[key] = hit
+        return hit
+
+    def __len__(self):
+        return len(self._memo)
+
+
 @dataclass(frozen=True)
 class TopicSpace:
     """The set of valid topic values for a deployment.
@@ -34,6 +66,11 @@ class TopicSpace:
 
     valid: frozenset[int]
 
+    def __post_init__(self):
+        # prune() runs once per received broadcast on every broker, and
+        # deployments publish a handful of distinct topic sets — memoize
+        object.__setattr__(self, "_prune_memo", BoundedTopicMemo())
+
     @classmethod
     def from_enum(cls, topic_enum) -> "TopicSpace":
         return cls(frozenset(int(t) for t in topic_enum))
@@ -43,20 +80,25 @@ class TopicSpace:
         """Topic space 0..n-1 (bitmask-friendly; n ≤ 256)."""
         return cls(frozenset(range(n)))
 
-    def prune(self, topics: Sequence[int]) -> tuple[List[int], bool]:
-        """Return (valid-deduped-topics, had_invalid)."""
-        seen = set()
-        out: List[int] = []
-        had_invalid = False
-        for t in topics:
-            t = int(t)
-            if t not in self.valid:
-                had_invalid = True
-                continue
-            if t not in seen:
-                seen.add(t)
-                out.append(t)
-        return out, had_invalid
+    def prune(self, topics: Sequence[int]) -> tuple[tuple, bool]:
+        """Return (valid-deduped-topics, had_invalid). The topic
+        sequence comes back as an immutable TUPLE: results are shared by
+        the memo, and a tuple makes that structurally safe."""
+        def compute(key):
+            seen = set()
+            out: List[int] = []
+            had_invalid = False
+            for t in key:
+                t = int(t)
+                if t not in self.valid:
+                    had_invalid = True
+                    continue
+                if t not in seen:
+                    seen.add(t)
+                    out.append(t)
+            return tuple(out), had_invalid
+
+        return self._prune_memo.get(topics, compute)
 
     def bitmask(self, topics: Iterable[int]) -> int:
         """Pack a topic set into an int bitmask (device representation)."""
