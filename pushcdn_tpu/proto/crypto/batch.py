@@ -14,25 +14,42 @@ Semantics are identical to per-item verification:
 - batch accepts ⇒ every item is individually valid (random-linear-
   combination soundness, failure probability 2^-128 per forged item);
 - batch rejects ⇒ at least one item is invalid ⇒ items are re-checked
-  individually IN PARALLEL, so a single forged signature costs the
-  honest co-batched users ~one extra verify of latency, not a serialized
-  sweep (and can never deny them service).
+  individually — in parallel threads on multi-core hosts, so a single
+  forged signature costs the honest co-batched users ~one extra verify
+  of latency; on a single hardware thread the re-check is necessarily
+  sequential (parallelism cannot exist there) but still yields to the
+  loop between pairings, and can never deny honest users service.
 
 Schemes without ``verify_batch`` (Ed25519 — already microseconds) pass
-straight through. All crypto runs off the event loop (ctypes releases
-the GIL), so a storm's pairings never stall the accept loop.
+straight through. On multi-core hosts all crypto runs off the event loop
+(ctypes releases the GIL), so a storm's pairings never stall the accept
+loop. On a single hardware thread an offload buys no parallelism and
+costs two context switches per auth (~0.3-0.7 ms measured), so there the
+verifier runs pairings inline and yields to the loop around each one —
+co-arrivals still coalesce into batches between the yields.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import List, Set, Tuple
+import os
+from typing import List, Optional, Set, Tuple
 
 
 class BatchVerifier:
-    def __init__(self, scheme, max_batch: int = 32):
+    def __init__(self, scheme, max_batch: int = 32,
+                 offload: Optional[bool] = None):
         self.scheme = scheme
         self.max_batch = max_batch
+        if offload is None:
+            # usable CPUs, not machine CPUs: a marshal pinned to one core
+            # by taskset/cgroups should take the inline path too
+            try:
+                usable = len(os.sched_getaffinity(0))
+            except (AttributeError, OSError):
+                usable = os.cpu_count() or 1
+            offload = usable > 1
+        self._offload = offload
         self._batchable = hasattr(scheme, "verify_batch")
         self._inflight = False
         self._pending: List[Tuple[tuple, asyncio.Future]] = []
@@ -62,9 +79,23 @@ class BatchVerifier:
         self._inflight = True
         try:
             self.singles += 1
-            return await asyncio.to_thread(self.scheme.verify, *item)
+            return await self._call(self.scheme.verify, *item)
         finally:
             self._drain()
+
+    async def _call(self, fn, *args):
+        """Run one crypto call per the offload policy, keeping the
+        batch-formation window alive either way."""
+        if self._offload:
+            return await asyncio.to_thread(fn, *args)
+        result = fn(*args)
+        # the loop was blocked for the call's duration: co-arrivals are
+        # queued behind it. Two passes let their handler chains (reader
+        # wakeup, then the handler itself) reach verify() and register in
+        # _pending before _drain decides whether a batch formed.
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        return result
 
     def _drain(self) -> None:
         """Kick the queued batch (keeps ``_inflight`` until the queue is
@@ -84,21 +115,28 @@ class BatchVerifier:
             try:
                 if len(items) == 1:
                     self.singles += 1
-                    results = [await asyncio.to_thread(
-                        self.scheme.verify, *items[0])]
+                    results = [await self._call(self.scheme.verify,
+                                                *items[0])]
                 else:
                     self.batches += 1
                     self.batched_items += len(items)
-                    ok = await asyncio.to_thread(
-                        self.scheme.verify_batch, items)
+                    ok = await self._call(self.scheme.verify_batch, items)
                     if ok:
                         results = [True] * len(items)
-                    else:
+                    elif self._offload:
                         # at least one forgery: identify it in PARALLEL so
                         # it cannot serialize the honest co-batched users
                         results = await asyncio.gather(*(
                             asyncio.to_thread(self.scheme.verify, *it)
                             for it in items))
+                    else:
+                        # single core: parallelism cannot help; re-check
+                        # sequentially with a yield per item so the loop
+                        # breathes between pairings
+                        results = []
+                        for it in items:
+                            results.append(self.scheme.verify(*it))
+                            await asyncio.sleep(0)
                 for (_, fut), ok in zip(batch, results):
                     if not fut.done():
                         fut.set_result(ok)

@@ -207,3 +207,31 @@ async def test_batch_verifier_isolates_forgery():
     assert results == [True, False, True, True]
     # adaptive batching: the first verified solo, 2-4 batched behind it
     assert bv.batches == 1 and bv.batched_items == 3
+
+
+@pytest.mark.parametrize("offload", [False, True])
+async def test_batch_verifier_offload_modes(offload):
+    """Both offload policies (inline single-core path and the to_thread
+    multi-core path) verify honest items, reject forgeries, and still
+    form batches behind an in-flight verification."""
+    from pushcdn_tpu.proto.crypto.batch import BatchVerifier
+    from pushcdn_tpu.proto.crypto.signature import Namespace
+
+    bv = BatchVerifier(BlsBn254Scheme, max_batch=8, offload=offload)
+    ns = Namespace.USER_MARSHAL_AUTH
+
+    async def one(seed, forge):
+        kp = BlsBn254Scheme.generate_keypair(seed=seed)
+        msg = b"mode %d" % seed
+        sig = BlsBn254Scheme.sign(kp.private_key, ns, msg)
+        if forge:
+            sig = bytes(sig[:-1]) + bytes([sig[-1] ^ 1])
+        return await bv.verify(kp.public_key, ns, msg, sig)
+
+    results = await asyncio.gather(
+        one(11, False), one(12, False), one(13, True), one(14, False))
+    assert results == [True, True, False, True]
+    # every waiter resolved (no future left hanging by either path) and
+    # the batch window stayed alive across the policy's yield/handoff
+    assert bv.batches >= 1
+    assert bv.singles + bv.batched_items == 4
