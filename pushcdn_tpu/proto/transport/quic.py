@@ -48,7 +48,7 @@ Packet layout (all integers big-endian):
     [1B type][8B conn_id][type-specific]
     SYN/SYNACK/PING/RST: nothing further
     DATA:   [8B stream offset][payload <= negotiated MTU]
-    ACK:    [8B cumulative ack offset]
+    ACK:    [8B cumulative ack offset][4B ack_delay us]
     FIN:    [8B final stream offset]
     FINACK: nothing further
     PROBE:  [4B datagram length][zero padding to that length]
@@ -113,6 +113,10 @@ def _grow_socket_buffers(transport) -> None:
 _HDR = struct.Struct(">BQ")      # type, conn_id
 _OFF = struct.Struct(">Q")       # stream offset / ack offset
 _PLEN = struct.Struct(">I")      # probe datagram length
+_ACK_DELAY = struct.Struct(">I")  # ACK-held time, microseconds (QUIC's
+                                  # ack_delay: subtracted from RTT samples
+                                  # so delayed ACKs don't inflate srtt and
+                                  # spuriously activate pacing/RTO growth)
 
 MTU_PAYLOAD = 1200               # conservative floor; fits any sane path MTU
 _DATA_OVERHEAD = _HDR.size + _OFF.size
@@ -140,6 +144,10 @@ MIN_RTO_S = 0.2                  # RTO floor (srtt + 4*rttvar clamped here).
 PACE_SRTT_FLOOR_S = 0.005        # below this RTT pacing is a no-op (loopback)
 ACK_DELAY_S = 0.02               # delayed-ACK timer (in-order data)
 ACK_EVERY_BYTES = 64 * 1024      # ...or after this many unacked rx bytes
+ACK_EVERY_DATAGRAMS = 2          # ...or every 2nd data datagram (QUIC's
+                                 # max_ack_delay companion rule: keeps the
+                                 # sender ACK-clocked during slow start
+                                 # when datagrams are still MTU-small)
 SOCK_BUF = 4 * 1024 * 1024       # kernel socket buffers (burst absorption)
 DUP_ACK_FAST_RETX = 3            # NewReno-style fast retransmit threshold
 RTO_BURST = 64                   # segments re-sent per RTO expiry
@@ -191,6 +199,8 @@ class _UdpStream(RawStream):
         self._recover = 0                        # NewReno recovery point
         self._srtt: Optional[float] = None
         self._rttvar = 0.0
+        self._min_rtt: Optional[float] = None  # true-path floor: immune to
+                                               # scheduling-contention spikes
         self._pace_tokens = self._cwnd
         self._pace_last = time.monotonic()
         self._last_retx_t = 0.0   # RTT-sample epoch (Karn, strengthened)
@@ -204,6 +214,9 @@ class _UdpStream(RawStream):
         self._eof = False
         self._last_acked_rx = 0                  # _expected at last ACK sent
         self._ack_handle = None                  # pending delayed-ACK timer
+        self._ack_pending_since = None           # arrival time of oldest
+                                                 # in-order byte not yet ACKed
+        self._rx_since_ack = 0                   # data datagrams since last ACK
 
         self._error: Optional[Exception] = None
         self._closed = False
@@ -224,6 +237,11 @@ class _UdpStream(RawStream):
                 # retransmitting sender converges
                 self._flush_ack()
             elif off == self._expected:
+                # QUIC semantics: ack_delay is measured from the arrival
+                # of the NEWEST data the ACK covers (the sender keys its
+                # RTT sample to the newest acked segment), so overwrite on
+                # every in-order arrival rather than set-once
+                self._ack_pending_since = self._last_recv
                 self._rbuf += payload
                 self._expected += len(payload)
                 while self._expected in self._ooo:
@@ -231,9 +249,15 @@ class _UdpStream(RawStream):
                     self._rbuf += seg
                     self._expected += len(seg)
                 self._rbuf_wake.set()
-                # in-order: delay the ACK (timer or byte threshold) — this
-                # halves datagram count on bulk transfers
-                if self._expected - self._last_acked_rx >= ACK_EVERY_BYTES:
+                # in-order: delay the ACK — flushed by the QUIC-standard
+                # every-2nd-datagram rule (keeps slow start ACK-clocked
+                # while datagrams are small), the byte threshold (bounds
+                # ACK latency once MTU probing makes datagrams huge), or
+                # the timer
+                self._rx_since_ack += 1
+                if (self._rx_since_ack >= ACK_EVERY_DATAGRAMS
+                        or self._expected - self._last_acked_rx
+                        >= ACK_EVERY_BYTES):
                     self._flush_ack()
                 else:
                     self._schedule_ack()
@@ -259,6 +283,14 @@ class _UdpStream(RawStream):
                     self._mtu = max(self._mtu, plen - _DATA_OVERHEAD)
         elif ptype == _ACK:
             ack = _OFF.unpack_from(body)[0]
+            ack_delay_s = 0.0
+            if len(body) >= _OFF.size + _ACK_DELAY.size:
+                # clamp to what a well-behaved peer can legitimately hold
+                # (timer + scheduling slack — QUIC's max_ack_delay clamp):
+                # an inflated field must not pin min_rtt/srtt to the floor
+                ack_delay_s = min(
+                    _ACK_DELAY.unpack_from(body, _OFF.size)[0] / 1e6,
+                    2.0 * ACK_DELAY_S)
             now = time.monotonic()
             if ack > self._acked:
                 newly = ack - self._acked
@@ -278,7 +310,11 @@ class _UdpStream(RawStream):
                     self._send_order.popleft()
                     self._unacked.pop(off, None)
                 if rtt_sample is not None:
-                    self._rtt_update(rtt_sample)
+                    # QUIC semantics: the peer held this ACK (delayed-ACK
+                    # timer / byte threshold); that hold time is not path
+                    # RTT. Clamp at a 50 us floor so a mis-reported delay
+                    # can't zero the estimator.
+                    self._rtt_update(max(rtt_sample - ack_delay_s, 5e-5))
                 if self._in_recovery:
                     if ack >= self._recover:
                         # full recovery: deflate to ssthresh
@@ -358,12 +394,22 @@ class _UdpStream(RawStream):
 
     # -- delayed ACKs --------------------------------------------------------
 
+    def _ack_delay_us(self) -> int:
+        """Time this ACK's newest-covered data sat waiting, microseconds."""
+        since, self._ack_pending_since = self._ack_pending_since, None
+        if since is None:
+            return 0
+        held = time.monotonic() - since
+        return min(0xFFFFFFFF, max(0, int(held * 1e6)))
+
     def _flush_ack(self) -> None:
         if self._ack_handle is not None:
             self._ack_handle.cancel()
             self._ack_handle = None
         self._last_acked_rx = self._expected
-        self._tx(_ACK, _OFF.pack(self._expected))
+        self._rx_since_ack = 0
+        self._tx(_ACK, _OFF.pack(self._expected)
+                 + _ACK_DELAY.pack(self._ack_delay_us()))
 
     def _schedule_ack(self) -> None:
         if self._ack_handle is None:
@@ -373,8 +419,7 @@ class _UdpStream(RawStream):
     def _delayed_ack_fire(self) -> None:
         self._ack_handle = None
         if not self._closed:
-            self._last_acked_rx = self._expected
-            self._tx(_ACK, _OFF.pack(self._expected))
+            self._flush_ack()
 
     # -- packet egress -------------------------------------------------------
 
@@ -397,6 +442,8 @@ class _UdpStream(RawStream):
 
     def _rtt_update(self, sample: float) -> None:
         """RFC 6298 srtt/rttvar; RTO = srtt + 4*rttvar, clamped."""
+        if self._min_rtt is None or sample < self._min_rtt:
+            self._min_rtt = sample
         if self._srtt is None:
             self._srtt = sample
             self._rttvar = sample / 2.0
@@ -413,10 +460,15 @@ class _UdpStream(RawStream):
 
     async def _pace(self, nbytes: int) -> None:
         """Token-bucket pacing at ~1.25x cwnd/srtt (burst cap = one cwnd).
-        Below PACE_SRTT_FLOOR_S the path is loopback-fast and pacing would
-        only cost event-loop wakeups — skip it."""
+        The on/off gate uses MIN RTT, not srtt: srtt absorbs receiver
+        scheduling stalls (single-process peers share one event loop), and
+        a contaminated estimate must not switch pacing on over a path
+        whose true RTT is loopback-fast — each pace sleep costs ~1 ms of
+        timer granularity per segment."""
         srtt = self._srtt
         if srtt is None or srtt <= PACE_SRTT_FLOOR_S:
+            return
+        if self._min_rtt is not None and self._min_rtt <= PACE_SRTT_FLOOR_S:
             return
         rate = 1.25 * max(self._cwnd, 2.0 * self._mtu) / srtt
         # burst cap must cover at least one segment: a probed-up MTU can
