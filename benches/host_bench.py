@@ -255,6 +255,59 @@ async def bench_device_echo(iters: int):
             await cluster.stop()
 
 
+async def bench_device_fanout(tput: int):
+    """Sustained broadcast fan-out THROUGH the attached device plane, end
+    to end: marshal-auth'd clients publish, frames stage into the ring,
+    the routing step runs on whatever accelerator is live (the real TPU
+    under axon; CPU elsewhere), the native engine egresses per-user wire
+    streams, and all 16 clients fully decode. The only e2e number in the
+    suite that exercises the real chip (the 8-shard mesh rows need 8
+    devices and therefore run on the virtual CPU mesh)."""
+    import jax
+
+    from pushcdn_tpu.broker.device_plane import DevicePlaneConfig
+    from pushcdn_tpu.testing import Cluster
+
+    cluster = await Cluster(num_brokers=1,
+                            device_plane=DevicePlaneConfig(
+                                ring_slots=1024, frame_bytes=2048)).start()
+    try:
+        clients = [cluster.client(seed=700 + i, topics=[0])
+                   for i in range(16)]
+        for c in clients:
+            await c.ensure_initialized()
+        payload = os.urandom(1024)
+
+        async def drain(c, n):
+            got = 0
+            while got < n:
+                got += len(await c.receive_messages())
+
+        # warmup: fill step-shape caches / device buffers
+        drains = [asyncio.create_task(drain(c, 400)) for c in clients]
+        for _ in range(200):
+            await clients[0].send_broadcast_message([0], payload)
+            await clients[1].send_broadcast_message([0], payload)
+        await asyncio.gather(*drains)
+
+        plane = cluster.brokers[0].device_plane
+        steps0 = plane.steps
+        t0 = time.perf_counter()
+        drains = [asyncio.create_task(drain(c, tput)) for c in clients]
+        for _ in range(tput // 2):
+            await clients[0].send_broadcast_message([0], payload)
+            await clients[1].send_broadcast_message([0], payload)
+        await asyncio.gather(*drains)
+        dt = time.perf_counter() - t0
+        emit("e2e/device_plane_fanout", tput * 16 / dt, "deliveries/s",
+             backend=jax.default_backend(), msgs=tput, frame=1024,
+             steps=plane.steps - steps0)
+        for c in clients:
+            c.close()
+    finally:
+        await cluster.stop()
+
+
 def _p99(lat):
     return round(sorted(lat)[max(0, int(len(lat) * 0.99) - 1)], 1)
 
@@ -291,6 +344,13 @@ async def amain(quick: bool):
     await bench_routing(iters=100 if quick else 500)
     await bench_e2e_echo(iters=200 if quick else 1000)
     await bench_device_echo(iters=100 if quick else 300)
+    # wide memory window: models the production TCP edge (same rationale
+    # as the configs benches) so the 16-way drain isn't pinched at 8 KiB
+    prev = Memory.set_duplex_window(256 * 1024)
+    try:
+        await bench_device_fanout(tput=1500 if quick else 6000)
+    finally:
+        Memory.set_duplex_window(prev)
 
 
 def main():

@@ -313,8 +313,12 @@ class _UdpStream(RawStream):
                     # QUIC semantics: the peer held this ACK (delayed-ACK
                     # timer / byte threshold); that hold time is not path
                     # RTT. Clamp at a 50 us floor so a mis-reported delay
-                    # can't zero the estimator.
-                    self._rtt_update(max(rtt_sample - ack_delay_s, 5e-5))
+                    # can't zero the estimator. min_rtt takes the RAW
+                    # sample (RFC 9002 §5.2): it gates pacing, and an
+                    # unauthenticated peer-reported delay must not be able
+                    # to deflate it.
+                    self._rtt_update(max(rtt_sample - ack_delay_s, 5e-5),
+                                     raw_sample=rtt_sample)
                 if self._in_recovery:
                     if ack >= self._recover:
                         # full recovery: deflate to ssthresh
@@ -440,10 +444,14 @@ class _UdpStream(RawStream):
 
     # -- congestion control --------------------------------------------------
 
-    def _rtt_update(self, sample: float) -> None:
-        """RFC 6298 srtt/rttvar; RTO = srtt + 4*rttvar, clamped."""
-        if self._min_rtt is None or sample < self._min_rtt:
-            self._min_rtt = sample
+    def _rtt_update(self, sample: float,
+                    raw_sample: Optional[float] = None) -> None:
+        """RFC 6298 srtt/rttvar; RTO = srtt + 4*rttvar, clamped. min_rtt
+        ratchets on the RAW (ack_delay-unadjusted) sample per RFC 9002
+        §5.2 — it gates pacing, so peer-reported delay must not move it."""
+        raw = sample if raw_sample is None else raw_sample
+        if self._min_rtt is None or raw < self._min_rtt:
+            self._min_rtt = raw
         if self._srtt is None:
             self._srtt = sample
             self._rttvar = sample / 2.0

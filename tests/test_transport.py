@@ -396,3 +396,60 @@ async def test_quic_tls_handshake_survives_datagram_loss():
     finally:
         a.abort()
         b.abort()
+
+
+async def test_quic_ack_delay_keeps_rtt_honest():
+    """ACKs carry the time the receiver held them (QUIC's ack_delay): a
+    timer-delayed ACK must not inflate the sender's RTT estimator, and a
+    hostile/corrupt delay field is clamped so it can't zero it either."""
+    from pushcdn_tpu.proto.transport.quic import (
+        _ACK_DELAY, _DATA, _OFF, _UdpStream, ACK_DELAY_S,
+    )
+
+    sent = []
+    a = _UdpStream(1, sent.append)
+    b = None
+
+    def to_b(pkt: bytes) -> None:
+        if b is not None:
+            b.on_packet(pkt[0], pkt[9:])
+
+    try:
+        # --- wire format: receiver stamps held time on timer-fired ACKs ---
+        acks = []
+        b = _UdpStream(1, acks.append)
+        b.on_packet(_DATA, _OFF.pack(0) + b"x" * 100)
+        # held ~30 ms, then the delayed-ACK timer fires
+        await asyncio.sleep(0.03)
+        async with asyncio.timeout(5):
+            while not any(p[0] == 4 for p in acks):  # _ACK type byte = 4
+                await asyncio.sleep(0.005)
+        ack_pkts = [p for p in acks if p[0] == 4]
+        assert ack_pkts, acks
+        body = ack_pkts[-1][9:]
+        assert len(body) >= _OFF.size + _ACK_DELAY.size
+        delay_us = _ACK_DELAY.unpack_from(body, _OFF.size)[0]
+        # the stamp reflects the ~20-30 ms hold, not zero
+        assert delay_us >= 10_000, delay_us
+
+        # --- sender side: the held time is subtracted from the sample ---
+        a._unacked[0] = [b"y" * 100, __import__("time").monotonic() - 0.040, 0]
+        a._send_order.append(0)
+        a._next_off = 100
+        a.on_packet(4, _OFF.pack(100) + _ACK_DELAY.pack(35_000))
+        # raw sample ~40 ms minus reported 35 ms -> ~5 ms, far below raw
+        assert a._srtt is not None and a._srtt < 0.02, a._srtt
+
+        # --- clamp: a hostile delay can't pin the estimator to the floor ---
+        c = _UdpStream(1, lambda pkt: None)
+        c._unacked[0] = [b"z" * 100, __import__("time").monotonic() - 0.500, 0]
+        c._send_order.append(0)
+        c._next_off = 100
+        c.on_packet(4, _OFF.pack(100) + _ACK_DELAY.pack(0xFFFFFFFF))
+        # raw ~500 ms minus the CLAMPED delay (2*ACK_DELAY_S) stays large
+        assert c._srtt is not None and c._srtt >= 0.5 - 2.5 * ACK_DELAY_S
+        c.abort()
+    finally:
+        a.abort()
+        if b is not None:
+            b.abort()
