@@ -35,6 +35,7 @@ from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
 from pushcdn_tpu.parallel.router import (
     IngressBatch,
     RouterState,
+    routing_step,
     routing_step_single,
 )
 from pushcdn_tpu.proto.message import KIND_BROADCAST
@@ -82,35 +83,74 @@ def main() -> None:
 
     state, batch = build_inputs()
 
-    # warmup / compile
+    # warmup / compile one plain step, then carry the merged CRDT so the
+    # timed steps run at the converged steady state
     result = routing_step_single(state, batch)
     jax.block_until_ready(result.deliver)
-    state = result.state  # carry the merged CRDT like a real steady state
+    state = result.state
 
-    # Every step's delivery matrix is CONSUMED on device (folded into an
-    # accumulator): blocking only on the final step would let a lazy
-    # remote-chip backend elide intermediate steps' work and overstate
-    # throughput. best-of-N repeats because tunnel dispatch is noisy.
+    # DELIBERATE host readbacks before timing — do not remove. The
+    # tunneled backend has a deferred-execution mode in which
+    # block_until_ready returns BEFORE the work runs: round 4 measured a
+    # "1.5B msgs/s" headline whose timed loop finished in milliseconds
+    # while the first later readback stalled for seconds paying for every
+    # step (the tell was an implied frame-byte rate ABOVE the chip's HBM
+    # spec). Any pre-timing readback pins the session to eager execution;
+    # the timed region below ALSO ends with a readback, so timing can
+    # never close before the work is real. These per-step scalars double
+    # as the exact-count honesty baseline.
+    # int32 accumulators wrap mod 2^32 (the Pallas kernel cannot compile
+    # under global x64); modular sums are order-independent, so the
+    # exact-count asserts below compare deltas mod 2^32
+    M32 = 1 << 32
+    result = routing_step_single(state, batch)
+    per_step_count = int(result.deliver.sum(dtype=jnp.int32)) % M32
+    delivered = result.deliver.any(axis=0)
+    per_step_bytes = int(jnp.where(delivered[:, None], batch.frame_bytes,
+                                   0).sum(dtype=jnp.int32)) % M32
+    state = result.state
+
+    # Many steps per jit call via lax.scan: intermediates (the [S, U]
+    # delivery matrix, gathered bytes) stay on device across the whole
+    # call, so the tunnel ships only the carried state + one scalar —
+    # per-call transfer overhead amortizes across K real steps instead of
+    # shipping ~70 MB of internal buffers per step (the eager-mode cost
+    # that made the old one-step-per-call structure measure the tunnel,
+    # not the chip).
+    K = 50          # steps per scan call
+    repeats = 5     # best-of: the tunneled chip is noisy
+
     @jax.jit
-    def consume(acc, deliver):
-        # decision-rate forcing: the whole matrix is in acc's
-        # dependency cone, so no backend can elide any of it
-        return acc + deliver.sum(dtype=jnp.int32)
+    def scan_decision(state, batch, acc):
+        def body(carry, _):
+            st, a = carry
+            r = routing_step(st, batch, jnp.int32(0), axis_name=None)
+            return (r.state, a + r.deliver.sum(dtype=jnp.int32)), None
+        (st, a), _ = jax.lax.scan(body, (state, acc), None, length=K)
+        return st, a
 
     @jax.jit
-    def consume_bytes(acc, deliver, frame_bytes):
-        # BYTE-TRUE forcing: every delivered frame's payload bytes enter
-        # the cone via a masked byte-reduction — the backend must read
-        # all S*F frame bytes from HBM, not just the routing metadata
-        delivered = deliver.any(axis=0)                     # [S]
-        masked = jnp.where(delivered[:, None], frame_bytes, 0)
-        return acc + masked.sum(dtype=jnp.int32)
+    def scan_bytes(state, batch, acc):
+        def body(carry, _):
+            st, a = carry
+            r = routing_step(st, batch, jnp.int32(0), axis_name=None)
+            d = r.deliver.any(axis=0)                       # [S]
+            masked = jnp.where(d[:, None], batch.frame_bytes, 0)
+            # BYTE-TRUE forcing: every delivered frame's payload bytes
+            # enter the accumulator's dependency cone
+            a = a + r.deliver.sum(dtype=jnp.int32) \
+                + masked.sum(dtype=jnp.int32)
+            return (r.state, a), None
+        (st, a), _ = jax.lax.scan(body, (state, acc), None, length=K)
+        return st, a
 
-    steps, repeats = 50, 5   # best-of-5: the tunneled chip is noisy
     acc = jnp.zeros((), jnp.int32)
-    acc = consume(acc, result.deliver)          # compile before timing
-    accb = consume_bytes(acc, result.deliver, batch.frame_bytes)
-    jax.block_until_ready(accb)
+    state, acc = scan_decision(state, batch, acc)       # compile
+    acc_val = int(acc) % M32                            # eager + baseline
+    accb = jnp.zeros((), jnp.int32)
+    state, accb = scan_bytes(state, batch, accb)        # compile
+    accb_val = int(accb) % M32
+
     if args.profile:  # start AFTER warm-up so the trace is steady-state
         jax.profiler.start_trace(args.profile)
         print(f"# tracing to {args.profile}", file=sys.stderr)
@@ -119,24 +159,32 @@ def main() -> None:
     best_decision = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        for _ in range(steps):
-            result = routing_step_single(state, batch)
-            state = result.state
-            acc = consume(acc, result.deliver)
-        jax.block_until_ready(acc)
+        state, acc = scan_decision(state, batch, acc)
+        new_val = int(acc) % M32  # readback INSIDE the timed window: the
         best_decision = min(best_decision, time.perf_counter() - t0)
+        # work cannot defer past it; delta checked exactly (mod 2^32)
+        if (new_val - acc_val) % M32 != (K * per_step_count) % M32:
+            raise SystemExit(
+                f"decision-count mismatch: +{(new_val - acc_val) % M32}, "
+                f"expected {(K * per_step_count) % M32} — the timed cone "
+                "was not forced")
+        acc_val = new_val
 
     # pass 2: byte-true rate — same steps, with every delivered frame's
     # bytes materialized into the accumulator's dependency cone
     best_bytes = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        for _ in range(steps):
-            result = routing_step_single(state, batch)
-            state = result.state
-            acc = consume_bytes(acc, result.deliver, batch.frame_bytes)
-        jax.block_until_ready(acc)
+        state, accb = scan_bytes(state, batch, accb)
+        new_val = int(accb) % M32
         best_bytes = min(best_bytes, time.perf_counter() - t0)
+        if (new_val - accb_val) % M32 != \
+                (K * (per_step_count + per_step_bytes)) % M32:
+            raise SystemExit(
+                f"byte-sum mismatch: +{(new_val - accb_val) % M32}, "
+                f"expected {(K * (per_step_count + per_step_bytes)) % M32}")
+        accb_val = new_val
+
     if args.profile:
         jax.profiler.stop_trace()
 
@@ -161,9 +209,9 @@ def main() -> None:
     except Exception:
         pass
 
-    msgs_per_sec = steps * S / best_bytes           # headline: byte-true
-    decision_rate = steps * S / best_decision
-    byte_rate = steps * S * F / best_bytes          # delivered bytes read
+    msgs_per_sec = K * S / best_bytes               # headline: byte-true
+    decision_rate = K * S / best_decision
+    byte_rate = K * S * F / best_bytes              # delivered bytes read
     kind = jax.devices()[0].device_kind
     # known per-chip HBM bandwidths (GB/s); the implied-fraction row is
     # informative only when the kind is recognized
@@ -175,8 +223,11 @@ def main() -> None:
         "value": round(msgs_per_sec, 1),
         "unit": "msgs/s",
         "vs_baseline": round(msgs_per_sec / TARGET_MSGS_PER_SEC, 4),
-        # byte-true companion numbers (same elision-proofing note: all in
-        # the on-device accumulator's dependency cone)
+        # byte-true companion numbers; elision-proofing: every step's
+        # delivery matrix and delivered bytes are in the on-device
+        # accumulator's cone, the timed window ends with a host readback
+        # (deferred execution cannot escape it), and the per-call count
+        # deltas are asserted against eagerly-measured per-step values
         "decision_rate_msgs_s": round(decision_rate, 1),
         "frame_byte_rate_GBps": round(byte_rate / 1e9, 2),
         "device_kind": kind,

@@ -34,7 +34,7 @@ from pushcdn_tpu.parallel.crdt import CrdtState
 from pushcdn_tpu.parallel.router import (
     IngressBatch,
     RouterState,
-    routing_step_single,
+    routing_step,
 )
 from pushcdn_tpu.proto.message import KIND_BROADCAST, KIND_DIRECT
 
@@ -98,37 +98,60 @@ def main() -> None:
     batches = [build_view_batch(v, V, args.slots, rng)
                for v in range(min(args.views, 8))]  # reuse shapes, rotate
 
-    # warmup/compile
-    result = routing_step_single(state, batches[0])
-    jax.block_until_ready(result.deliver)
-
-    # every view's delivery matrix is consumed on device — blocking only
-    # on the last view would let a lazy remote backend elide the
-    # intermediate views' work and overstate the rate (see BASELINE.md)
+    # Every view's delivery matrix is consumed ON DEVICE, INSIDE ONE jit:
+    # the full-matrix reduction sits in the timed accumulator's dependency
+    # cone (no backend can elide it — the final count is asserted against
+    # the exact expected value below), and single-jit fusion means XLA
+    # never materializes the [slots, V] matrix between kernels. Both
+    # earlier shapes were honest but artifact-bound on the tunneled
+    # backend: a separate consume jit — and even a fused jit that called
+    # the JITTED routing_step_single, since jit-in-jit is not inlined
+    # there — shipped the ~164 MB matrix through the tunnel every view
+    # (~38 ms/view of transfer, not routing; BASELINE.md round-4 note).
+    # Calling the unjitted routing_step keeps the whole view one program.
     @jax.jit
-    def consume(acc, deliver):
-        # full on-device reduction: the whole matrix is in acc's
-        # dependency cone, so no backend can elide any of it
-        return acc + deliver.sum(dtype=jnp.int32)
+    def fused_view(state, batch, acc):
+        result = routing_step(state, batch, jnp.int32(0), axis_name=None)
+        return result.state, acc + result.deliver.sum(dtype=jnp.int64)
 
     per_batch_msgs = [int(np.asarray(b.valid).sum()) for b in batches]
+    # int32 accumulator wrapping mod 2^32 (x64 is off; modular sums are
+    # order-independent, so the exact-count check compares mod 2^32 —
+    # same pattern as bench.py)
+    M32 = 1 << 32
     acc = jnp.zeros((), jnp.int32)
-    acc = consume(acc, result.deliver)  # compile consume before timing
+    state, acc = fused_view(state, batches[0], acc)  # compile + warm
     jax.block_until_ready(acc)
+    # DELIBERATE host readback before timing — do not remove. The
+    # tunneled backend has a deferred-execution mode in which
+    # block_until_ready returns BEFORE the work runs (measured: a
+    # 500-view loop "completes" in 21 ms and the first later readback
+    # then stalls 21 s paying for all of it — an apparent free 400×).
+    # Any pre-timing readback (this int(acc), or per_batch_msgs above)
+    # pins the session to eager execution, where block_until_ready is
+    # truthful and dt below includes real execution. Recorded so a
+    # future round doesn't rediscover the fake speedup (same spirit as
+    # the step-size note in BASELINE.md).
+    warmup_deliveries = int(acc)
+
     total_msgs = 0
     t0 = time.perf_counter()
     for v in range(args.views):
-        batch = batches[v % len(batches)]
-        result = routing_step_single(state, batch)
-        state = result.state
-        acc = consume(acc, result.deliver)
+        state, acc = fused_view(state, batches[v % len(batches)], acc)
         total_msgs += per_batch_msgs[v % len(batches)]
     jax.block_until_ready(acc)
     dt = time.perf_counter() - t0
-    deliveries = int(np.asarray(result.deliver).sum())
     # deliveries per view: proposal -> V validators, DA -> committee,
     # votes -> 1 leader each
     per_view_deliveries = V + DA_COMMITTEE + min(V, args.slots - 2)
+    # elision-proof: the accumulated on-device count must equal the
+    # closed-form expectation for every timed view (+1 for the warmup)
+    expected = ((args.views + 1) * per_view_deliveries) % M32
+    measured = int(acc) % M32
+    if measured != expected:
+        raise SystemExit(
+            f"delivery-count mismatch: device accumulated {measured}, "
+            f"expected {expected} — the timed cone was not fully forced")
 
     print(json.dumps({
         "bench": "consensus_replay",
@@ -137,7 +160,8 @@ def main() -> None:
         "consensus_msgs_per_sec": round(total_msgs / dt, 1),
         "deliveries_per_sec": round(args.views * per_view_deliveries / dt, 1),
         "views_per_sec": round(args.views / dt, 2),
-        "sample_view_deliveries": deliveries,
+        "per_view_deliveries": per_view_deliveries,
+        "device_count_check": "exact",
     }))
 
 

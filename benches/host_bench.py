@@ -291,16 +291,17 @@ async def bench_device_fanout(tput: int):
         await asyncio.gather(*drains)
 
         plane = cluster.brokers[0].device_plane
+        sent = tput // 2 * 2  # two publishers: drains must match exactly
         steps0 = plane.steps
         t0 = time.perf_counter()
-        drains = [asyncio.create_task(drain(c, tput)) for c in clients]
-        for _ in range(tput // 2):
+        drains = [asyncio.create_task(drain(c, sent)) for c in clients]
+        for _ in range(sent // 2):
             await clients[0].send_broadcast_message([0], payload)
             await clients[1].send_broadcast_message([0], payload)
         await asyncio.gather(*drains)
         dt = time.perf_counter() - t0
-        emit("e2e/device_plane_fanout", tput * 16 / dt, "deliveries/s",
-             backend=jax.default_backend(), msgs=tput, frame=1024,
+        emit("e2e/device_plane_fanout", sent * 16 / dt, "deliveries/s",
+             backend=jax.default_backend(), msgs=sent, frame=1024,
              steps=plane.steps - steps0)
         for c in clients:
             c.close()

@@ -459,9 +459,12 @@ class Connection:
         interrupted (a cancelled put never inserts — without this, a reader
         cancelled while blocked on a full bounded queue leaks pool bytes)."""
         q = self._recv_q
-        if not q.full():
-            # the common case: room available — skip the awaited put's
-            # coroutine round-trip (~1 us per wakeup on the hot drain)
+        if q.maxsize <= 0:
+            # unbounded (the common case): skip the awaited put's
+            # coroutine round-trip (~1 us per wakeup on the hot drain).
+            # Bounded queues keep the awaited path — put_nowait on a
+            # just-freed slot would jump ahead of putters already
+            # blocked in q.put (FIFO inversion + starvation).
             q.put_nowait(item)
             return
         try:
@@ -737,9 +740,10 @@ class Connection:
         self._check()
         done = asyncio.get_running_loop().create_future() if flush else None
         q = self._send_q
-        if not q.full():
-            # room available (always true for unbounded connections):
-            # skip the awaited put's coroutine round-trip on the hot path
+        if q.maxsize <= 0:
+            # unbounded (the default): skip the awaited put's coroutine
+            # round-trip on the hot path. Bounded queues keep the awaited
+            # path so senders already blocked in q.put keep FIFO order.
             q.put_nowait((raw, done))
         else:
             await q.put((raw, done))
@@ -778,10 +782,10 @@ class Connection:
             raise
         try:
             q = self._send_q
-            if not q.full():
-                q.put_nowait((raws, done))  # common case: no coroutine hop
+            if q.maxsize <= 0:
+                q.put_nowait((raws, done))  # unbounded: no coroutine hop
             else:
-                await q.put((raws, done))
+                await q.put((raws, done))  # bounded: keep putter FIFO
         except BaseException:
             # cancelled while blocked on a bounded queue: never inserted
             for p in raws:

@@ -312,13 +312,18 @@ class _UdpStream(RawStream):
                 if rtt_sample is not None:
                     # QUIC semantics: the peer held this ACK (delayed-ACK
                     # timer / byte threshold); that hold time is not path
-                    # RTT. Clamp at a 50 us floor so a mis-reported delay
-                    # can't zero the estimator. min_rtt takes the RAW
-                    # sample (RFC 9002 §5.2): it gates pacing, and an
-                    # unauthenticated peer-reported delay must not be able
-                    # to deflate it.
-                    self._rtt_update(max(rtt_sample - ack_delay_s, 5e-5),
-                                     raw_sample=rtt_sample)
+                    # RTT. min_rtt takes the RAW sample (RFC 9002 §5.2):
+                    # it gates pacing, and an unauthenticated
+                    # peer-reported delay must not be able to deflate it.
+                    # The adjusted sample floors at min_rtt (§5.3), so a
+                    # maxed-out delay stamp can't drag srtt below the
+                    # path's observed floor either.
+                    floor = self._min_rtt if self._min_rtt is not None \
+                        else 5e-5
+                    floor = min(floor, rtt_sample)
+                    self._rtt_update(
+                        max(rtt_sample - ack_delay_s, floor, 5e-5),
+                        raw_sample=rtt_sample)
                 if self._in_recovery:
                     if ack >= self._recover:
                         # full recovery: deflate to ssthresh
