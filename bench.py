@@ -117,7 +117,8 @@ def main() -> None:
     # shipping ~70 MB of internal buffers per step (the eager-mode cost
     # that made the old one-step-per-call structure measure the tunnel,
     # not the chip).
-    K = 50          # steps per scan call
+    K = 500         # steps per scan call (amortizes the per-call tunnel
+                    # round trip, measured below and reported separately)
     repeats = 5     # best-of: the tunneled chip is noisy
 
     @jax.jit
@@ -143,6 +144,27 @@ def main() -> None:
             return (r.state, a), None
         (st, a), _ = jax.lax.scan(body, (state, acc), None, length=K)
         return st, a
+
+    # calibrate the per-call overhead with a trivial scan of the same
+    # length: on the tunneled backend one eager jit call costs ~70-80 ms
+    # regardless of content; reporting it separately decomposes the
+    # inclusive rate below into tunnel tax vs real routing work
+    @jax.jit
+    def trivial(acc):
+        def body(a, _):
+            return a + 1, None
+        a, _ = jax.lax.scan(body, acc, None, length=K)
+        return a
+
+    tacc = jnp.zeros((), jnp.int32)
+    tacc = trivial(tacc)
+    _ = int(tacc)
+    call_overhead_s = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        tacc = trivial(tacc)
+        _ = int(tacc)
+        call_overhead_s = min(call_overhead_s, time.perf_counter() - t0)
 
     acc = jnp.zeros((), jnp.int32)
     state, acc = scan_decision(state, batch, acc)       # compile
@@ -211,7 +233,11 @@ def main() -> None:
 
     msgs_per_sec = K * S / best_bytes               # headline: byte-true
     decision_rate = K * S / best_decision
-    byte_rate = K * S * F / best_bytes              # delivered bytes read
+    byte_rate = K * S * F / best_bytes              # delivered bytes in cone
+    # tunnel-overhead-free estimate (the rate a locally-attached chip
+    # would sustain): subtract the calibrated per-call floor
+    overhead_free = K * S / max(best_bytes - call_overhead_s,
+                                best_bytes * 0.05)
     kind = jax.devices()[0].device_kind
     # known per-chip HBM bandwidths (GB/s); the implied-fraction row is
     # informative only when the kind is recognized
@@ -227,11 +253,18 @@ def main() -> None:
         # delivery matrix and delivered bytes are in the on-device
         # accumulator's cone, the timed window ends with a host readback
         # (deferred execution cannot escape it), and the per-call count
-        # deltas are asserted against eagerly-measured per-step values
+        # deltas are asserted against eagerly-measured per-step values.
+        # NOTE the byte forcing is hoistable algebra (XLA may reduce it
+        # to a precomputed per-frame row-sum dotted with the delivered
+        # mask each step), so frame_byte_rate is an in-cone figure, not
+        # a bandwidth measurement; the delivery MATRIX itself cannot be
+        # hoisted (the carried CRDT state threads through every step)
         "decision_rate_msgs_s": round(decision_rate, 1),
         "frame_byte_rate_GBps": round(byte_rate / 1e9, 2),
         "device_kind": kind,
     }
+    row["per_call_overhead_ms"] = round(call_overhead_s * 1e3, 1)
+    row["overhead_free_msgs_s_est"] = round(overhead_free, 1)
     if spec:
         row["hbm_frac_of_spec"] = round(byte_rate / (spec * 1e9), 4)
     if egress_rate is not None:

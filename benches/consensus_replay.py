@@ -112,7 +112,7 @@ def main() -> None:
     @jax.jit
     def fused_view(state, batch, acc):
         result = routing_step(state, batch, jnp.int32(0), axis_name=None)
-        return result.state, acc + result.deliver.sum(dtype=jnp.int64)
+        return result.state, acc + result.deliver.sum(dtype=jnp.int32)
 
     per_batch_msgs = [int(np.asarray(b.valid).sum()) for b in batches]
     # int32 accumulator wrapping mod 2^32 (x64 is off; modular sums are

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 
 from pushcdn_tpu.bin.common import init_logging, tune_gc, keypair_from_seed, run_def_from_args
 from pushcdn_tpu.broker.broker import GIB, Broker, BrokerConfig
@@ -88,6 +89,14 @@ async def amain(args: argparse.Namespace) -> None:
 
     device_plane = None
     if args.device_plane:
+        # Honor JAX_PLATFORMS before jax initializes: an accelerator
+        # plugin's sitecustomize may overwrite the jax_platforms config
+        # default (the same workaround tests/conftest.py applies), which
+        # otherwise points a CPU-pinned subprocess at a dead/busy chip.
+        platforms = os.environ.get("JAX_PLATFORMS")
+        if platforms:
+            import jax
+            jax.config.update("jax_platforms", platforms)
         from pushcdn_tpu.broker.device_plane import DevicePlaneConfig
         device_plane = DevicePlaneConfig(**_overrides())
     broker = await Broker.new(BrokerConfig(

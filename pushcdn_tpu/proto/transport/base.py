@@ -462,9 +462,11 @@ class Connection:
         if q.maxsize <= 0:
             # unbounded (the common case): skip the awaited put's
             # coroutine round-trip (~1 us per wakeup on the hot drain).
-            # Bounded queues keep the awaited path — put_nowait on a
-            # just-freed slot would jump ahead of putters already
-            # blocked in q.put (FIFO inversion + starvation).
+            # Bounded queues keep the awaited path: blocked putters then
+            # drain in FIFO among themselves and cannot be starved
+            # indefinitely by a put_nowait loop (asyncio.Queue gives no
+            # hard slot reservation — a racing new sender can still win
+            # the freed slot in the wakeup window, same as always).
             q.put_nowait(item)
             return
         try:
@@ -743,7 +745,10 @@ class Connection:
         if q.maxsize <= 0:
             # unbounded (the default): skip the awaited put's coroutine
             # round-trip on the hot path. Bounded queues keep the awaited
-            # path so senders already blocked in q.put keep FIFO order.
+            # path: blocked senders queue FIFO among themselves rather
+            # than losing every freed slot to a put_nowait fast path
+            # (asyncio.Queue has no hard slot reservation, so a racing
+            # sender can still occasionally win the wakeup window).
             q.put_nowait((raw, done))
         else:
             await q.put((raw, done))
@@ -785,7 +790,7 @@ class Connection:
             if q.maxsize <= 0:
                 q.put_nowait((raws, done))  # unbounded: no coroutine hop
             else:
-                await q.put((raws, done))  # bounded: keep putter FIFO
+                await q.put((raws, done))  # bounded: queue behind waiters
         except BaseException:
             # cancelled while blocked on a bounded queue: never inserted
             for p in raws:

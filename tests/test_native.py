@@ -161,3 +161,33 @@ def test_egress_encode_dense_single_user():
     expect = (struct.pack(">I", F) + bytes(block[0]) +
               struct.pack(">I", 5) + bytes(block[1, :5]))
     assert bytes(streams.stream(0)) == expect
+
+
+def test_push_batch_multiword_mask_expansion_and_memo():
+    """Multi-word topic masks expand to the exact little-endian u32 words
+    through the memoized row cache — uniform, mixed, and out-of-range
+    (truncating, matching the old per-word shift loop) mask batches."""
+    W = 8
+    ring = FrameRing(slots=16, frame_bytes=32, topic_words=W)
+    big = (1 << 200) | (1 << 37) | 0b101     # spans words 0, 1, and 6
+    over = (1 << (32 * W)) | 0b11            # bit above the topic space
+    neg = -1                                 # pathological caller input
+    masks = [big, big, over, neg, 0b1]       # uniform run + mixed tail
+    n = ring.push_batch([b"m"] * 5, [KIND_BROADCAST] * 5, masks, [-1] * 5)
+    assert n == 5
+    batch = ring.take_batch()
+    allbits = (1 << (32 * W)) - 1
+    for i, m in enumerate(masks):
+        expect = [(int(m) & allbits) >> (32 * w) & 0xFFFFFFFF
+                  for w in range(W)]
+        assert list(batch.topic_mask[i]) == expect, (i, m)
+
+    # uniform-mask fast path fills every row identically
+    ring2 = FrameRing(slots=16, frame_bytes=32, topic_words=W)
+    assert ring2.push_batch([b"u"] * 6, [KIND_BROADCAST] * 6,
+                            [big] * 6, [-1] * 6) == 6
+    b2 = ring2.take_batch()
+    rows = b2.topic_mask[:6]
+    assert (rows == rows[0]).all()
+    assert list(rows[0]) == [(big >> (32 * w)) & 0xFFFFFFFF
+                             for w in range(W)]

@@ -281,3 +281,42 @@ async def test_send_encoded_nowait_bounded_queue_fails_fast():
     finally:
         a.close()
         b.close()
+
+
+async def test_bounded_queue_send_order_is_fifo_under_saturation():
+    """Bounded connections take the awaited ``q.put`` path (no
+    put_nowait fast path): a saturated sequential sender's frames
+    transmit in send order, and a putter blocked on a full queue makes
+    progress as the writer drains (liveness). asyncio.Queue gives no
+    hard slot reservation against a RACING second sender, so this pins
+    ordering/liveness for the saturated path, not a global FIFO across
+    concurrent senders."""
+    lim = Limiter(per_connection_queue=2)
+    listener = await Memory.bind("sem-fifo-order")
+    connect = asyncio.create_task(Memory.connect("sem-fifo-order",
+                                                 limiter=lim))
+    server = await (await listener.accept()).finalize()
+    client = await connect
+
+    n = 40
+    sent = [b"frame-%03d" % i for i in range(n)]
+
+    async def sender():
+        for payload in sent:
+            await client.send_raw(payload)
+
+    task = asyncio.create_task(sender())
+    got = []
+    async with asyncio.timeout(10):
+        while len(got) < n:
+            raw = await server.recv_raw()
+            got.append(bytes(raw.data))
+            raw.release()
+            # stall the drain a tick so the bounded queue saturates and
+            # blocked puts interleave with freed slots
+            await asyncio.sleep(0)
+    await task
+    assert got == sent  # exact send order, no slot-stealing reorder
+    client.close()
+    server.close()
+    await listener.close()
