@@ -27,7 +27,9 @@ import time
 
 import numpy as np
 
-# Do NOT force a platform: the driver runs this on the real TPU chip.
+# The platform is NOT forced here — the driver runs this on the real TPU
+# chip — EXCEPT when the pre-flight accelerator probe fails, in which case
+# main() falls back to the CPU platform with an explicit note in the JSON.
 import jax
 import jax.numpy as jnp
 
@@ -80,6 +82,18 @@ def main() -> None:
                          "flamegraph analog of the reference's pprof-in-"
                          "criterion integration")
     args = ap.parse_args()
+
+    # A wedged accelerator tunnel hangs jax init in-process where no
+    # timeout can reach it: probe device init + a real transfer in a
+    # subprocess first, and fall back to the CPU platform (honestly
+    # labeled in the JSON) rather than hanging the driver's bench run.
+    from pushcdn_tpu.testing.accel_probe import accelerator_reachable
+    platform_note = None
+    ok, why = accelerator_reachable()
+    if not ok:
+        jax.config.update("jax_platforms", "cpu")
+        platform_note = (f"accelerator unreachable ({why}); CPU-platform "
+                         "fallback — NOT a TPU measurement")
 
     state, batch = build_inputs()
 
@@ -263,6 +277,8 @@ def main() -> None:
         "frame_byte_rate_GBps": round(byte_rate / 1e9, 2),
         "device_kind": kind,
     }
+    if platform_note:
+        row["note"] = platform_note
     row["per_call_overhead_ms"] = round(call_overhead_s * 1e3, 1)
     row["overhead_free_msgs_s_est"] = round(overhead_free, 1)
     if spec:

@@ -313,29 +313,6 @@ def _p99(lat):
     return round(sorted(lat)[max(0, int(len(lat) * 0.99) - 1)], 1)
 
 
-def _accelerator_reachable(timeout_s: float = 120.0):
-    """Probe jax device init AND a real transfer in a SUBPROCESS: a wedged
-    accelerator tunnel hangs `jax.devices()` indefinitely (and enumeration
-    can succeed on a broken runtime that then dies at device_put — see
-    __graft_entry__._pick_devices), and an in-process hang cannot be timed
-    out. Returns (ok, reason)."""
-    import subprocess
-    probe = ("import jax; d = jax.devices()[0]; "
-             "jax.device_put(0, d).block_until_ready()")
-    try:
-        r = subprocess.run([sys.executable, "-c", probe],
-                           capture_output=True, timeout=timeout_s)
-        if r.returncode == 0:
-            return True, ""
-        tail = r.stderr.decode(errors="replace").strip().splitlines()
-        return False, ("probe exited %d: %s"
-                       % (r.returncode, tail[-1] if tail else ""))[:300]
-    except subprocess.TimeoutExpired:
-        return False, f"probe timed out after {timeout_s:.0f}s (wedged tunnel?)"
-    except (subprocess.SubprocessError, OSError) as exc:
-        return False, f"probe failed to launch: {exc!r}"[:300]
-
-
 async def amain(quick: bool):
     sizes = [100, 1024, 100 * 1024, 10 * 1024 * 1024]
     if not quick:
@@ -367,7 +344,8 @@ async def amain(quick: bool):
                               min(budget, max(10 * size, floor)))
     await bench_routing(iters=100 if quick else 500)
     await bench_e2e_echo(iters=200 if quick else 1000)
-    ok, why = _accelerator_reachable()
+    from pushcdn_tpu.testing.accel_probe import accelerator_reachable
+    ok, why = accelerator_reachable()
     if ok:
         await bench_device_echo(iters=100 if quick else 300)
         # wide memory window: models the production TCP edge (same

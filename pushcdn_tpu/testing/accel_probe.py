@@ -1,0 +1,53 @@
+"""Out-of-process accelerator reachability probe.
+
+A wedged accelerator tunnel hangs ``jax.devices()`` (and even device
+enumeration can succeed on a runtime that then dies at ``device_put`` —
+a libtpu client/terminal version mismatch does exactly that), and an
+in-process hang cannot be timed out. Benches probe in a SUBPROCESS
+before touching the device in-process, and degrade with a recorded
+reason instead of hanging the run.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+from typing import Tuple
+
+_PROBE = ("import jax; d = jax.devices()[0]; "
+          "jax.device_put(0, d).block_until_ready()")
+
+
+def accelerator_reachable(timeout_s: float = 120.0) -> Tuple[bool, str]:
+    """Return ``(ok, reason)``; ``reason`` is empty when reachable.
+
+    The probe runs in its own session so that on timeout the WHOLE
+    process group is killed — a wedged jax runtime can fork helpers that
+    inherit the output pipes, and killing only the direct child would
+    leave ``subprocess.run``'s final ``communicate()`` blocked on pipe
+    EOF forever (the exact hang this probe exists to prevent).
+    """
+    proc = None
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            start_new_session=True)
+        _, stderr = proc.communicate(timeout=timeout_s)
+        if proc.returncode == 0:
+            return True, ""
+        tail = stderr.decode(errors="replace").strip().splitlines()
+        return False, ("probe exited %d: %s"
+                       % (proc.returncode, tail[-1] if tail else ""))[:300]
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+        proc.wait()
+        return False, (f"probe timed out after {timeout_s:.0f}s "
+                       "(wedged accelerator tunnel?)")
+    except (subprocess.SubprocessError, OSError) as exc:
+        return False, f"probe failed to launch: {exc!r}"[:300]
