@@ -331,7 +331,14 @@ def deserialize(frame: BytesLike) -> Message:
             ctx = bytes(view[13:13 + ctxlen])
             if len(ctx) != ctxlen or 13 + ctxlen != n:
                 bail(ErrorKind.DESERIALIZE, "AuthenticateResponse context length mismatch")
-            return AuthenticateResponse(permit=permit, context=ctx.decode("utf-8"))
+            try:
+                context = ctx.decode("utf-8")
+            except UnicodeDecodeError as exc:
+                # a hostile peer's bytes must surface as the documented
+                # Error(DESERIALIZE), never a loose UnicodeDecodeError
+                bail(ErrorKind.DESERIALIZE,
+                     "AuthenticateResponse context is not UTF-8", exc)
+            return AuthenticateResponse(permit=permit, context=context)
     except struct.error as exc:
         bail(ErrorKind.DESERIALIZE, f"truncated frame for kind {kind}", exc)
     bail(ErrorKind.DESERIALIZE, f"unknown message kind {kind}")

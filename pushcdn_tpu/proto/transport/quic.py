@@ -229,6 +229,12 @@ class _UdpStream(RawStream):
 
     def on_packet(self, ptype: int, body: bytes) -> None:
         self._last_recv = time.monotonic()
+        # UDP is the attack surface: a short/garbled datagram must be
+        # DROPPED, never allowed to raise struct.error out of the
+        # protocol callback (PROBE/PROBEACK bodies are already
+        # length-guarded below; RST/FINACK/PING carry no body)
+        if ptype in (_DATA, _ACK, _FIN) and len(body) < _OFF.size:
+            return
         if ptype == _DATA:
             off = _OFF.unpack_from(body)[0]
             payload = body[_OFF.size:]
