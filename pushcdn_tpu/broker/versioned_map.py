@@ -46,7 +46,22 @@ _U32 = struct.Struct("<I")
 _T_NONE, _T_INT, _T_BYTES, _T_STR, _T_TUPLE = 0, 1, 2, 3, 4
 
 
-def encode_value(v, out: bytearray) -> None:
+# Nesting bound for tuple values, enforced on BOTH sides: the analog of
+# capnp's traversal limit (the reference's envelope format caps recursion
+# depth by construction). Decode-side it stops a hostile peer's
+# nested-tuple bomb from escaping as RecursionError; encode-side it fails
+# fast with Error(SERIALIZE) so an over-nested local value can't ship a
+# payload every peer would reject as malformed.
+_MAX_VALUE_DEPTH = 32
+
+
+def encode_value(v, out: bytearray, depth: int = 0) -> None:
+    if depth >= _MAX_VALUE_DEPTH and isinstance(v, tuple):
+        # symmetric with the decode-side traversal limit: fail fast at the
+        # write site with Error(SERIALIZE) instead of shipping a payload
+        # every peer would reject (and disconnect us) as malformed
+        bail(ErrorKind.SERIALIZE,
+             "versioned-map value nesting exceeds traversal limit")
     if v is None:
         out.append(_T_NONE)
     elif isinstance(v, bool):
@@ -71,13 +86,14 @@ def encode_value(v, out: bytearray) -> None:
         out.append(_T_TUPLE)
         out += _U32.pack(len(v))
         for item in v:
-            encode_value(item, out)
+            encode_value(item, out, depth + 1)
     else:
         bail(ErrorKind.SERIALIZE,
              f"type {type(v).__name__} not supported in versioned-map codec")
 
 
-def decode_value(view: memoryview, off: int) -> Tuple[object, int]:
+def decode_value(view: memoryview, off: int,
+                 depth: int = 0) -> Tuple[object, int]:
     tag = view[off]
     off += 1
     if tag == _T_NONE:
@@ -93,11 +109,14 @@ def decode_value(view: memoryview, off: int) -> Tuple[object, int]:
             bail(ErrorKind.DESERIALIZE, "truncated scalar in versioned-map codec")
         return (raw if tag == _T_BYTES else raw.decode("utf-8")), off + n
     if tag == _T_TUPLE:
+        if depth >= _MAX_VALUE_DEPTH:
+            bail(ErrorKind.DESERIALIZE,
+                 "versioned-map value nesting exceeds traversal limit")
         (n,) = _U32.unpack_from(view, off)
         off += 4
         items = []
         for _ in range(n):
-            item, off = decode_value(view, off)
+            item, off = decode_value(view, off, depth + 1)
             items.append(item)
         return tuple(items), off
     bail(ErrorKind.DESERIALIZE, f"unknown scalar tag {tag} in versioned-map codec")

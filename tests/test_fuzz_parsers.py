@@ -137,3 +137,33 @@ async def test_quic_on_packet_survives_random_datagrams():
             isinstance(stream._error, ConnectionResetError)
     finally:
         stream.abort()
+
+
+def test_versioned_map_codec_survives_hostile_payloads():
+    """The CRDT sync codec is broker-to-broker wire surface: random blobs
+    and a nested-tuple recursion bomb must both surface as the documented
+    Error(DESERIALIZE) (the capnp-traversal-limit analog), never
+    RecursionError or a raw struct/index error."""
+    import struct as _struct
+
+    import pushcdn_tpu.broker.versioned_map as vm
+    from pushcdn_tpu.broker.versioned_map import VersionedMap
+
+    rng = random.Random(11)
+    rejected = 0
+    for _ in range(500):
+        blob = bytes(rng.getrandbits(8) for _ in range(rng.randrange(0, 300)))
+        try:
+            VersionedMap.deserialize_entries(blob)
+        except Error:
+            rejected += 1
+    assert rejected > 0
+
+    nest = b"".join(bytes([vm._T_TUPLE]) + _struct.pack("<I", 1)
+                    for _ in range(100_000))
+    bomb = _struct.pack("<I", 1) + nest
+    try:
+        VersionedMap.deserialize_entries(bomb)
+        raise AssertionError("tuple bomb decoded")
+    except Error:
+        pass  # the documented failure mode — bounded traversal
