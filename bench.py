@@ -87,13 +87,11 @@ def main() -> None:
     # timeout can reach it: probe device init + a real transfer in a
     # subprocess first, and fall back to the CPU platform (honestly
     # labeled in the JSON) rather than hanging the driver's bench run.
-    from pushcdn_tpu.testing.accel_probe import accelerator_reachable
-    platform_note = None
-    ok, why = accelerator_reachable()
-    if not ok:
-        jax.config.update("jax_platforms", "cpu")
-        platform_note = (f"accelerator unreachable ({why}); CPU-platform "
-                         "fallback — NOT a TPU measurement")
+    from pushcdn_tpu.testing.accel_probe import force_cpu_if_unreachable
+    why = force_cpu_if_unreachable("bench.py")
+    platform_note = None if why is None else (
+        f"accelerator unreachable ({why}); CPU-platform fallback — NOT a "
+        "TPU measurement")
 
     state, batch = build_inputs()
 

@@ -19,9 +19,17 @@ from typing import Tuple
 _PROBE = ("import jax; d = jax.devices()[0]; "
           "jax.device_put(0, d).block_until_ready()")
 
+_MEMO: "Tuple[bool, str] | None" = None
 
-def accelerator_reachable(timeout_s: float = 120.0) -> Tuple[bool, str]:
+
+def accelerator_reachable(timeout_s: float = 120.0,
+                          use_cache: bool = True) -> Tuple[bool, str]:
     """Return ``(ok, reason)``; ``reason`` is empty when reachable.
+
+    The result is memoized per process (``use_cache=False`` re-probes):
+    the probe costs a full jax-import subprocess — and the whole wedge
+    timeout when the tunnel is dead — so callers that consult it more
+    than once (entry() then dryrun, or bench setup) pay once.
 
     The probe runs in its own session so that on timeout the WHOLE
     process group is killed — a wedged jax runtime can fork helpers that
@@ -29,6 +37,9 @@ def accelerator_reachable(timeout_s: float = 120.0) -> Tuple[bool, str]:
     leave ``subprocess.run``'s final ``communicate()`` blocked on pipe
     EOF forever (the exact hang this probe exists to prevent).
     """
+    global _MEMO
+    if use_cache and _MEMO is not None:
+        return _MEMO
     proc = None
     try:
         proc = subprocess.Popen(
@@ -37,17 +48,41 @@ def accelerator_reachable(timeout_s: float = 120.0) -> Tuple[bool, str]:
             start_new_session=True)
         _, stderr = proc.communicate(timeout=timeout_s)
         if proc.returncode == 0:
-            return True, ""
-        tail = stderr.decode(errors="replace").strip().splitlines()
-        return False, ("probe exited %d: %s"
-                       % (proc.returncode, tail[-1] if tail else ""))[:300]
+            result = True, ""
+        else:
+            tail = stderr.decode(errors="replace").strip().splitlines()
+            result = False, ("probe exited %d: %s"
+                             % (proc.returncode,
+                                tail[-1] if tail else ""))[:300]
     except subprocess.TimeoutExpired:
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError, OSError):
             pass
         proc.wait()
-        return False, (f"probe timed out after {timeout_s:.0f}s "
-                       "(wedged accelerator tunnel?)")
+        result = False, (f"probe timed out after {timeout_s:.0f}s "
+                         "(wedged accelerator tunnel?)")
     except (subprocess.SubprocessError, OSError) as exc:
-        return False, f"probe failed to launch: {exc!r}"[:300]
+        result = False, f"probe failed to launch: {exc!r}"[:300]
+    _MEMO = result
+    return result
+
+
+def force_cpu_if_unreachable(label: str):
+    """Probe once (memoized); when the accelerator is unreachable, force
+    the CPU platform and return the reason string (``None`` when
+    reachable). Call BEFORE anything initializes jax backends — the
+    ``jax_platforms`` config is read at first backend init; if a backend
+    already exists, a best-effort ``clear_backends()`` makes the switch
+    take effect anyway."""
+    ok, why = accelerator_reachable()
+    if ok:
+        return None
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.extend.backend.clear_backends()
+    except Exception:
+        pass  # best-effort: no backend initialized yet is the normal case
+    print(f"{label}: accelerator unreachable ({why}); CPU-platform fallback")
+    return why
