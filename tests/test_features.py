@@ -164,3 +164,59 @@ async def test_mesh_reforms_on_heartbeat():
                          and b1.connections.num_brokers == 1)
     finally:
         await cluster.stop()
+
+
+async def test_marshal_death_and_replacement():
+    """The marshal is stateless (parity cdn-marshal: horizontally
+    scalable, handlers.rs soft-closes after every auth): killing it must
+    not disturb already-connected clients (they only ever used it to get
+    a permit), new connects must fail while it is down, and a REPLACEMENT
+    marshal on the same discovery store must serve new auths immediately —
+    including a re-auth from a client whose connection was torn down."""
+    from pushcdn_tpu.marshal import Marshal, MarshalConfig
+
+    cluster = await Cluster(num_brokers=1).start()
+    try:
+        alive = cluster.client(seed=7101, topics=[0])
+        await alive.ensure_initialized()
+
+        # marshal dies
+        await cluster.marshal.stop()
+
+        # existing session unaffected: the broker link never involved it
+        await alive.send_broadcast_message([0], b"marshal-less")
+        got = await asyncio.wait_for(alive.receive_message(), 10)
+        assert bytes(got.message) == b"marshal-less"
+
+        # a new client cannot authenticate while the marshal is down
+        orphan = cluster.client(seed=7102, topics=[0])
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(orphan.ensure_initialized(), 1.0)
+
+        # replacement marshal: same discovery store, same endpoint name
+        cluster.marshal = await Marshal.new(MarshalConfig(
+            run_def=cluster.run_def,
+            discovery_endpoint=cluster.db,
+            bind_endpoint=cluster.marshal_endpoint,
+        ))
+        await cluster.marshal.start()
+
+        # the orphan's single-flight retry loop (2 s cadence) finds the
+        # replacement and completes the full permit handshake
+        await asyncio.wait_for(orphan.ensure_initialized(), 15)
+        await orphan.send_broadcast_message([0], b"via replacement")
+        for c in (alive, orphan):
+            got = await asyncio.wait_for(c.receive_message(), 10)
+            assert bytes(got.message) == b"via replacement"
+
+        # a torn-down session re-auths through the NEW marshal too
+        alive._disconnect_on_error()
+        await alive.ensure_initialized()
+        await alive.send_direct_message(alive.public_key, b"re-authed")
+        got = await asyncio.wait_for(alive.receive_message(), 10)
+        assert bytes(got.message) == b"re-authed"
+
+        alive.close()
+        orphan.close()
+    finally:
+        await cluster.stop()
