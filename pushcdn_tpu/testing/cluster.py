@@ -123,6 +123,26 @@ class Cluster:
             subscribed_topics=set(topics),
         ))
 
+    async def restart_broker(self, broker_index: int) -> "Broker":
+        """Start a replacement broker under broker_index's identity
+        (same endpoints + deployment keypair, same config shape as
+        ``start`` — single source of truth for restart tests). The old
+        instance must already be stopped."""
+        pub, priv = self.broker_endpoints(broker_index)
+        broker = await Broker.new(BrokerConfig(
+            run_def=self.run_def,
+            keypair=self.broker_keypair,
+            discovery_endpoint=self.db,
+            public_advertise_endpoint=pub, public_bind_endpoint=pub,
+            private_advertise_endpoint=priv, private_bind_endpoint=priv,
+            heartbeat_interval_s=3600, sync_interval_s=3600,
+            whitelist_interval_s=3600,
+            device_plane=self.device_plane,
+        ))
+        await broker.start()
+        self.brokers[broker_index] = broker
+        return broker
+
     async def steer_load(self, broker_index: int, load: int):
         """Fake a broker's advertised load to steer marshal placement
         (parity double_connect.rs:100-121)."""

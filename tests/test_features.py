@@ -126,10 +126,12 @@ async def test_mesh_self_heals_after_broker_death():
         assert cluster.brokers[0].connections.num_brokers == 1
         # broker 1 dies
         await cluster.brokers[1].stop()
-        # survivor detects on next send: force a sync -> send fails -> removal
+        # survivor detects on next send: force a sync -> send fails ->
+        # removal (the EOF path may have already removed it)
         from pushcdn_tpu.broker.tasks.sync import full_user_sync
-        peer = cluster.brokers[0].connections.all_broker_identifiers()[0]
-        await full_user_sync(cluster.brokers[0], peer)
+        peers = cluster.brokers[0].connections.all_broker_identifiers()
+        if peers:
+            await full_user_sync(cluster.brokers[0], peers[0])
         await wait_until(lambda: cluster.brokers[0].connections.num_brokers == 0)
 
         # clients still work through the survivor (marshal re-steers: the
@@ -218,5 +220,71 @@ async def test_marshal_death_and_replacement():
 
         alive.close()
         orphan.close()
+    finally:
+        await cluster.stop()
+
+
+async def test_broker_restart_same_identity_rejoins_and_resyncs():
+    """Broker state is soft by design (SURVEY §5: no checkpointing —
+    rebuilt from discovery + full CRDT syncs on reconnect). A broker that
+    dies and comes back under the SAME identity must rejoin the mesh on a
+    heartbeat tick, receive/serve full syncs, and have its reconnected
+    users reachable from the surviving broker's DirectMap."""
+    from pushcdn_tpu.broker.tasks.sync import full_user_sync
+
+    cluster = await Cluster(num_brokers=2).start()
+    try:
+        await cluster.place_on(0)
+        alice = cluster.client(seed=7301, topics=[0])
+        await alice.ensure_initialized()
+        await cluster.place_on(1)
+        bob = cluster.client(seed=7302, topics=[0])
+        await bob.ensure_initialized()
+        await wait_until(
+            lambda: cluster.brokers[1].connections.num_users == 1)
+
+        # broker 1 dies; the survivor notices on the next send (it may
+        # already have noticed via the closing stream's EOF, so the list
+        # can legitimately be empty by the time we look)
+        await cluster.brokers[1].stop()
+        peers = cluster.brokers[0].connections.all_broker_identifiers()
+        if peers:
+            await full_user_sync(cluster.brokers[0], peers[0])
+        await wait_until(
+            lambda: cluster.brokers[0].connections.num_brokers == 0)
+        bob._disconnect_on_error()  # his session died with the broker
+
+        # restart under the SAME endpoints + deployment keypair
+        restarted = await cluster.restart_broker(1)
+
+        # mesh reforms on the next heartbeat round (>=-identifier dedup)
+        await heartbeat_once(cluster.brokers[0])
+        await heartbeat_once(restarted)
+        await wait_until(
+            lambda: cluster.brokers[0].connections.num_brokers == 1
+            and restarted.connections.num_brokers == 1)
+
+        # bob reconnects; the marshal steers him onto the restarted broker
+        await cluster.place_on(1)
+        await bob.ensure_initialized()
+        await wait_until(lambda: restarted.connections.num_users == 1)
+
+        # strong-consistency push (broker default) syncs bob's ownership;
+        # wait for the claim to land in the SURVIVOR's DirectMap before
+        # routing (the push crosses the mesh link asynchronously)
+        bob_pk = bytes(bob.public_key)
+        await wait_until(
+            lambda: cluster.brokers[0].connections
+            .get_broker_identifier_of_user(bob_pk) is not None)
+        await alice.send_direct_message(bob.public_key, b"after restart")
+        got = await asyncio.wait_for(bob.receive_message(), 10)
+        assert bytes(got.message) == b"after restart"
+        # and broadcast fan-out crosses the reformed link both ways
+        await bob.send_broadcast_message([0], b"mesh is back")
+        for c in (alice, bob):
+            got = await asyncio.wait_for(c.receive_message(), 10)
+            assert bytes(got.message) == b"mesh is back"
+        alice.close()
+        bob.close()
     finally:
         await cluster.stop()
