@@ -305,9 +305,10 @@ class FrameRing:
                         m.to_bytes(4 * W, "little"), np.uint32).copy()
                 return row
 
+            if not isinstance(tmasks, list):
+                tmasks = list(tmasks)  # tuples/arrays get the fast path too
             first = tmasks[0] if len(tmasks) else 0
-            if isinstance(tmasks, list) and \
-                    tmasks.count(first) == len(tmasks):
+            if tmasks.count(first) == len(tmasks):
                 # one publisher, one topic set — the dominant step shape:
                 # a single vectorized fill instead of a row per frame
                 tmasks_a[:] = expand(first)

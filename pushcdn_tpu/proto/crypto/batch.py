@@ -32,8 +32,11 @@ co-arrivals still coalesce into batches between the yields.
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 from typing import List, Optional, Set, Tuple
+
+logger = logging.getLogger("pushcdn.crypto.batch")
 
 
 class BatchVerifier:
@@ -42,13 +45,31 @@ class BatchVerifier:
         self.scheme = scheme
         self.max_batch = max_batch
         if offload is None:
-            # usable CPUs, not machine CPUs: a marshal pinned to one core
-            # by taskset/cgroups should take the inline path too
-            try:
-                usable = len(os.sched_getaffinity(0))
-            except (AttributeError, OSError):
-                usable = os.cpu_count() or 1
-            offload = usable > 1
+            # PUSHCDN_CRYPTO_OFFLOAD=0/1 overrides the autodetect: the
+            # affinity is sampled once here, so a later cgroup/affinity
+            # change is invisible — an operator who knows better can pin
+            # the policy instead of restarting into the right mask
+            env = os.environ.get("PUSHCDN_CRYPTO_OFFLOAD", "").strip().lower()
+            if env in ("0", "1", "false", "true", "no", "yes", "off", "on"):
+                offload = env in ("1", "true", "yes", "on")
+                logger.info("crypto offload policy: %s (PUSHCDN_CRYPTO_OFFLOAD)",
+                            "thread" if offload else "inline")
+            else:
+                if env:
+                    logger.warning(
+                        "PUSHCDN_CRYPTO_OFFLOAD=%r not recognized "
+                        "(want 0/1); falling back to autodetect", env)
+                # usable CPUs, not machine CPUs: a marshal pinned to one
+                # core by taskset/cgroups should take the inline path too
+                try:
+                    usable = len(os.sched_getaffinity(0))
+                except (AttributeError, OSError):
+                    usable = os.cpu_count() or 1
+                offload = usable > 1
+                logger.info(
+                    "crypto offload policy: %s (auto: %d usable CPU%s)",
+                    "thread" if offload else "inline",
+                    usable, "" if usable == 1 else "s")
         self._offload = offload
         self._batchable = hasattr(scheme, "verify_batch")
         self._inflight = False

@@ -58,8 +58,17 @@ def accelerator_reachable(timeout_s: float = 120.0,
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError, OSError):
+            try:
+                proc.kill()  # fall back to the direct child
+            except OSError:
+                pass  # this path must degrade to a report, never raise
+        try:
+            proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            # Unkillable child (e.g. stuck in uninterruptible IO on the
+            # tunnel fd): report rather than hang — the zombie is leaked
+            # deliberately, the alternative is blocking forever.
             pass
-        proc.wait()
         result = False, (f"probe timed out after {timeout_s:.0f}s "
                          "(wedged accelerator tunnel?)")
     except (subprocess.SubprocessError, OSError) as exc:
