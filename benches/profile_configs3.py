@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""cProfile attribution for the configs[3] decoded drain (VERDICT r4 #2).
+
+Reproduces exactly the ``configs3/device_mesh_broadcast_fanout`` phase of
+``configs_bench.py`` (8-shard device mesh, 16 clients, 1 KiB frames,
+2 publishers) with cProfile wrapped around the steady-state drain, then
+buckets cumulative time into the four suspects the verdict names: client
+decode, event loop machinery, broker egress, and the mesh step.
+
+Usage: python benches/profile_configs3.py [--msgs N] [--raw] [--dump F]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import cProfile
+import io
+import json
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+from pushcdn_tpu.proto.transport.memory import Memory
+
+
+async def _drain(client, n: int):
+    got = 0
+    async with asyncio.timeout(60):
+        while got < n:
+            got += len(await client.receive_messages(n - got))
+
+
+async def _drain_raw(client, n: int):
+    from pushcdn_tpu.proto.transport.base import FrameChunk
+    got = 0
+    conn = client._connection
+    async with asyncio.timeout(60):
+        while got < n:
+            for item in await conn.recv_frames(n - got):
+                got += item.remaining if type(item) is FrameChunk else 1
+                item.release()
+
+
+BUCKETS = {
+    "client_decode": ("client/client.py", "proto/message.py",
+                      "proto/limiter.py"),
+    "transport_pump": ("proto/transport/",),
+    "event_loop": ("asyncio/", "selectors.py", "selector_events.py"),
+    "broker_egress": ("tasks/senders.py", "native/__init__", "egress"),
+    "mesh_step": ("mesh_group.py", "parallel/", "jax/", "jaxlib"),
+    "broker_ingress": ("tasks/handlers.py", "tasks/listeners.py",
+                       "broker/connections.py"),
+}
+
+
+def bucket_of(path: str) -> str:
+    for name, pats in BUCKETS.items():
+        if any(p in path for p in pats):
+            return name
+    return "other"
+
+
+async def amain(msgs: int, raw: bool, dump: str | None,
+                profile: bool = True, trials: int = 1):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from pushcdn_tpu.bin.common import tune_gc
+    from pushcdn_tpu.testing.mesh_cluster import MeshCluster
+    tune_gc()
+
+    prev_window = Memory.set_duplex_window(256 * 1024)
+    cluster = await MeshCluster(
+        num_shards=8, ring_slots=1024, frame_bytes=2048,
+        batch_window_s=float(os.environ.get("PCFG3_WINDOW", "0.001")),
+        devices=jax.devices("cpu"), prefix="pcfg3",
+    ).start(form_host_mesh=False)
+    try:
+        clients = [await cluster.place_client(7000 + i, i % 8, topics=[0])
+                   for i in range(16)]
+        payload = os.urandom(1024)
+
+        # warmup: compile the step, steady the pumps
+        warm = [asyncio.create_task(
+            (_drain_raw if raw else _drain)(c, 200)) for c in clients]
+        for _ in range(100):
+            await clients[0].send_broadcast_message([0], payload)
+            await clients[1].send_broadcast_message([0], payload)
+        await asyncio.gather(*warm)
+
+        drain = _drain_raw if raw else _drain
+        per_client = msgs
+        prof = cProfile.Profile() if profile else None
+        rates = []
+        import gc
+        gc_mode = os.environ.get("PCFG3_GC", "off")
+        if gc_mode == "refreeze":
+            gc.collect(); gc.freeze()
+        elif gc_mode == "refreeze_big":
+            gc.collect(); gc.freeze(); gc.set_threshold(500_000, 100, 100)
+        for trial in range(trials):
+            if gc_mode == "off":
+                gc.collect()
+                gc.disable()
+            t0 = time.perf_counter()
+            if prof:
+                prof.enable()
+            drains = [asyncio.create_task(drain(c, per_client))
+                      for c in clients]
+            for _ in range(msgs // 2):
+                await clients[0].send_broadcast_message([0], payload)
+                await clients[1].send_broadcast_message([0], payload)
+            await asyncio.gather(*drains)
+            if prof:
+                prof.disable()
+            dt = time.perf_counter() - t0
+            if gc_mode == "off":
+                gc.enable()
+            rates.append(per_client * 16 / dt)
+        rate = max(rates)
+        print(json.dumps({
+            "bench": "profile/configs3_drain",
+            "mode": "raw" if raw else "decoded",
+            "deliveries_per_s": round(rate, 1), "wall_s": round(dt, 3),
+            "trials": [round(r, 1) for r in rates],
+        }), flush=True)
+
+        for c in clients:
+            c.close()
+        if not prof:
+            return
+        st = pstats.Stats(prof)
+        total = st.total_tt
+        # tottime (self time) attribution per file bucket
+        sums: dict = {}
+        for (path, _line, fname), (_cc, _nc, tt, _ct, _callers) in \
+                st.stats.items():
+            sums.setdefault(bucket_of(path), [0.0, []])
+            sums[bucket_of(path)][0] += tt
+        rows = sorted(sums.items(), key=lambda kv: -kv[1][0])
+        print(f"\n== self-time attribution (total {total:.2f}s profiled, "
+              f"wall {dt:.2f}s) ==")
+        for name, (tt, _) in rows:
+            print(f"  {name:16s} {tt:7.2f}s  {tt / total * 100:5.1f}%")
+
+        print("\n== top 25 self-time functions ==")
+        out = io.StringIO()
+        st.stream = out
+        st.sort_stats("tottime").print_stats(25)
+        print(out.getvalue())
+        if dump:
+            prof.dump_stats(dump)
+            print(f"profile dumped to {dump}")
+    finally:
+        await cluster.stop()
+        Memory.set_duplex_window(prev_window)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--msgs", type=int, default=4000)
+    ap.add_argument("--raw", action="store_true")
+    ap.add_argument("--dump")
+    ap.add_argument("--noprofile", action="store_true")
+    ap.add_argument("--trials", type=int, default=1)
+    args = ap.parse_args()
+    asyncio.run(amain(args.msgs, args.raw, args.dump,
+                      profile=not args.noprofile, trials=args.trials))
+
+
+if __name__ == "__main__":
+    main()

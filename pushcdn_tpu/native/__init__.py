@@ -26,18 +26,26 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _compile() -> Optional[ctypes.CDLL]:
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    if not os.path.exists(_LIB_PATH) or \
-            os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH):
-        cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB_PATH]
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        except (subprocess.SubprocessError, OSError):
-            return None
+def _build_lib(src: str, lib_path: str, loader, extra_flags: tuple = ()):
+    """Compile ``src`` to ``lib_path`` when stale and load it via
+    ``loader`` (CDLL or PyDLL). Returns None on ANY failure — a missing
+    source next to a cached .so, a compiler error, a load error — so
+    callers always degrade to their Python fallback."""
     try:
-        lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        if not os.path.exists(lib_path) or \
+                os.path.getmtime(src) > os.path.getmtime(lib_path):
+            cmd = ["g++", "-O3", "-shared", "-fPIC", *extra_flags,
+                   src, "-o", lib_path]
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return loader(lib_path)
+    except (subprocess.SubprocessError, OSError):
+        return None
+
+
+def _compile() -> Optional[ctypes.CDLL]:
+    lib = _build_lib(_SRC, _LIB_PATH, ctypes.CDLL)
+    if lib is None:
         return None
 
     u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -84,6 +92,49 @@ def _get() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _get() is not None
+
+
+# -- CPython-API batch decoder (native/pydecode.cpp) -------------------------
+#
+# A SEPARATE library from the framing CDLL: it is loaded via PyDLL so calls
+# keep the GIL (the decoder builds Python objects), whereas the framing
+# lib's plain-C calls release it.
+
+_PYDECODE_SRC = os.path.join(_REPO, "native", "pydecode.cpp")
+_PYDECODE_LIB = os.path.join(_BUILD_DIR, "libpushcdn_pydecode.so")
+_pydecode_fn = None
+_pydecode_tried = False
+
+
+def _compile_pydecode():
+    import sysconfig
+    lib = _build_lib(_PYDECODE_SRC, _PYDECODE_LIB, ctypes.PyDLL,
+                     ("-I", sysconfig.get_paths()["include"]))
+    if lib is None:
+        return None
+    fn = lib.pushcdn_decode_frames_py
+    fn.restype = ctypes.py_object
+    fn.argtypes = [ctypes.py_object, ctypes.py_object, ctypes.py_object,
+                   ctypes.c_ssize_t, ctypes.py_object, ctypes.py_object,
+                   ctypes.py_object]
+    return fn
+
+
+def pydecode():
+    """The batch frame→Message decoder, or None when unavailable.
+
+    Signature: ``fn(buf, offs, lens, start, Broadcast, Direct, fallback)``
+    → list of messages, or None when the inputs don't fit the C fast path
+    (caller must then run the Python decoder). Raises whatever ``fallback``
+    raises on malformed frames.
+    """
+    global _pydecode_fn, _pydecode_tried
+    if _pydecode_fn is None and not _pydecode_tried:
+        with _lock:
+            if _pydecode_fn is None and not _pydecode_tried:
+                _pydecode_fn = _compile_pydecode()
+                _pydecode_tried = True
+    return _pydecode_fn
 
 
 def _ptr(a: np.ndarray, ctype):

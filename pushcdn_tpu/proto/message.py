@@ -408,13 +408,32 @@ def deserialize_owned(frame: BytesLike) -> Message:
                                    else frame))
 
 
+_native_decode = None
+_native_decode_tried = False
+
+
 def decode_frames(buf: bytes, offs, lens, start: int = 0) -> list:
     """Decode a parse batch's frames straight off the shared chunk buffer
     (transport ``FrameChunk``) — the fan-out drain's hot loop. Inline
     little-endian field reads replace per-frame memoryview + Struct calls;
     payload/recipient slices of the ``bytes`` buffer are the single owned
     copy. Cold kinds and malformed frames take the general path (which
-    raises the usual ``Error(DESERIALIZE)``)."""
+    raises the usual ``Error(DESERIALIZE)``).
+
+    The loop itself runs in C when the native library is available
+    (native/pydecode.cpp — same construction, same fallback semantics,
+    ~5x less per-message cost); this Python body is the fallback and the
+    executable specification."""
+    global _native_decode, _native_decode_tried
+    if not _native_decode_tried:
+        from pushcdn_tpu import native as _native_mod
+        _native_decode = _native_mod.pydecode()
+        _native_decode_tried = True
+    if _native_decode is not None:
+        res = _native_decode(buf, offs, lens, start,
+                             Broadcast, Direct, deserialize_owned)
+        if res is not None:
+            return res
     out = []
     append = out.append
     for i in range(start, len(offs)):

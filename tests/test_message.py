@@ -157,3 +157,69 @@ def test_decode_frames_matches_deserialize_fuzz():
                 assert bytes(a) == bytes(b), field
             else:
                 assert a == b, field
+
+
+def test_decode_frames_native_vs_python_paths(monkeypatch):
+    """When the C batch decoder (native/pydecode.cpp) is available, it
+    must produce the same objects AND the same failures as the Python
+    loop — they are dual implementations of one spec."""
+    import random
+
+    import pushcdn_tpu.proto.message as message_mod
+    from pushcdn_tpu.proto.message import decode_frames, deserialize_owned
+
+    rng = random.Random(99)
+    frames = []
+    for _ in range(100):
+        pick = rng.randrange(4)
+        if pick == 0:
+            frames.append(serialize(Broadcast(
+                topics=[rng.randrange(256)
+                        for _ in range(rng.randrange(0, 4))],
+                message=rng.randbytes(rng.randrange(0, 200)))))
+        elif pick == 1:
+            frames.append(serialize(Direct(
+                recipient=rng.randbytes(rng.randrange(0, 48)),
+                message=rng.randbytes(rng.randrange(0, 200)))))
+        elif pick == 2:  # cold kind via the fallback
+            frames.append(serialize(Subscribe(
+                topics=[rng.randrange(256)
+                        for _ in range(rng.randrange(0, 4))])))
+        else:  # empty-ish hot frames (boundary sizes)
+            frames.append(serialize(Broadcast(topics=[], message=b"")))
+    buf = bytearray()
+    offs, lens = [], []
+    for f in frames:
+        offs.append(len(buf))
+        lens.append(len(f))
+        buf += f
+    buf = bytes(buf)
+
+    native_out = decode_frames(buf, offs, lens)
+    # force the Python loop and compare
+    monkeypatch.setattr(message_mod, "_native_decode", None)
+    monkeypatch.setattr(message_mod, "_native_decode_tried", True)
+    python_out = decode_frames(buf, offs, lens)
+    assert len(native_out) == len(python_out) == len(frames)
+    for a, b in zip(native_out, python_out):
+        assert type(a) is type(b)
+        assert a == b
+
+    # malformed hot frames must raise the same Error on both paths
+    bad_cases = [
+        b"\x05\xff\xff",          # Broadcast claims 65535 topics in 3 B
+        b"\x04\xff\xff\xff\x7f",  # Direct recipient overruns frame
+    ]
+    for bad in bad_cases:
+        # pin the Python loop for py_err (decode_frames re-installs the
+        # native fn as a side effect of the nat_err call below, so this
+        # must be re-pinned every iteration)
+        monkeypatch.setattr(message_mod, "_native_decode", None)
+        monkeypatch.setattr(message_mod, "_native_decode_tried", True)
+        with pytest.raises(Error) as py_err:
+            decode_frames(bad, [0], [len(bad)])
+        monkeypatch.setattr(message_mod, "_native_decode_tried", False)
+        with pytest.raises(Error) as nat_err:
+            decode_frames(bad, [0], [len(bad)])
+        assert message_mod._native_decode is not None  # native path ran
+        assert py_err.value.kind == nat_err.value.kind
