@@ -60,9 +60,20 @@ async def authenticate_with_marshal(
 
 async def authenticate_with_broker(
         connection: Connection, permit: int, topics: List[int]) -> None:
-    """Redeem ``permit``; on ack, send our subscription set (user.rs:108-161)."""
+    """Redeem ``permit`` and replay our subscription set (user.rs:108-161).
+
+    The wire sequence is the reference's (permit, ack, Subscribe) but the
+    client PIPELINES: permit and Subscribe go out in one flush, then the
+    ack is awaited. The broker reads them in order either way (it
+    validates the permit before touching the Subscribe), an invalid
+    permit still tears the connection down before the Subscribe is acted
+    on, and the handshake drops one full round trip."""
+    # both flushed: back-to-back flushed sends on an idle link take the
+    # transport's inline fast path (no writer-task spawn for the whole
+    # handshake), and the broker still reads them in order
     await connection.send_message(AuthenticateWithPermit(permit=permit),
                                   flush=True)
+    await connection.send_message(Subscribe(topics), flush=True)
     response = await connection.recv_message()
     if not isinstance(response, AuthenticateResponse):
         bail(ErrorKind.AUTHENTICATION,
@@ -70,5 +81,3 @@ async def authenticate_with_broker(
     if response.permit != 1:
         bail(ErrorKind.AUTHENTICATION,
              f"broker rejected permit: {response.context!r}")
-    # Replay our subscriptions as part of the handshake (user.rs:152-158).
-    await connection.send_message(Subscribe(topics), flush=True)

@@ -245,8 +245,18 @@ class Connection:
         self._recv_pending: deque = deque()
         self._error: Optional[Error] = None
         self._closed = False
-        self._writer_task = asyncio.create_task(self._writer_loop())
+        # serializes stream writes between the writer task and the inline
+        # flush fast path in send_raw (see there)
+        self._write_mutex = asyncio.Lock()
+        # the writer task spawns lazily on the first QUEUED send: a
+        # handshake-only link whose few flushed sends all take the inline
+        # fast path never pays the task spawn (or its batch encoder)
+        self._writer_task: Optional[asyncio.Task] = None
         self._reader_task = asyncio.create_task(self._reader_loop())
+
+    def _ensure_writer(self) -> None:
+        if self._writer_task is None:
+            self._writer_task = asyncio.create_task(self._writer_loop())
 
     # -- actor loops --------------------------------------------------------
 
@@ -278,156 +288,25 @@ class Connection:
 
     async def _writer_loop(self) -> None:
         # the native batch encoder length-delimits a run of small frames in
-        # one C call + one copy (the verdict's "egress batches ... through
-        # encode_frames"); None ⇒ the Python coalescer below does it
-        encoder = native.FrameEncoder.create(4 * self._BATCH_COALESCE_LIMIT)
+        # one C call + one copy; created lazily on the first BATCH (its
+        # reusable output buffer is a ~256 KiB allocation that depth-1 and
+        # handshake traffic never needs). None ⇒ Python coalescer.
+        encoder_cell = [False]  # False = not created yet; None = no native
         enc_cap = 3 * self._BATCH_COALESCE_LIMIT
         batch: list = []
         try:
             while True:
                 item = await self._send_q.get()
-                if item is _CLOSE:
-                    await self._stream.close()
-                    return
-                # Depth-1 fast path (the latency regime): one small single
-                # frame and nothing else queued — write it directly, skipping
-                # batch assembly, the get_nowait exception, flattening and
-                # encoder probing. This is what a handshake or an idle-link
-                # echo pays per message.
-                if self._send_q.empty():
-                    payload, done = item
-                    if type(payload) is PreEncoded:
-                        await self._flush_chunked(payload.data)
-                        if done is not None and not done.done():
-                            done.set_result(None)
-                        continue
-                    if type(payload) is not list:
-                        data = payload.data if isinstance(payload, Bytes) \
-                            else payload
-                        n = len(data)
-                        if n <= self._BATCH_COALESCE_LIMIT:
-                            batch = [item]
-                            try:
-                                one = bytearray(_LEN.pack(n))
-                                one += data
-                                await self._flush(one)
-                            finally:
-                                if isinstance(payload, Bytes):
-                                    payload.release()
-                            batch = []
-                            if done is not None and not done.done():
-                                done.set_result(None)
-                            continue
-                # Drain everything queued right now into one write batch.
-                batch = [item]
-                while len(batch) < 512:
-                    try:
-                        nxt = self._send_q.get_nowait()
-                    except asyncio.QueueEmpty:
-                        break
-                    batch.append(nxt)
-                    if nxt is _CLOSE:
-                        break
-
-                dones = []
-                close_after = False
+                # every write section holds the mutex: send_raw's inline
+                # flush fast path writes from the sender's task, and the
+                # two paths must never interleave bytes on the stream
+                await self._write_mutex.acquire()
                 try:
-                    # flatten: an entry's payload is one frame or a whole
-                    # list of frames (send_raw_many batches)
-                    frames: list = []
-                    for entry in batch:
-                        if entry is _CLOSE:
-                            close_after = True
-                            break
-                        payload, done = entry
-                        if type(payload) is list:
-                            for p in payload:
-                                frames.append(
-                                    p.data if isinstance(p, Bytes) else p)
-                        else:
-                            frames.append(payload.data
-                                          if isinstance(payload, Bytes)
-                                          else payload)
-                        if done is not None:
-                            dones.append(done)
-
-                    buf = bytearray()
-                    i, nf = 0, len(frames)
-                    while i < nf:
-                        data = frames[i]
-                        if type(data) is PreEncoded:
-                            if buf:
-                                await self._flush(buf)
-                                buf = bytearray()
-                            await self._flush_chunked(data.data)
-                            i += 1
-                            continue
-                        n = len(data)
-                        if encoder is not None and type(data) is bytes \
-                                and n <= self._BATCH_COALESCE_LIMIT:
-                            # native run: consecutive small bytes frames
-                            j, total = i, 0
-                            while j < nf:
-                                d = frames[j]
-                                if type(d) is not bytes:
-                                    break
-                                ln = len(d)
-                                if ln > self._BATCH_COALESCE_LIMIT or \
-                                        total + ln + 4 > enc_cap:
-                                    break
-                                total += ln + 4
-                                j += 1
-                            if j - i > 1:
-                                if buf:
-                                    await self._flush(buf)
-                                    buf = bytearray()
-                                enc = encoder.encode(frames[i:j])
-                                if enc is not None:
-                                    try:
-                                        await self._flush(enc)
-                                    finally:
-                                        enc.release()
-                                    i = j
-                                    continue
-                                # encode failed (shouldn't): python path
-                        if n <= self._BATCH_COALESCE_LIMIT:
-                            buf += _LEN.pack(n)
-                            buf += data
-                            if len(buf) >= self._BATCH_COALESCE_LIMIT:
-                                await self._flush(buf)
-                                buf = bytearray()
-                        else:
-                            if buf:
-                                await self._flush(buf)
-                                buf = bytearray()
-                            await self._flush(bytearray(_LEN.pack(n)))
-                            # large frames flush in bounded chunks so slow
-                            # links get a timeout window per chunk, not one
-                            # window for the whole payload
-                            view = memoryview(data)
-                            chunk = 4 * self._BATCH_COALESCE_LIMIT
-                            for off in range(0, n, chunk):
-                                await self._flush(bytearray(view[off:off + chunk]))
-                        i += 1
-                    if buf:
-                        await self._flush(buf)
+                    closed = await self._writer_item(item, encoder_cell,
+                                                     enc_cap, batch)
                 finally:
-                    for entry in batch:
-                        if entry is _CLOSE:
-                            continue
-                        p = entry[0]
-                        if type(p) is list:
-                            for x in p:
-                                if isinstance(x, Bytes):
-                                    x.release()
-                        elif isinstance(p, Bytes):
-                            p.release()
-                batch = []
-                for done in dones:
-                    if not done.done():
-                        done.set_result(None)
-                if close_after:
-                    await self._stream.close()
+                    self._write_mutex.release()
+                if closed:
                     return
         except asyncio.CancelledError:
             # close() cancels the writer mid-flush: flush=True senders whose
@@ -448,6 +327,163 @@ class Connection:
                         and not entry[1].done():
                     entry[1].set_exception(err)
             self._poison(err)
+
+    async def _writer_item(self, item, encoder_cell, enc_cap,
+                           batch: list) -> bool:
+        """Process one dequeued writer entry (and any batchable run behind
+        it). ``batch`` is the caller's scratch list, mutated IN PLACE —
+        in-flight entries live there so the writer loop's cancel/error
+        handlers can resolve their futures. Always called under
+        ``_write_mutex`` (the inline flush path in ``send_raw`` takes the
+        same mutex)."""
+        if item is _CLOSE:
+            await self._stream.close()
+            return True
+        # Depth-1 fast path (the latency regime): one small single frame
+        # and nothing else queued — write it directly, skipping batch
+        # assembly, the get_nowait exception, flattening and encoder
+        # probing. This is what a handshake or an idle-link echo pays per
+        # message.
+        if self._send_q.empty():
+            payload, done = item
+            if type(payload) is PreEncoded:
+                await self._flush_chunked(payload.data)
+                if done is not None and not done.done():
+                    done.set_result(None)
+                return False
+            if type(payload) is not list:
+                data = payload.data if isinstance(payload, Bytes) \
+                    else payload
+                n = len(data)
+                if n <= self._BATCH_COALESCE_LIMIT:
+                    batch.append(item)
+                    try:
+                        one = bytearray(_LEN.pack(n))
+                        one += data
+                        await self._flush(one)
+                    finally:
+                        if isinstance(payload, Bytes):
+                            payload.release()
+                    batch.clear()
+                    if done is not None and not done.done():
+                        done.set_result(None)
+                    return False
+        # Drain everything queued right now into one write batch.
+        batch.append(item)
+        while len(batch) < 512:
+            try:
+                nxt = self._send_q.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            batch.append(nxt)
+            if nxt is _CLOSE:
+                break
+
+        if encoder_cell[0] is False:
+            encoder_cell[0] = native.FrameEncoder.create(
+                4 * self._BATCH_COALESCE_LIMIT)
+        encoder = encoder_cell[0]
+        dones = []
+        close_after = False
+        try:
+            # flatten: an entry's payload is one frame or a whole
+            # list of frames (send_raw_many batches)
+            frames: list = []
+            for entry in batch:
+                if entry is _CLOSE:
+                    close_after = True
+                    break
+                payload, done = entry
+                if type(payload) is list:
+                    for p in payload:
+                        frames.append(
+                            p.data if isinstance(p, Bytes) else p)
+                else:
+                    frames.append(payload.data
+                                  if isinstance(payload, Bytes)
+                                  else payload)
+                if done is not None:
+                    dones.append(done)
+
+            buf = bytearray()
+            i, nf = 0, len(frames)
+            while i < nf:
+                data = frames[i]
+                if type(data) is PreEncoded:
+                    if buf:
+                        await self._flush(buf)
+                        buf = bytearray()
+                    await self._flush_chunked(data.data)
+                    i += 1
+                    continue
+                n = len(data)
+                if encoder is not None and type(data) is bytes \
+                        and n <= self._BATCH_COALESCE_LIMIT:
+                    # native run: consecutive small bytes frames
+                    j, total = i, 0
+                    while j < nf:
+                        d = frames[j]
+                        if type(d) is not bytes:
+                            break
+                        ln = len(d)
+                        if ln > self._BATCH_COALESCE_LIMIT or \
+                                total + ln + 4 > enc_cap:
+                            break
+                        total += ln + 4
+                        j += 1
+                    if j - i > 1:
+                        if buf:
+                            await self._flush(buf)
+                            buf = bytearray()
+                        enc = encoder.encode(frames[i:j])
+                        if enc is not None:
+                            try:
+                                await self._flush(enc)
+                            finally:
+                                enc.release()
+                            i = j
+                            continue
+                        # encode failed (shouldn't): python path
+                if n <= self._BATCH_COALESCE_LIMIT:
+                    buf += _LEN.pack(n)
+                    buf += data
+                    if len(buf) >= self._BATCH_COALESCE_LIMIT:
+                        await self._flush(buf)
+                        buf = bytearray()
+                else:
+                    if buf:
+                        await self._flush(buf)
+                        buf = bytearray()
+                    await self._flush(bytearray(_LEN.pack(n)))
+                    # large frames flush in bounded chunks so slow
+                    # links get a timeout window per chunk, not one
+                    # window for the whole payload
+                    view = memoryview(data)
+                    chunk = 4 * self._BATCH_COALESCE_LIMIT
+                    for off in range(0, n, chunk):
+                        await self._flush(bytearray(view[off:off + chunk]))
+                i += 1
+            if buf:
+                await self._flush(buf)
+        finally:
+            for entry in batch:
+                if entry is _CLOSE:
+                    continue
+                p = entry[0]
+                if type(p) is list:
+                    for x in p:
+                        if isinstance(x, Bytes):
+                            x.release()
+                elif isinstance(p, Bytes):
+                    p.release()
+        batch.clear()
+        for done in dones:
+            if not done.done():
+                done.set_result(None)
+        if close_after:
+            await self._stream.close()
+            return True
+        return False
 
     # One bulk read per wakeup, then parse every complete frame out of the
     # carry buffer — the old two-awaits-per-frame loop spent ~70% of small-
@@ -676,17 +712,20 @@ class Connection:
             self._error = err
         self._closed = True
         self._stream.abort()
-        self._drain_queues(err)
+        # Resolve blocked senders, but KEEP the receive side: frames that
+        # arrived before the failure are still deliverable (TCP delivers
+        # data queued ahead of a FIN; a reader that parses a chunk and hits
+        # EOF in the same wakeup must not steal the parsed frames back).
+        # The error marker queues BEHIND them; the owner's eventual
+        # ``close()`` returns any never-consumed permits to the pool.
+        self._drain_send_queue(err)
         # Wake any blocked receiver.
         try:
             self._recv_q.put_nowait(err)
         except asyncio.QueueFull:
             pass
 
-    def _drain_queues(self, err: Optional[Error]) -> None:
-        """Release every queued frame's pool permit (both directions). A
-        closed/poisoned connection must hand its bytes back to the global
-        pool or fan-out clones leak permits until the broker stalls."""
+    def _drain_send_queue(self, err: Optional[Error]) -> None:
         while True:
             try:
                 item = self._send_q.get_nowait()
@@ -706,6 +745,12 @@ class Connection:
                     done.set_exception(err)
                 else:
                     done.cancel()
+
+    def _drain_queues(self, err: Optional[Error]) -> None:
+        """Release every queued frame's pool permit (both directions). A
+        closed connection must hand its bytes back to the global pool or
+        fan-out clones leak permits until the broker stalls."""
+        self._drain_send_queue(err)
         while self._recv_pending:
             item = self._recv_pending.popleft()
             if isinstance(item, (Bytes, FrameChunk)):
@@ -738,8 +783,42 @@ class Connection:
         With ``flush=True``, wait until the frame hits the stream — used by
         handshakes; the hot path queues and returns (reference
         send_message_raw semantics).
+
+        Inline fast path: a flushed small frame on an idle link is written
+        directly from the caller's task (no writer-task wakeup, no done
+        future) — one scheduling round instead of three per handshake
+        message. Only taken when the send queue is empty AND the writer
+        isn't mid-write (``_write_mutex``), so frames can never reorder or
+        interleave; the mutex acquire is non-yielding in that state, which
+        makes check-then-acquire atomic on the single loop.
         """
         self._check()
+        if flush and self._send_q.empty() and not self._write_mutex.locked():
+            data = raw.data if isinstance(raw, Bytes) else raw
+            if type(data) is bytes and len(data) <= self._BATCH_COALESCE_LIMIT:
+                await self._write_mutex.acquire()
+                try:
+                    one = bytearray(_LEN.pack(len(data)))
+                    one += data
+                    await self._flush(one)
+                except asyncio.CancelledError:
+                    # cancelled mid-write: part of the frame may already be
+                    # on the stream (transports commit incrementally), so
+                    # the link's framing can no longer be trusted — poison,
+                    # exactly like the writer loop cancelled mid-flush
+                    self._poison(Error(ErrorKind.CONNECTION,
+                                       "send cancelled mid-write"))
+                    raise
+                except Exception as exc:
+                    err = Error(ErrorKind.CONNECTION,
+                                f"write failed: {exc!r}", exc)
+                    self._poison(err)
+                    raise err
+                finally:
+                    if isinstance(raw, Bytes):
+                        raw.release()
+                    self._write_mutex.release()
+                return
         done = asyncio.get_running_loop().create_future() if flush else None
         q = self._send_q
         if q.maxsize <= 0:
@@ -752,6 +831,7 @@ class Connection:
             q.put_nowait((raw, done))
         else:
             await q.put((raw, done))
+        self._ensure_writer()
         if self._error is not None:  # poisoned while enqueueing
             raise self._error
         if done is not None:
@@ -764,6 +844,7 @@ class Connection:
         peer can't stall the pump."""
         self._check()
         self._send_q.put_nowait((raw, None))
+        self._ensure_writer()
         if self._error is not None:
             raise self._error
 
@@ -789,8 +870,10 @@ class Connection:
             q = self._send_q
             if q.maxsize <= 0:
                 q.put_nowait((raws, done))  # unbounded: no coroutine hop
+                self._ensure_writer()
             else:
                 await q.put((raws, done))  # bounded: queue behind waiters
+                self._ensure_writer()
         except BaseException:
             # cancelled while blocked on a bounded queue: never inserted
             for p in raws:
@@ -815,6 +898,7 @@ class Connection:
         (kept alive by this reference until written)."""
         self._check()
         self._send_q.put_nowait((PreEncoded(data), None))
+        self._ensure_writer()
         if self._error is not None:
             raise self._error
 
@@ -825,6 +909,7 @@ class Connection:
         try:
             self._check()
             self._send_q.put_nowait((raws, None))
+            self._ensure_writer()
         except BaseException:
             for p in raws:
                 if isinstance(p, Bytes):
@@ -957,6 +1042,18 @@ class Connection:
         if self._error is not None:
             raise self._error
         self._closed = True
+        if self._writer_task is None:
+            # nothing was ever queued: flush is trivially done — close the
+            # write side directly (under the mutex so an in-flight inline
+            # write completes first)
+            try:
+                async with asyncio.timeout(WRITE_TIMEOUT_S):
+                    async with self._write_mutex:
+                        await self._stream.close()
+            except Exception:
+                pass
+            self._reader_task.cancel()
+            return
         await self._send_q.put(_CLOSE)
         try:
             async with asyncio.timeout(WRITE_TIMEOUT_S):
@@ -970,7 +1067,8 @@ class Connection:
     def close(self) -> None:
         """Tear down immediately (abort both tasks, return queued permits)."""
         self._closed = True
-        self._writer_task.cancel()
+        if self._writer_task is not None:
+            self._writer_task.cancel()
         self._reader_task.cancel()
         self._stream.abort()
         self._drain_queues(self._error)

@@ -178,6 +178,13 @@ async def test_subscribe_delivery_and_invalid_topic_kick():
         await bob.ensure_initialized()
 
         await alice.send_broadcast_message([0], b"one")
+        # pin the broker-side order: once alice (a topic-0 subscriber) has
+        # her copy back, the broker has already routed "one" — sends return
+        # when queued, not when routed, so bob's subscribe could otherwise
+        # legally overtake it (same non-guarantee as the reference's queued
+        # send_message_raw)
+        got = await asyncio.wait_for(alice.receive_message(), 5)
+        assert bytes(got.message) == b"one"
         await bob.subscribe([0])
         await asyncio.sleep(0.1)
         await alice.send_broadcast_message([0], b"two")
