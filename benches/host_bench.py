@@ -40,7 +40,7 @@ from pushcdn_tpu.broker.tasks.heartbeat import heartbeat_once
 from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME
 from pushcdn_tpu.proto.def_ import testing_run_def
 from pushcdn_tpu.proto.message import Broadcast, Direct
-from pushcdn_tpu.proto.transport import Memory, Quic, Tcp
+from pushcdn_tpu.proto.transport import Memory, Quic, Tcp, TcpTls
 from pushcdn_tpu.proto.transport.memory import gen_testing_connection_pair
 
 RESULTS: list[dict] = []
@@ -335,6 +335,12 @@ async def amain(quick: bool):
                 Memory.set_duplex_window(prev)
     for size in sizes:
         await bench_transport(Tcp, "127.0.0.1:0", size,
+                              min(budget, max(10 * size, floor)))
+    for size in sizes:
+        # kernel TCP + TLS: the apples-to-apples baseline for the
+        # QUIC-class rows below (those carry TLS 1.3 too; plain TCP does
+        # not, so its rows measure an unencrypted stack)
+        await bench_transport(TcpTls, "127.0.0.1:0", size,
                               min(budget, max(10 * size, floor)))
     for size in sizes:
         # QUIC-class UDP: same byte budget as TCP — with congestion

@@ -286,7 +286,19 @@ class _UdpStream(RawStream):
             if len(body) >= _PLEN.size:
                 (plen,) = _PLEN.unpack_from(body)
                 if plen in PROBE_DATAGRAM_SIZES:
-                    self._mtu = max(self._mtu, plen - _DATA_OVERHEAD)
+                    new_mtu = max(self._mtu, plen - _DATA_OVERHEAD)
+                    if new_mtu > self._mtu and self._ssthresh == float("inf"):
+                        # cwnd is segment-denominated CC state expressed in
+                        # bytes; a probed-up path just redefined "segment".
+                        # Before any loss evidence (ssthresh untouched),
+                        # re-express the window in the new units — else a
+                        # 64 KB-MTU path ramps from a 1200 B-era window
+                        # through queue-bloated RTTs, and short flows
+                        # measure the ramp instead of the path. Pacing
+                        # still smooths the larger window onto the wire.
+                        self._cwnd = max(self._cwnd,
+                                         float(CWND_INITIAL_SEGS * new_mtu))
+                    self._mtu = new_mtu
         elif ptype == _ACK:
             ack = _OFF.unpack_from(body)[0]
             ack_delay_s = 0.0
@@ -514,9 +526,10 @@ class _UdpStream(RawStream):
         PROBEACKs whatever actually arrives. Lost probes (path too small)
         simply never raise ``_mtu``. Runs once per connection."""
         try:
-            for _ in range(PROBE_ATTEMPTS):
-                await asyncio.sleep(PROBE_INTERVAL_S)
-                if self._closed or self._error is not None:
+            for attempt in range(PROBE_ATTEMPTS):
+                if attempt:  # first burst goes out immediately (RFC 8899
+                    await asyncio.sleep(PROBE_INTERVAL_S)  # probes on
+                if self._closed or self._error is not None:  # confirmation)
                     return
                 top = PROBE_DATAGRAM_SIZES[-1]
                 if self._mtu >= top - _DATA_OVERHEAD:

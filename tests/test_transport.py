@@ -207,20 +207,35 @@ async def test_quic_recovers_from_datagram_loss():
 
     a = _UdpStream(1, wire("a", lambda: b))
     b = _UdpStream(1, wire("b", lambda: a))
+    # pin the floor MTU: probing this lossless-looking fake wire up to
+    # 64 KB would fit the whole payload in one segment and leave nothing
+    # for the loss-recovery dynamics this test exists to observe
+    a._prober.cancel()
+    b._prober.cancel()
     try:
         payload = bytes(range(256)) * 200  # 51200 B
         await a.write(payload)
         got = bytearray()
+        peak_cwnd = 0.0
         async with asyncio.timeout(30):
             while len(got) < len(payload):
                 got += await b.read_some(65536)
+                if a._ssthresh != float("inf"):
+                    # only sample AFTER the first loss cut — the initial
+                    # window already exceeds the floor, so pre-loss
+                    # samples would make the regrowth assert vacuous
+                    peak_cwnd = max(peak_cwnd, a._cwnd)
         assert bytes(got) == payload
-        # recovery must not leave the window collapsed: after the transfer
-        # completes through 20% loss, the congestion controller has both
-        # cut (ssthresh finite — losses were seen) and RAMPED back up
-        # (cwnd grew past its post-loss floor of 2 segments)
+        # recovery must not leave the window collapsed: through 20% loss
+        # the congestion controller has both cut (ssthresh finite — losses
+        # were seen) and RAMPED back up past its post-loss floor of 2
+        # segments at some point during the transfer. (The END-state cwnd
+        # is deliberately not asserted: with a deterministic every-5th
+        # dropper a tail loss legally leaves cwnd at the floor — that IS
+        # NewReno — and which datagram the tail loss lands on is pure
+        # drop-counter phase.)
         assert a._ssthresh != float("inf")
-        assert a._cwnd > 2.0 * a._mtu
+        assert peak_cwnd > 2.0 * a._mtu
         # and the reverse direction too
         await b.write(b"pong" * 1000)
         back = bytearray()
