@@ -97,7 +97,8 @@ class PartitionedUserSlots(UserSlots):
 
 class MultiHostBrokerGroup(MeshBrokerGroup):
     def __init__(self, mesh, config: MeshGroupConfig = None,
-                 discovery=None, directory_refresh_s: float = 0.5):
+                 discovery=None, directory_refresh_s: float = 0.5,
+                 collective_timeout_s: float = 20.0):
         config = config or MeshGroupConfig()
         config.gather_frame_bytes = True  # bytes must cross hosts on-device
         super().__init__(mesh, config)
@@ -115,6 +116,13 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
         self._dir_task: Optional[asyncio.Task] = None
         self._stop_requested = False
         self._stop_barrier = self._make_stop_barrier(mesh)
+        # Watchdog bound on every collective tick: gloo's own failure
+        # detection can take minutes on a silently-dead peer, and a
+        # wedged or straggling host would otherwise gate the lockstep
+        # pump forever. On breach the group fails CLOSED (disabled +
+        # halt) in bounded time; the stuck collective thread is left to
+        # die on gloo's schedule (it cannot be cancelled from Python).
+        self.collective_timeout_s = collective_timeout_s
 
     # ---- collective stop barrier ----------------------------------------
 
@@ -281,6 +289,11 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
                            self._claim_version.copy(), self._masks.copy(),
                            self._liveness.copy())
             self.steps -= 1
+            # compile + first-rendezvous the stop barrier here too: its
+            # first pump-tick call runs under the collective watchdog,
+            # and paying jit compile inside that window could fail-close
+            # a healthy group at startup on a contended host
+            self._collective_stop(False)
         except Exception:
             logger.exception("multi-host warmup step failed")
             self.disabled = True
@@ -332,8 +345,19 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
         c = self.config
         while True:
             await asyncio.sleep(c.batch_window_s)
-            stop = await asyncio.to_thread(
-                self._collective_stop, self._stop_requested)
+            try:
+                stop = await asyncio.wait_for(
+                    asyncio.to_thread(self._collective_stop,
+                                      self._stop_requested),
+                    timeout=self.collective_timeout_s)
+            except Exception as exc:  # CancelledError is BaseException
+                logger.error(
+                    "stop-barrier collective %s after %.0f s — peer host "
+                    "dead or wedged; group disabled",
+                    "timed out" if isinstance(exc, asyncio.TimeoutError)
+                    else f"failed ({exc!r})", self.collective_timeout_s)
+                self._fail_group("stop-barrier failure")
+                return
             if stop:
                 # a peer host retired: the collective is over everywhere.
                 # Mark disabled so try_stage stops ACKing frames into rings
@@ -352,9 +376,11 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
             quarantined, self._quarantine = self._quarantine, []
             try:
                 from pushcdn_tpu.broker.tasks.senders import egress_streams
-                jobs = await asyncio.to_thread(
-                    self._run_step, batches, directs, owner, versions,
-                    masks, liveness)
+                jobs = await asyncio.wait_for(
+                    asyncio.to_thread(
+                        self._run_step, batches, directs, owner, versions,
+                        masks, liveness),
+                    timeout=self.collective_timeout_s)
                 for shard, streams, d2, lengths, frames in jobs:
                     broker = self.brokers[shard]
                     if broker is None:
@@ -366,25 +392,44 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
                         self._egress_py(broker, d2, lengths, frames)
             except asyncio.CancelledError:
                 raise
+            except asyncio.TimeoutError:
+                logger.error(
+                    "multi-host step exceeded the %.0f s collective "
+                    "watchdog — peer host dead or wedged; group disabled",
+                    self.collective_timeout_s)
+                self._fail_group("step watchdog breach", batches, directs)
+                return
             except Exception:
                 logger.exception("multi-host step failed; group disabled "
                                  "(no host fallback plane exists)")
-                self.disabled = True
-                self._stop_requested = True
-                taken = (sum(int(b.valid.sum()) for lane in batches
-                             for b in lane)
-                         + sum(int(d.valid.sum()) for lane in directs
-                               for d in lane))
-                self._halt_aux("step failure", taken=taken)
-                # one last barrier so the peer hosts exit cleanly
+                self._fail_group("step failure", batches, directs)
+                # one last barrier so the peer hosts exit cleanly —
+                # bounded: with a DEAD peer this barrier would otherwise
+                # block until gloo's own (minutes-long) timeout
                 try:
-                    await asyncio.to_thread(self._collective_stop, True)
+                    await asyncio.wait_for(
+                        asyncio.to_thread(self._collective_stop, True),
+                        timeout=self.collective_timeout_s)
                 except Exception:
                     pass
                 return
             finally:
                 for slot in quarantined:
                     self.slots.free_slot(slot)
+
+    def _fail_group(self, why: str, batches=None, directs=None) -> None:
+        """Shared disable/halt path for every pump failure branch.
+        ``batches``/``directs`` are the step's already-drained snapshots
+        (their frames are the loss most certain to have happened)."""
+        self.disabled = True
+        self._stop_requested = True
+        taken = 0
+        if batches is not None:
+            taken = (sum(int(b.valid.sum()) for lane in batches
+                         for b in lane)
+                     + sum(int(d.valid.sum()) for lane in directs
+                           for d in lane))
+        self._halt_aux(why, taken=taken)
 
     def _halt_aux(self, why: str, taken: int = 0) -> None:
         """Stop republishing claims and account for frames that were

@@ -55,9 +55,11 @@ async def heartbeat_once(broker: "Broker") -> None:
         if covers and not plane.disabled and not plane.overflow_seen:
             return
         if plane is not None and (plane.disabled or plane.overflow_seen):
-            logger.warning(
-                "device plane %s; enabling host mesh dialing",
-                "disabled" if plane.disabled else "has overflow traffic")
+            state = "disabled" if plane.disabled else "has overflow traffic"
+            if getattr(broker, "_fail_open_logged", None) != state:
+                broker._fail_open_logged = state  # log each state change
+                logger.warning(                   # once, not every tick
+                    "device plane %s; enabling host mesh dialing", state)
     peers = await broker.discovery.get_other_brokers()
     me = str(broker.identity)
     candidates = [

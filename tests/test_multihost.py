@@ -102,3 +102,96 @@ def test_two_process_multihost_deployment():
     for rank, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"rank {rank}: MULTIHOST OK" in out, out
+
+
+def test_two_process_kill_and_redeploy():
+    """VERDICT r4 #6: SIGKILL one host of a live two-host group mid-stream.
+
+    Phase 1 (``tests/_multihost_kill_worker.py``): both hosts prove the
+    device plane end to end, then rank 1 is SIGKILLed. The survivor must
+    observe the collective fail, disable the group CLEANLY (pump task
+    finished — no hung collective), fail-fast staging, and keep serving
+    its local client over the host path.
+
+    Phase 2: a fresh two-process deployment on a new coordinator port and
+    discovery db forms and serves cross-host traffic (the standard
+    ``_multihost_worker.py`` pair). jax.distributed's world is static, so
+    "the restarted host rejoins" is a redeployment — the parity analog of
+    the reference's same-identity broker restart at deployment
+    granularity (heartbeat.rs:69-107 self-heal)."""
+    import signal
+    import tempfile
+    import time as _time
+
+    tmp = tempfile.mkdtemp(prefix="pushcdn-kill-")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+    db = os.path.join(tmp, "d.sqlite")
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_kill_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(rank), str(base), db, tmp],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for rank in (0, 1)
+    ]
+    try:
+        # wait for both readiness sentinels (device plane proven live)
+        deadline = _time.time() + 240
+        while _time.time() < deadline:
+            if all(os.path.exists(os.path.join(tmp, f"ready-{r}"))
+                   for r in (0, 1)):
+                break
+            for p in procs:
+                if p.poll() is not None:
+                    out, _ = p.communicate()
+                    raise AssertionError(f"worker died pre-kill:\n{out}")
+            _time.sleep(0.2)
+        else:
+            raise AssertionError("workers never reached readiness")
+
+        procs[1].send_signal(signal.SIGKILL)
+        try:
+            out0, _ = procs[0].communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            procs[0].kill()
+            out0, _ = procs[0].communicate(timeout=30)
+            raise AssertionError(
+                f"survivor hung past the watchdog; output:\n{out0}")
+        assert procs[0].returncode == 0, f"survivor failed:\n{out0}"
+        assert "rank 0: KILL OK" in out0, out0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate(timeout=30)
+
+    # ---- phase 2: redeployment heals the deployment ----------------------
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        base2 = s.getsockname()[1]
+    db2 = os.path.join(tmp, "d2.sqlite")
+    worker2 = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+    procs2 = [
+        subprocess.Popen(
+            [sys.executable, worker2, str(rank), str(base2), db2],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for rank in (0, 1)
+    ]
+    outputs = []
+    try:
+        for p in procs2:
+            out, _ = p.communicate(timeout=300)
+            outputs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs2:
+            p.kill()
+        raise
+    for rank, (p, out) in enumerate(zip(procs2, outputs)):
+        assert p.returncode == 0, f"redeploy rank {rank} failed:\n{out}"
+        assert f"rank {rank}: MULTIHOST OK" in out, out
