@@ -1,0 +1,117 @@
+"""Worker for the multi-host straggler bench (spawned by
+``straggler_bench.py``): same two-process deployment as the multihost
+tests, but rank 1 injects a blocking delay into every collective tick —
+an artificially slow host — and rank 0 measures the achieved step
+cadence and cross-host delivery rate.
+
+Usage: _straggler_worker.py <rank> <base_port> <db> <delay_ms> <msgs>
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+rank = int(sys.argv[1])
+base = int(sys.argv[2])
+db = sys.argv[3]
+delay_ms = float(sys.argv[4])
+msgs = int(sys.argv[5])
+
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{base}",
+                           num_processes=2, process_id=rank)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pushcdn_tpu.broker.mesh_group import MeshGroupConfig  # noqa: E402
+from pushcdn_tpu.testing.two_host import make_two_host_node  # noqa: E402
+
+CLIENT_SEED = [81_000, 82_000]
+WINDOW_S = 0.02
+
+
+async def _main() -> None:
+    node = await make_two_host_node(
+        rank, base, db, client_seeds=CLIENT_SEED, broker_seed_base=90,
+        mesh_config=MeshGroupConfig(
+            num_user_slots=64, ring_slots=64, frame_bytes=2048,
+            extra_lanes=(), direct_bucket_slots=4,
+            batch_window_s=WINDOW_S),
+        collective_timeout_s=60.0)  # sweep delays stay FAR below this
+    group, broker, client = node.group, node.broker, node.client
+
+    if rank == 1 and delay_ms > 0:
+        # the slow host: every collective tick pays a blocking delay
+        # (models a host whose step thread is starved/slow)
+        orig = group._collective_stop
+
+        def slow_stop(want_stop):
+            time.sleep(delay_ms / 1e3)
+            return orig(want_stop)
+        group._collective_stop = slow_stop
+
+    await node.directory_rendezvous()
+
+    # measured phase: rank 0 publishes, BOTH drain their copies
+    payload = os.urandom(1024)
+    t0 = time.perf_counter()
+    steps0 = group.steps
+
+    async def drain():
+        got = 0
+        async with asyncio.timeout(180):
+            while got < msgs:
+                got += len(await client.receive_messages(msgs - got))
+    d = asyncio.create_task(drain())
+    if rank == 0:
+        for _ in range(msgs):
+            await client.send_broadcast_message([0], payload)
+    print(f"rank {rank}: MARK sent", flush=True)
+    await d
+    print(f"rank {rank}: MARK drained", flush=True)
+    dt = time.perf_counter() - t0
+    steps = group.steps - steps0
+    print(f"rank {rank}: STRAGGLER delay_ms={delay_ms} msgs={msgs} "
+          f"wall={dt:.3f} steps={steps} "
+          f"cadence_ms={dt / max(steps, 1) * 1e3:.1f} "
+          f"rate={msgs / dt:.1f}/s", flush=True)
+
+    # drain barrier via directory, then exit
+    await node.publish_marker(b"sdone-%d" % rank)
+    await node.await_markers([b"sdone-0", b"sdone-1"])
+    print(f"rank {rank}: MARK barrier passed", flush=True)
+    client.close()
+    await node.marshal.stop()
+    print(f"rank {rank}: MARK marshal stopped", flush=True)
+    await broker.stop()
+    print(f"rank {rank}: MARK broker stopped", flush=True)
+    if rank == 1:
+        # announce imminent exit so the coordinator (rank 0) can outlive
+        # us — its death fatal-terminates any process still polling the
+        # coordination service
+        await node.publish_marker(b"exiting-1")
+    else:
+        await node.await_markers([b"exiting-1"], timeout_s=30.0)
+        await asyncio.sleep(1.0)  # let rank 1's os._exit land first
+    print(f"rank {rank}: DONE", flush=True)
+    os._exit(0)
+
+
+async def main() -> None:
+    try:
+        await _main()
+    except BaseException:
+        import traceback
+        traceback.print_exc()
+        sys.stdout.flush()
+        os._exit(1)
+
+
+asyncio.run(main())

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Straggler degradation curve for the lockstep multi-host pump
+(VERDICT r4 #7): two OS processes run the standard two-host deployment
+while host 1 injects a blocking delay into every collective tick;
+host 0's achieved step cadence and cross-host delivery rate quantify
+how much one slow host gates the whole group.
+
+Usage: python benches/straggler_bench.py [--delays 0,20,100] [--msgs 200]
+Prints one JSON line per sweep point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "benches", "_straggler_worker.py")
+
+
+def run_point(delay_ms: float, msgs: int) -> dict:
+    sys.path.insert(0, REPO)
+    from pushcdn_tpu.testing.two_host import spawn_worker_pair
+    db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-strag-"), "d.sqlite")
+    logdir = os.path.dirname(db)
+    procs, _base = spawn_worker_pair(
+        WORKER, [db, str(delay_ms), str(msgs)], cwd=REPO, pipe=False,
+        log_dir=logdir)
+    try:
+        for p in procs:
+            p.communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+            p.communicate(timeout=30)
+    outs = [open(os.path.join(logdir, f"rank{r}.log")).read()
+            for r in (0, 1)]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"rank {rank} failed (full log at {logdir}):\n{out[-3000:]}")
+    rows = []
+    for rank in (0, 1):
+        m = re.search(r"rank %d: STRAGGLER delay_ms=\S+ msgs=(\d+) "
+                      r"wall=([\d.]+) steps=(\d+) cadence_ms=([\d.]+) "
+                      r"rate=([\d.]+)/s" % rank, outs[rank])
+        assert m, outs[rank][-2000:]
+        rows.append(m)
+    # rank 0 drains its LOCAL copies; rank 1's drain is the genuinely
+    # cross-host half — report both, extrapolate neither
+    return {"delay_ms": delay_ms, "msgs": int(rows[0].group(1)),
+            "wall_s": float(rows[0].group(2)),
+            "steps": int(rows[0].group(3)),
+            "cadence_ms": float(rows[0].group(4)),
+            "local_deliveries_per_s": float(rows[0].group(5)),
+            "cross_host_deliveries_per_s": float(rows[1].group(5)),
+            "cross_host_wall_s": float(rows[1].group(2))}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--delays", default="0,20,100")
+    ap.add_argument("--msgs", type=int, default=200)
+    args = ap.parse_args()
+    for d in (float(x) for x in args.delays.split(",")):
+        row = run_point(d, args.msgs)
+        print(json.dumps({"bench": "multihost/straggler", **row}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,92 +39,23 @@ assert jax.process_count() == 2
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from pushcdn_tpu.broker.broker import Broker, BrokerConfig  # noqa: E402
-from pushcdn_tpu.broker.mesh_group import MeshGroupConfig  # noqa: E402
-from pushcdn_tpu.broker.multihost_group import (  # noqa: E402
-    MultiHostBrokerGroup,
-)
-from pushcdn_tpu.client import Client, ClientConfig  # noqa: E402
-from pushcdn_tpu.marshal import Marshal, MarshalConfig  # noqa: E402
-from pushcdn_tpu.parallel.multihost import (  # noqa: E402
-    local_shard_indices,
-    pod_broker_mesh,
-)
 from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME  # noqa: E402
-from pushcdn_tpu.proto.def_ import testing_run_def  # noqa: E402
-from pushcdn_tpu.proto.discovery.base import BrokerIdentifier  # noqa: E402
-from pushcdn_tpu.proto.discovery.embedded import Embedded  # noqa: E402
 from pushcdn_tpu.proto.message import Broadcast, Direct  # noqa: E402
-from pushcdn_tpu.proto.transport import Tcp  # noqa: E402
-
-N_SHARDS = 8
-MARSHAL_PORT = base + 1 + rank
-BROKER_PUB = base + 10 + 10 * rank
-BROKER_PRIV = BROKER_PUB + 1
+from pushcdn_tpu.testing.two_host import make_two_host_node  # noqa: E402
 
 # deterministic client identities: each host can derive the OTHER's key
 CLIENT_SEED = [61_000, 62_000]
 
 
 async def main() -> None:
-    mesh = pod_broker_mesh(N_SHARDS)
-    local = local_shard_indices(mesh)
-    my_shard = local[0]
-
-    rd = testing_run_def(broker_protocol=Tcp, user_protocol=Tcp)
-    group = MultiHostBrokerGroup(
-        mesh,
-        MeshGroupConfig(num_user_slots=64, ring_slots=8, frame_bytes=1024,
-                        extra_lanes=(), direct_bucket_slots=4,
-                        batch_window_s=0.05),
-        discovery=await Embedded.new(db),
-        directory_refresh_s=0.3)
-
-    ident = BrokerIdentifier(f"127.0.0.1:{BROKER_PUB}",
-                             f"127.0.0.1:{BROKER_PRIV}")
-    broker = await Broker.new(BrokerConfig(
-        run_def=rd, keypair=DEFAULT_SCHEME.generate_keypair(seed=50 + rank),
-        discovery_endpoint=db,
-        public_advertise_endpoint=ident.public_advertise_endpoint,
-        public_bind_endpoint=f"127.0.0.1:{BROKER_PUB}",
-        private_advertise_endpoint=ident.private_advertise_endpoint,
-        private_bind_endpoint=f"127.0.0.1:{BROKER_PRIV}",
-        heartbeat_interval_s=0.5, sync_interval_s=3600,
-        whitelist_interval_s=3600, form_mesh=False))
-    group.attach(broker, my_shard)
-    await broker.start()
-
-    marshal = await Marshal.new(MarshalConfig(
-        run_def=rd, discovery_endpoint=db,
-        bind_endpoint=f"127.0.0.1:{MARSHAL_PORT}"))
-    await marshal.start()
-
-    # pin placement: THIS host's marshal always assigns THIS host's broker
-    # (production load-balances; the test needs the cross-host topology)
-    async def pinned():
-        return ident
-    marshal.discovery.get_with_least_connections = pinned
-
-    client = Client(ClientConfig(
-        marshal_endpoint=f"127.0.0.1:{MARSHAL_PORT}",
-        keypair=DEFAULT_SCHEME.generate_keypair(seed=CLIENT_SEED[rank]),
-        protocol=Tcp, subscribed_topics={0}))
-    await client.ensure_initialized()
-    for _ in range(100):  # registration completes just after the auth ack
-        if broker.connections.num_users == 1:
-            break
-        await asyncio.sleep(0.05)
-    assert broker.connections.num_users == 1
+    node = await make_two_host_node(
+        rank, base, db, client_seeds=CLIENT_SEED, broker_seed_base=50)
+    group, broker, client = node.group, node.broker, node.client
+    my_shard = node.my_shard
 
     # rendezvous: wait until the user-slot directory shows BOTH clients
     # (this also phase-syncs the two processes)
-    for _ in range(200):
-        slots = await group.discovery.get_user_slots()
-        if len(slots) >= 2:
-            break
-        await asyncio.sleep(0.1)
-    else:
-        raise AssertionError("user-slot directory never converged")
+    await node.directory_rendezvous()
 
     # ---- cross-host broadcast (the VERDICT 'Done' criterion) -------------
     if rank == 0:
@@ -165,18 +96,11 @@ async def main() -> None:
     # end-of-test rendezvous: neither host may stop the collective pump
     # until BOTH have seen their final deliveries (the directory doubles
     # as the phase barrier)
-    await group.discovery.publish_user_slots(
-        {b"done-%d" % rank: (0, 0.0)}, 60)
-    for _ in range(200):
-        slots = await group.discovery.get_user_slots()
-        if b"done-0" in slots and b"done-1" in slots:
-            break
-        await asyncio.sleep(0.1)
-    else:
-        raise AssertionError("peer never reached the done barrier")
+    await node.publish_marker(b"done-%d" % rank)
+    await node.await_markers([b"done-0", b"done-1"])
 
     client.close()
-    await marshal.stop()
+    await node.marshal.stop()
     if rank == 0:
         await broker.stop()   # triggers the collective stop barrier
     else:
