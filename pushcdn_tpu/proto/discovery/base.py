@@ -25,6 +25,21 @@ from typing import List, Optional
 from pushcdn_tpu.proto.error import ErrorKind, bail
 
 
+# Permit value space: 0 = failure, 1 = bare ack, real permits are drawn
+# from randbits(62) + 2 by every issuer (embedded + redis). The wire
+# field is a u64, so validators MUST range-check before touching storage
+# — SQLite INTEGER is signed 64-bit and a hostile permit >= 2^63 would
+# otherwise surface as OverflowError instead of a clean rejection
+# (found by tests/test_fuzz_auth.py).
+PERMIT_MIN = 2
+PERMIT_MAX = (1 << 62) + 1
+
+
+def permit_in_range(permit: int) -> bool:
+    return PERMIT_MIN <= permit <= PERMIT_MAX
+
+
+
 @dataclass(frozen=True, order=True)
 class BrokerIdentifier:
     """Identity = the two endpoints a broker advertises.
@@ -86,11 +101,21 @@ class DiscoveryClient(abc.ABC):
         """Create a single-use permit (>1) bound to ``for_broker`` with a
         TTL (30 s in the reference, auth/marshal.rs:121-135)."""
 
-    @abc.abstractmethod
     async def validate_permit(self, broker: BrokerIdentifier,
                               permit: int) -> Optional[bytes]:
         """Redeem-and-delete (GETDEL semantics): returns the public key the
-        permit was issued to, or None if invalid/expired/foreign."""
+        permit was issued to, or None if invalid/expired/foreign.
+
+        Template method: the range check runs HERE so no backend can skip
+        it — an out-of-space wire permit must never reach storage (see
+        ``permit_in_range``). Backends implement ``_validate_permit``."""
+        if not permit_in_range(permit):
+            return None
+        return await self._validate_permit(broker, permit)
+
+    async def _validate_permit(self, broker: BrokerIdentifier,
+                               permit: int) -> Optional[bytes]:
+        raise NotImplementedError
 
     @abc.abstractmethod
     async def set_whitelist(self, users: List[bytes]) -> None: ...

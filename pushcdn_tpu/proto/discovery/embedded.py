@@ -142,9 +142,10 @@ class Embedded(DiscoveryClient):
             except sqlite3.IntegrityError:
                 continue  # permit collision: retry
 
-    async def validate_permit(self, broker: BrokerIdentifier,
-                              permit: int) -> Optional[bytes]:
-        """Redeem-and-delete (GETDEL parity, redis permit redemption)."""
+    async def _validate_permit(self, broker: BrokerIdentifier,
+                               permit: int) -> Optional[bytes]:
+        """Redeem-and-delete (GETDEL parity, redis permit redemption);
+        range-checked by the base-class template method."""
         self._prune()
         row = self._db.execute(
             "SELECT broker, public_key FROM permits WHERE permit = ?",

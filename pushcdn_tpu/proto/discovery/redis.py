@@ -102,8 +102,9 @@ class Redis(DiscoveryClient):
             if ok:
                 return permit
 
-    async def validate_permit(self, broker: BrokerIdentifier,
-                              permit: int) -> Optional[bytes]:
+    async def _validate_permit(self, broker: BrokerIdentifier,
+                               permit: int) -> Optional[bytes]:
+        # range-checked by the base-class template method
         raw = await self._client.getdel(f"{_PREFIX_PERMIT}{permit}")
         if raw is None:
             return None

@@ -1,0 +1,241 @@
+"""Adversarial fuzzing of the HANDSHAKE STATE MACHINES against a live
+marshal + broker (VERDICT r4 #8) — the tier past the codec fuzzers in
+``test_fuzz_parsers.py``.
+
+The contract under attack traffic: the servers reject, disconnect, or
+time out per the documented auth flow — no unhandled task exceptions, no
+leaked tasks, and the cluster KEEPS SERVING legitimate clients after
+every barrage. Parity: the reference's handshake validations at
+cdn-proto/src/connection/auth/broker.rs:77-151 and marshal.rs:76-141
+(Rust's ?-bail chain is the analog of our Error-only guarantee).
+
+Deterministic seeds: failures reproduce.
+"""
+
+import asyncio
+import gc
+import random
+import struct
+
+import pytest
+
+from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME, Namespace
+from pushcdn_tpu.proto.error import Error
+from pushcdn_tpu.proto.message import (
+    AuthenticateResponse,
+    AuthenticateWithKey,
+    AuthenticateWithPermit,
+    Broadcast,
+    Subscribe,
+    serialize,
+)
+from pushcdn_tpu.proto.transport.memory import Memory
+from pushcdn_tpu.testing import Cluster
+
+class _LoopErrors:
+    """Collects unhandled task/loop exceptions during a fuzz barrage."""
+
+    def __init__(self):
+        self.errors = []
+        self._prev = None
+
+    def __enter__(self):
+        loop = asyncio.get_running_loop()
+        self._prev = loop.get_exception_handler()
+        loop.set_exception_handler(
+            lambda lo, ctx: self.errors.append(ctx))
+        return self
+
+    def __exit__(self, *exc):
+        asyncio.get_running_loop().set_exception_handler(self._prev)
+
+
+async def _settle(baseline_tasks, timeout_s: float = 8.0):
+    """Wait until the running task set returns to (a subset of) the
+    baseline — fuzz connections must not leak server tasks. The marshal's
+    5 s auth timeout is the slowest legitimate cleanup."""
+    deadline = asyncio.get_running_loop().time() + timeout_s
+    while asyncio.get_running_loop().time() < deadline:
+        gc.collect()
+        extra = {t for t in asyncio.all_tasks()
+                 if not t.done() and t not in baseline_tasks
+                 and t is not asyncio.current_task()}
+        if not extra:
+            return
+        await asyncio.sleep(0.2)
+    names = sorted(t.get_name() for t in extra)
+    raise AssertionError(f"leaked tasks after fuzz barrage: {names}")
+
+
+async def _expect_reject_or_drop(conn):
+    """The server either answers permit=0 or just drops us — both are
+    within the documented handshake contract."""
+    try:
+        got = await asyncio.wait_for(conn.recv_message(), 8)
+        assert isinstance(got, AuthenticateResponse)
+        assert got.permit == 0
+    except (Error, asyncio.TimeoutError):
+        pass
+    finally:
+        conn.close()
+
+
+async def _assert_still_serving(cluster, seed: int):
+    """The real invariant: a legitimate client authenticates and gets an
+    echo after the barrage."""
+    c = cluster.client(seed=seed, topics=[0])
+    await asyncio.wait_for(c.ensure_initialized(), 10)
+    await c.send_direct_message(c.public_key, b"alive?")
+    got = await asyncio.wait_for(c.receive_message(), 5)
+    assert bytes(got.message) == b"alive?"
+    c.close()
+
+
+def _signed_awk(keypair, namespace=Namespace.USER_MARSHAL_AUTH,
+                timestamp=None):
+    import time as _time
+    ts = int(_time.time()) if timestamp is None else timestamp
+    sig = DEFAULT_SCHEME.sign(keypair.private_key, namespace,
+                              struct.pack("<Q", ts))
+    return AuthenticateWithKey(public_key=keypair.public_key,
+                               timestamp=ts, signature=sig)
+
+
+async def test_marshal_handshake_fuzz():
+    """Garbage, wrong kinds, wrong namespaces, stale timestamps,
+    truncated wire frames, and mid-handshake disconnects against a live
+    marshal: every case ends in a reject or clean drop."""
+    cluster = await Cluster(num_brokers=1).start()
+    try:
+        baseline = set(asyncio.all_tasks())
+        kp = DEFAULT_SCHEME.generate_keypair(seed=9001)
+        rng = random.Random(4242)
+
+        with _LoopErrors() as errs:
+            # 1. random byte frames
+            for i in range(10):
+                conn = await Memory.connect(cluster.marshal_endpoint)
+                blob = bytes(rng.getrandbits(8)
+                             for _ in range(rng.randrange(1, 200)))
+                try:
+                    await conn.send_raw(blob, flush=True)
+                except Error:
+                    pass
+                await _expect_reject_or_drop(conn)
+
+            # 2. wrong first message kinds
+            for msg in (Subscribe([0]), Broadcast(topics=[0], message=b"x"),
+                        AuthenticateWithPermit(permit=7)):
+                conn = await Memory.connect(cluster.marshal_endpoint)
+                await conn.send_message(msg, flush=True)
+                await _expect_reject_or_drop(conn)
+
+            # 3. wrong-namespace signature (signed for broker-broker auth)
+            conn = await Memory.connect(cluster.marshal_endpoint)
+            await conn.send_message(
+                _signed_awk(kp, namespace=Namespace.BROKER_BROKER_AUTH),
+                flush=True)
+            await _expect_reject_or_drop(conn)
+
+            # 4. stale timestamp (outside the ±5 s window)
+            conn = await Memory.connect(cluster.marshal_endpoint)
+            await conn.send_message(_signed_awk(kp, timestamp=1000),
+                                    flush=True)
+            await _expect_reject_or_drop(conn)
+
+            # 5. truncated AWK halves on the wire (mid-frame EOF)
+            valid = serialize(_signed_awk(kp))
+            for cut in (1, len(valid) // 2, len(valid) - 1):
+                conn = await Memory.connect(cluster.marshal_endpoint)
+                frame = struct.pack(">I", len(valid)) + valid[:cut]
+                await conn._stream.write(frame)  # bypass framing on purpose
+                conn.close()  # EOF mid-frame
+
+            # 6. connect-and-vanish (no bytes at all)
+            for _ in range(5):
+                conn = await Memory.connect(cluster.marshal_endpoint)
+                conn.close()
+
+        assert not errs.errors, errs.errors
+        await _settle(baseline)
+        await _assert_still_serving(cluster, seed=9100)
+    finally:
+        await cluster.stop()
+
+
+async def test_broker_permit_fuzz():
+    """Permit forgery, truncation, reuse, and mid-handshake disconnects
+    against a live broker's user listener."""
+    cluster = await Cluster(num_brokers=1).start()
+    try:
+        baseline = set(asyncio.all_tasks())
+        rng = random.Random(2424)
+        broker_ep = cluster.brokers[0].config.public_advertise_endpoint
+
+        with _LoopErrors() as errs:
+            # 1. permits the marshal never issued (incl. boundary values)
+            for permit in (0, 1, 2, 2**31 - 1, 2**63, rng.getrandbits(64)):
+                conn = await Memory.connect(broker_ep)
+                try:
+                    await conn.send_message(
+                        AuthenticateWithPermit(permit=permit), flush=True)
+                except (Error, struct.error, OverflowError):
+                    conn.close()  # unencodable permit: client-side error
+                    continue
+                await _expect_reject_or_drop(conn)
+
+            # 2. garbage instead of the permit message
+            for _ in range(5):
+                conn = await Memory.connect(broker_ep)
+                blob = bytes(rng.getrandbits(8)
+                             for _ in range(rng.randrange(1, 100)))
+                try:
+                    await conn.send_raw(blob, flush=True)
+                except Error:
+                    pass
+                await _expect_reject_or_drop(conn)
+
+            # 3. a REAL permit redeemed, then garbage instead of the
+            # Subscribe that must follow
+            from pushcdn_tpu.proto.auth import user as user_auth
+            mconn = await Memory.connect(cluster.marshal_endpoint)
+            kp = DEFAULT_SCHEME.generate_keypair(seed=9200)
+            permit, ep = await user_auth.authenticate_with_marshal(
+                mconn, DEFAULT_SCHEME, kp)
+            mconn.close()
+            conn = await Memory.connect(ep)
+            await conn.send_message(AuthenticateWithPermit(permit=permit),
+                                    flush=True)
+            got = await asyncio.wait_for(conn.recv_message(), 8)
+            assert isinstance(got, AuthenticateResponse) and got.permit == 1
+            await conn.send_message(Broadcast(topics=[0], message=b"not-sub"),
+                                    flush=True)
+            # broker must drop us (auth flow violated), not crash
+            with pytest.raises((Error, asyncio.TimeoutError)):
+                await asyncio.wait_for(conn.recv_message(), 3)
+            conn.close()
+
+            # 4. permit single-use: redeeming the same permit again fails
+            conn = await Memory.connect(ep)
+            await conn.send_message(AuthenticateWithPermit(permit=permit),
+                                    flush=True)
+            await _expect_reject_or_drop(conn)
+
+            # 5. real permit, disconnect before Subscribe
+            mconn = await Memory.connect(cluster.marshal_endpoint)
+            kp2 = DEFAULT_SCHEME.generate_keypair(seed=9201)
+            permit2, ep2 = await user_auth.authenticate_with_marshal(
+                mconn, DEFAULT_SCHEME, kp2)
+            mconn.close()
+            conn = await Memory.connect(ep2)
+            await conn.send_message(AuthenticateWithPermit(permit=permit2),
+                                    flush=True)
+            conn.close()
+
+        assert not errs.errors, errs.errors
+        await _settle(baseline)
+        await _assert_still_serving(cluster, seed=9300)
+        # no fuzz connection ever became a registered user
+        assert cluster.brokers[0].connections.num_users <= 1
+    finally:
+        await cluster.stop()
