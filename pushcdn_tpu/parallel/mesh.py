@@ -134,8 +134,10 @@ class MeshDiscovery(DiscoveryClient):
                            expiry_s: float, public_key: bytes) -> int:
         return await self.backing.issue_permit(for_broker, expiry_s, public_key)
 
-    async def validate_permit(self, broker: BrokerIdentifier,
-                              permit: int) -> Optional[bytes]:
+    async def _validate_permit(self, broker: BrokerIdentifier,
+                               permit: int) -> Optional[bytes]:
+        # the base-class template already range-checked; delegate to the
+        # backing store's public entry (idempotent re-check is harmless)
         return await self.backing.validate_permit(broker, permit)
 
     async def set_whitelist(self, users: List[bytes]) -> None:

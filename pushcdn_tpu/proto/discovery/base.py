@@ -113,9 +113,10 @@ class DiscoveryClient(abc.ABC):
             return None
         return await self._validate_permit(broker, permit)
 
+    @abc.abstractmethod
     async def _validate_permit(self, broker: BrokerIdentifier,
                                permit: int) -> Optional[bytes]:
-        raise NotImplementedError
+        ...
 
     @abc.abstractmethod
     async def set_whitelist(self, users: List[bytes]) -> None: ...
