@@ -149,6 +149,11 @@ async def bench_two_broker_fanout(msgs: int):
         publisher = clients[0]
         receivers = clients  # all 8 subscribe to topic 0, sender included
 
+        # the cluster + clients now exist: freeze the live heap so
+        # steady-state GC only walks young message garbage (same server
+        # posture as the device-mesh phase below)
+        from pushcdn_tpu.bin.common import tune_gc as _tg
+        _tg(500_000)
         t0 = time.perf_counter()
         drains = [asyncio.create_task(_drain(c, msgs)) for c in receivers]
         for _ in range(msgs):
@@ -199,6 +204,8 @@ async def bench_topic_pubsub(per_topic: int, rounds: int):
 
         direct_targets = [clients[((t + 1) % 4) * per_topic + 1]
                           for t in topics]
+        from pushcdn_tpu.bin.common import tune_gc as _tg
+        _tg(500_000)  # re-freeze: 256 clients' live state is now resident
         t0 = time.perf_counter()
         drains = [asyncio.create_task(recv_counts(c, i // per_topic))
                   for i, c in enumerate(clients)]
