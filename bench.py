@@ -237,9 +237,17 @@ def main() -> None:
         blocks_e = [np.asarray(batch.frame_bytes)[:S_e]]
         streams = native.egress_encode(deliver_e, lengths_e, blocks_e)
         if streams is not None:
-            t0 = time.perf_counter()
-            streams = native.egress_encode(deliver_e, lengths_e, blocks_e)
-            egress_rate = streams.total_msgs / (time.perf_counter() - t0)
+            total_msgs = streams.total_msgs
+            rates = []
+            for _ in range(3):
+                del streams  # return the pooled buffer before re-encoding
+                t0 = time.perf_counter()
+                streams = native.egress_encode(deliver_e, lengths_e,
+                                               blocks_e)
+                rates.append(total_msgs / (time.perf_counter() - t0))
+            rates.sort()
+            egress_rate = rates[1]  # median of 3: the shared core's cgroup
+            #                         throttling makes single shots lie
     except Exception:
         pass
 

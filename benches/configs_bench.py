@@ -364,12 +364,17 @@ async def bench_eight_broker_device_mesh(msgs: int, tput_msgs: int):
             await asyncio.gather(*drains)
             dt = time.perf_counter() - t0
             trials.append(tput_msgs * 16 / dt)
-        emit("configs3/device_mesh_broadcast_fanout", max(trials),
+        # headline = MEDIAN of the trials (VERDICT r5 #5: on a noisy
+        # shared core the max systematically flatters); the max is
+        # disclosed alongside, as the trials always were
+        headline = statistics.median(trials)
+        emit("configs3/device_mesh_broadcast_fanout", headline,
              "deliveries/s", msgs=tput_msgs, brokers=8,
-             publish_rate=round(max(trials) / 16, 1),
+             publish_rate=round(headline / 16, 1),
              frame=1024, host_links=0,
              mesh_routed=cluster.group.messages_routed,
              trials=[round(r, 1) for r in trials],
+             max=round(max(trials), 1),
              batch_window_s=DEVICE_MESH_WINDOW_S, gc_refrozen=True)
 
         # transport-level delivery rate (raw twin; 2 publishers on

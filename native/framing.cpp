@@ -179,6 +179,67 @@ void pushcdn_egress_count(
   }
 }
 
+// Fused single-pass variant: count + prefix-sum + fill in ONE walk over the
+// delivery matrix, into a caller-recycled buffer (the egress pool in
+// pushcdn_tpu/native). Writes per-user offsets/bytes/msgs as it goes and
+// returns total bytes written, or -1 when the buffer is too small — the
+// caller then sizes it with pushcdn_egress_count and retries; with a
+// grow-only pooled buffer the retry happens once per high-water mark, so
+// the steady state pays a single matrix walk and zero page faults.
+int64_t pushcdn_egress_encode_fused(
+    const uint8_t* deliver, int32_t U, int32_t N, const int32_t* lengths,
+    const uint8_t* const* blocks, int32_t nb, int32_t rows_per_block,
+    int64_t frame_stride,
+    int64_t* out_offsets,  // [U] written: stream start per user
+    int64_t* out_bytes,    // [U] written: stream size per user
+    int32_t* out_msgs,     // [U] written: delivered count per user
+    uint8_t* out, int64_t out_capacity) {
+  const int32_t nwords = N / 8;
+  int64_t pos = 0;
+  for (int32_t u = 0; u < U; ++u) {
+    const uint8_t* row = deliver + (int64_t)u * N;
+    const int64_t start = pos;
+    int32_t msgs = 0;
+    int32_t n = 0;
+    for (int32_t w = 0; w < nwords; ++w, n += 8) {
+      if (load_u64(row + n) == 0) continue;
+      for (int32_t k = 0; k < 8; ++k) {
+        const int32_t f = n + k;
+        if (!row[f]) continue;
+        const int32_t len = lengths[f];
+        if (pos + 4 + (int64_t)len > out_capacity) return -1;
+        out[pos] = (uint8_t)((uint32_t)len >> 24);
+        out[pos + 1] = (uint8_t)((uint32_t)len >> 16);
+        out[pos + 2] = (uint8_t)((uint32_t)len >> 8);
+        out[pos + 3] = (uint8_t)len;
+        const uint8_t* src = blocks[f / rows_per_block] +
+                             (int64_t)(f % rows_per_block) * frame_stride;
+        std::memcpy(out + pos + 4, src, (size_t)len);
+        pos += 4 + (int64_t)len;
+        ++msgs;
+      }
+    }
+    for (; n < N; ++n) {
+      if (!row[n]) continue;
+      const int32_t len = lengths[n];
+      if (pos + 4 + (int64_t)len > out_capacity) return -1;
+      out[pos] = (uint8_t)((uint32_t)len >> 24);
+      out[pos + 1] = (uint8_t)((uint32_t)len >> 16);
+      out[pos + 2] = (uint8_t)((uint32_t)len >> 8);
+      out[pos + 3] = (uint8_t)len;
+      const uint8_t* src = blocks[n / rows_per_block] +
+                           (int64_t)(n % rows_per_block) * frame_stride;
+      std::memcpy(out + pos + 4, src, (size_t)len);
+      pos += 4 + (int64_t)len;
+      ++msgs;
+    }
+    out_offsets[u] = start;
+    out_bytes[u] = pos - start;
+    out_msgs[u] = msgs;
+  }
+  return pos;
+}
+
 // Pass 2: fill per-user streams. Returns total bytes written, or -1 if any
 // user's stream would overrun out_capacity (callers size `out` from pass 1,
 // so -1 means the matrix changed between passes — it can't, both run on one

@@ -22,4 +22,8 @@ Architecture (TPU-first, not a port):
 
 __version__ = "0.1.0"
 
+from pushcdn_tpu import _aio_compat
+
+_aio_compat.install()  # asyncio.timeout backport for 3.10 images
+
 from pushcdn_tpu.proto.error import Error, ErrorKind  # noqa: F401

@@ -1,0 +1,78 @@
+"""Runtime compatibility gates for older interpreters.
+
+The stack targets Python >= 3.11 (``asyncio.timeout`` everywhere); some
+deployment images still ship 3.10. Rather than thread a wrapper through
+every call site, importing :mod:`pushcdn_tpu` installs a backport into
+the ``asyncio`` module when the attribute is missing — the same
+cancel-the-current-task design as the stdlib version (and the
+``async-timeout`` package).
+
+Deliberate tradeoff: this mutates the process-global stdlib namespace on
+3.10 images, where ``hasattr(asyncio, "timeout")`` feature detection by
+ANY library in the process will now find the backport. To keep that
+surface honest the backport implements the full 3.11 ``Timeout`` API
+(``when``/``reschedule``/``expired``), not just the context manager.
+The one unfixable 3.10 gap is ``Task.uncancel`` accounting: an external
+cancellation landing in the same event-loop tick as the expiry is
+indistinguishable from it. On >= 3.11 images this module is a no-op.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+
+class _TimeoutBackport:
+    __slots__ = ("_when", "_task", "_handle", "_expired", "_entered")
+
+    def __init__(self, delay: Optional[float]):
+        self._task = None
+        self._handle = None
+        self._expired = False
+        self._entered = False
+        self._when = None if delay is None else delay  # resolved on enter
+
+    def when(self) -> Optional[float]:
+        return self._when
+
+    def expired(self) -> bool:
+        return self._expired
+
+    def reschedule(self, when: Optional[float]) -> None:
+        """``when`` is an absolute loop time, per the 3.11 API."""
+        self._when = when
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+        if self._entered and when is not None:
+            self._handle = asyncio.get_running_loop().call_at(
+                when, self._on_timeout)
+
+    async def __aenter__(self):
+        self._task = asyncio.current_task()
+        self._entered = True
+        delay = self._when
+        if delay is not None:
+            loop = asyncio.get_running_loop()
+            self._when = loop.time() + delay  # absolute, 3.11 semantics
+            self._handle = loop.call_at(self._when, self._on_timeout)
+        return self
+
+    def _on_timeout(self):
+        self._expired = True
+        if self._task is not None:
+            self._task.cancel()
+
+    async def __aexit__(self, exc_type, exc, tb):
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+        if self._expired and exc_type is asyncio.CancelledError:
+            raise asyncio.TimeoutError() from exc
+        return False
+
+
+def install() -> None:
+    if not hasattr(asyncio, "timeout"):
+        asyncio.timeout = lambda delay: _TimeoutBackport(delay)

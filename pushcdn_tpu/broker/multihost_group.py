@@ -135,9 +135,10 @@ class MultiHostBrokerGroup(MeshBrokerGroup):
         def per_shard(x):
             return jax.lax.psum(x[0], BROKER_AXIS)[None]
 
-        sharded = jax.shard_map(
+        from pushcdn_tpu.parallel.jax_compat import shard_map as _shard_map_compat
+        sharded = _shard_map_compat(
             per_shard, mesh=mesh, in_specs=(P(BROKER_AXIS),),
-            out_specs=P(BROKER_AXIS), check_vma=False)
+            out_specs=P(BROKER_AXIS))
         return jax.jit(sharded)
 
     def _collective_stop(self, want_stop: bool) -> bool:

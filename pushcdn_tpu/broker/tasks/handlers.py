@@ -91,6 +91,27 @@ class EgressBatch:
                 f.release()
         self.brokers.clear()
 
+    @staticmethod
+    async def _send_batch(conn, frames: list) -> None:
+        """Hand one peer its whole batch. Small-frame batches pre-encode
+        into ONE PreEncoded writer entry via the native batch encoder
+        (verbatim flush, permits released here, no per-frame writer
+        work); other shapes ride ``send_raw_many`` (the writer's own
+        coalescer). Ownership rule either way: the frames are consumed —
+        released here on the encode path, by the connection on the raw
+        path."""
+        if len(frames) < 2:  # depth-1: nothing to coalesce, skip probing
+            await conn.send_raw_many(frames)
+            return
+        from pushcdn_tpu.broker.tasks.senders import pre_encode_frames
+        encoded = pre_encode_frames(frames)
+        if encoded is not None:
+            for f in frames:
+                f.release()
+            await conn.send_encoded(encoded)
+        else:
+            await conn.send_raw_many(frames)
+
     async def flush(self) -> None:
         broker = self.broker
         try:
@@ -103,7 +124,7 @@ class EgressBatch:
                         f.release()
                     continue
                 try:
-                    await conn.send_raw_many(frames)
+                    await self._send_batch(conn, frames)
                 except asyncio.CancelledError:
                     raise
                 except Exception as exc:
@@ -120,7 +141,7 @@ class EgressBatch:
                         f.release()
                     continue
                 try:
-                    await conn.send_raw_many(frames)
+                    await self._send_batch(conn, frames)
                 except asyncio.CancelledError:
                     raise
                 except Exception as exc:

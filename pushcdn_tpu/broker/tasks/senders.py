@@ -10,8 +10,9 @@ fan-out shares one payload buffer (Arc-clone parity, handler.rs hot path).
 from __future__ import annotations
 
 import logging
-from typing import TYPE_CHECKING, Iterable, List
+from typing import TYPE_CHECKING, Iterable, List, Optional
 
+from pushcdn_tpu import native as native_mod
 from pushcdn_tpu.proto.limiter import Bytes
 from pushcdn_tpu.proto.util import mnemonic
 
@@ -19,6 +20,36 @@ if TYPE_CHECKING:
     from pushcdn_tpu.broker.broker import Broker
 
 logger = logging.getLogger("pushcdn.broker")
+
+# pre-encode shape bounds: the fast path covers fan-out batches of small
+# frames (the hot regime); anything bigger rides the writer's own
+# coalescer, which chunks large flushes per timeout window
+_PRE_ENCODE_MAX_FRAME = 64 * 1024
+_PRE_ENCODE_MAX_TOTAL = 1 << 20
+
+
+def pre_encode_frames(raws) -> Optional[bytearray]:
+    """Length-delimit a batch of small ``bytes`` frames into ONE owned
+    buffer via the native batch encoder (one C call, one copy — the same
+    copy count as the writer-side coalescer, moved off the writer task so
+    the flush is verbatim and the frames' pool permits release at encode
+    time). None when the native library is unavailable or the batch
+    doesn't fit the fast-path shape (callers fall back to
+    ``send_raw_many``)."""
+    encoder = native_mod.shared_encoder()
+    if encoder is None or len(raws) < 2:
+        return None
+    total = 0
+    payloads = []
+    for r in raws:
+        data = r.data if isinstance(r, Bytes) else r
+        if type(data) is not bytes or len(data) > _PRE_ENCODE_MAX_FRAME:
+            return None
+        total += len(data) + 4
+        if total > _PRE_ENCODE_MAX_TOTAL:
+            return None
+        payloads.append(data)
+    return encoder.encode_detached(payloads)
 
 
 async def try_send_to_user(broker: "Broker", public_key: bytes,
@@ -51,13 +82,21 @@ def try_send_frames_to_user_nowait(broker: "Broker", public_key: bytes,
     connection = broker.connections.get_user_connection(public_key)
     if connection is None:
         return 0
-    clones = [raw.clone() for raw in raws]
-    if not clones:
+    raws = list(raws)
+    if not raws:
         return 0
+    # Pre-encoded fast path: the whole batch becomes one verbatim writer
+    # flush, and the borrowed frames need no clones at all (the encode
+    # copies; the caller keeps ownership of the originals).
+    encoded = pre_encode_frames(raws)
     try:
-        # the connection owns the clones from here (released on failure too)
-        connection.send_raw_many_nowait(clones)
-        return len(clones)
+        if encoded is not None:
+            connection.send_encoded_nowait(encoded)
+        else:
+            # the connection owns the clones from here (released on
+            # failure too)
+            connection.send_raw_many_nowait([raw.clone() for raw in raws])
+        return len(raws)
     except Exception as exc:
         logger.info("nowait send to user %s failed (%r); removing",
                     mnemonic(public_key), exc)
@@ -67,15 +106,16 @@ def try_send_frames_to_user_nowait(broker: "Broker", public_key: bytes,
 
 
 def try_send_encoded_to_user_nowait(broker: "Broker", public_key: bytes,
-                                    data) -> bool:
+                                    data, owner=None) -> bool:
     """Queue a pre-framed egress stream (native.egress_encode output) to
     one user — zero per-frame work here or in the writer; a failure
-    removes the user (failure-is-removal, as everywhere)."""
+    removes the user (failure-is-removal, as everywhere). ``owner`` keeps
+    a pooled egress buffer alive until the flush completes."""
     connection = broker.connections.get_user_connection(public_key)
     if connection is None:
         return False
     try:
-        connection.send_encoded_nowait(data)
+        connection.send_encoded_nowait(data, owner)
         return True
     except Exception as exc:
         logger.info("encoded send to user %s failed (%r); removing",
@@ -94,7 +134,8 @@ def egress_streams(broker: "Broker", slots, streams) -> int:
         key = slots.key_of(int(slot))
         if key is None:  # released mid-step: user is gone, drop
             continue
-        if try_send_encoded_to_user_nowait(broker, key, streams.stream(slot)):
+        if try_send_encoded_to_user_nowait(broker, key, streams.stream(slot),
+                                           owner=streams):
             routed += int(streams.msgs[slot])
     return routed
 

@@ -77,6 +77,11 @@ def _compile() -> Optional[ctypes.CDLL]:
         u8p, ctypes.c_int32, ctypes.c_int32, i32p,
         ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32, ctypes.c_int32,
         ctypes.c_int64, i64p, u8p, ctypes.c_int64]
+    lib.pushcdn_egress_encode_fused.restype = ctypes.c_int64
+    lib.pushcdn_egress_encode_fused.argtypes = [
+        u8p, ctypes.c_int32, ctypes.c_int32, i32p,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int64, i64p, i64p, i32p, u8p, ctypes.c_int64]
     return lib
 
 
@@ -101,14 +106,24 @@ def available() -> bool:
 # lib's plain-C calls release it.
 
 _PYDECODE_SRC = os.path.join(_REPO, "native", "pydecode.cpp")
-_PYDECODE_LIB = os.path.join(_BUILD_DIR, "libpushcdn_pydecode.so")
 _pydecode_fn = None
 _pydecode_tried = False
 
 
+def _pydecode_lib_path() -> str:
+    """The cached .so name is keyed on the interpreter ABI: unlike the
+    plain-C framing lib, pydecode is a CPython-API library (tp_alloc, slot
+    layouts), and loading a cache built against another interpreter's
+    headers is undefined behavior — a Python minor upgrade must recompile,
+    not reuse."""
+    import sysconfig
+    abi = sysconfig.get_config_var("SOABI") or "unknown-abi"
+    return os.path.join(_BUILD_DIR, f"libpushcdn_pydecode-{abi}.so")
+
+
 def _compile_pydecode():
     import sysconfig
-    lib = _build_lib(_PYDECODE_SRC, _PYDECODE_LIB, ctypes.PyDLL,
+    lib = _build_lib(_PYDECODE_SRC, _pydecode_lib_path(), ctypes.PyDLL,
                      ("-I", sysconfig.get_paths()["include"]))
     if lib is None:
         return None
@@ -304,22 +319,129 @@ class FrameEncoder:
             return None
         return memoryview(self._out)[:wrote]
 
+    def encode_detached(self, payloads: list) -> Optional[bytearray]:
+        """Encode ``payloads`` (bytes objects) into a FRESH exact-size
+        bytearray the caller owns outright — the routing loops' pre-encode
+        handoff: the batch becomes one ``PreEncoded`` writer entry, still
+        one C call and one copy (the same count as the writer-side
+        encoder), but flattening/probing moves off the writer task and
+        the frames' pool permits release at encode time instead of after
+        the wire flush. None when any payload is not ``bytes``."""
+        n = len(payloads)
+        if n == 0:
+            return None
+        if n > len(self._lens):
+            self._lens = np.zeros(max(n, 2 * len(self._lens)), np.int32)
+        lens = self._lens
+        try:
+            lens[:n] = np.fromiter(map(len, payloads), np.int32, count=n)
+            ptrs = (ctypes.c_char_p * n)(*payloads)
+        except TypeError:  # a non-bytes payload (memoryview/Bytes slipped in)
+            return None
+        total = int(lens[:n].sum()) + 4 * n
+        out = bytearray(total)
+        out_ptr = (ctypes.c_uint8 * total).from_buffer(out)
+        wrote = self._lib.pushcdn_encode_frames_ptrs(
+            ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_char_p)),
+            _ptr(lens, ctypes.c_int32), n,
+            ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_uint8)), total)
+        del out_ptr  # release the from_buffer export before handing out
+        if wrote != total:
+            return None
+        return out
+
+
+_shared_encoder: Optional[FrameEncoder] = None
+_shared_encoder_tried = False
+
+
+def shared_encoder() -> Optional[FrameEncoder]:
+    """Process-wide :class:`FrameEncoder` for single-event-loop callers
+    that only use :meth:`FrameEncoder.encode_detached` (no persistent
+    output buffer is shared, so one instance serves every connection)."""
+    global _shared_encoder, _shared_encoder_tried
+    if _shared_encoder is None and not _shared_encoder_tried:
+        _shared_encoder_tried = True
+        _shared_encoder = FrameEncoder.create(capacity=1)
+    return _shared_encoder
+
+
+class _EgressLease:
+    """Owns one pooled egress buffer; when the LAST reference to the lease
+    drops (the :class:`EgressStreams` and every writer entry holding it),
+    the buffer returns to the free pool instead of the allocator. This is
+    what turns the per-step egress allocation — whose page-fault cost was
+    ~2/3 of the engine's steady-state runtime — into a recycled buffer.
+
+    Callers that hand stream views to asynchronous consumers (connection
+    writers) must keep the lease alive alongside the view (the ``owner``
+    seat on ``PreEncoded`` / ``send_encoded_nowait``); a view without its
+    lease risks the pool recycling the buffer under a pending write."""
+
+    __slots__ = ("_buf",)
+
+    def __init__(self, buf: bytearray):
+        self._buf = buf
+
+    def __del__(self):
+        buf = self._buf
+        # drop buffers far above the (decaying) recent need instead of
+        # pooling them: one anomalous spike step must not pin
+        # spike-sized allocations for process lifetime
+        if buf is not None and len(_EGRESS_POOL) < _EGRESS_POOL_MAX \
+                and len(buf) <= 8 * _EGRESS_NEED_HW:
+            _EGRESS_POOL.append(buf)
+
+
+_EGRESS_POOL: list = []   # free bytearrays (bounded; newest last)
+_EGRESS_POOL_MAX = 3
+_EGRESS_NEED_HW = 1 << 20  # decaying high-water mark of real step sizes
+
+
+def _egress_note_need(nbytes: int) -> None:
+    """Record a step's actual egress size (geometric decay: the
+    high-water mark forgets a spike within ~tens of steps)."""
+    global _EGRESS_NEED_HW
+    _EGRESS_NEED_HW = max(nbytes, int(_EGRESS_NEED_HW * 0.9), 1 << 20)
+
+
+def _egress_take(nbytes: int):
+    """Take a pooled buffer of at least ``nbytes``, or allocate fresh.
+    Returns (bytearray, lease). Lock-free on purpose: encode runs both on
+    the event loop and in mesh-group worker threads, and the lease's
+    ``__del__`` (which appends back) can fire inside any allocation's GC —
+    so only GIL-atomic list ops are used, with a defensive retry."""
+    pool = _EGRESS_POOL
+    try:
+        for _ in range(len(pool)):
+            buf = pool.pop()
+            if len(buf) >= nbytes:
+                return buf, _EgressLease(buf)
+            pool.insert(0, buf)  # too small for this step: rotate away
+    except IndexError:  # raced another taker
+        pass
+    buf = bytearray(max(nbytes, 1 << 20))
+    return buf, _EgressLease(buf)
+
 
 class EgressStreams:
     """One step's egress, encoded: per-user length-delimited streams laid
     out back-to-back in one buffer. ``users`` lists the slots with at least
     one delivery; ``stream(i)`` is the i-th listed user's bytes — already
-    wire-framed, handed to the connection writer as-is."""
+    wire-framed, handed to the connection writer as-is (pass this object
+    as the writer's ``owner`` so the pooled buffer outlives the flush)."""
 
-    __slots__ = ("buf", "users", "offsets", "nbytes", "msgs", "total_msgs")
+    __slots__ = ("buf", "users", "offsets", "nbytes", "msgs", "total_msgs",
+                 "lease")
 
-    def __init__(self, buf, users, offsets, nbytes, msgs):
+    def __init__(self, buf, users, offsets, nbytes, msgs, lease=None):
         self.buf = buf
         self.users = users      # int list — user slots with deliveries
         self.offsets = offsets  # int64[U] stream starts (all slots)
         self.nbytes = nbytes    # int64[U] stream sizes (all slots)
         self.msgs = msgs        # int32[U] delivered count (all slots)
         self.total_msgs = int(msgs.sum())
+        self.lease = lease      # pooled-buffer lease (None = plain alloc)
 
     def stream(self, slot: int) -> memoryview:
         off = int(self.offsets[slot])
@@ -355,24 +477,45 @@ def egress_encode(deliver: np.ndarray, lengths: np.ndarray,
     lengths = np.ascontiguousarray(lengths, np.int32)
     per_bytes = np.zeros(U, np.int64)
     per_msgs = np.zeros(U, np.int32)
-    lib.pushcdn_egress_count(
-        _ptr(deliver, ctypes.c_uint8), U, N,
-        _ptr(lengths, ctypes.c_int32),
-        _ptr(per_bytes, ctypes.c_int64), _ptr(per_msgs, ctypes.c_int32))
-    total = int(per_bytes.sum())
     offsets = np.zeros(U, np.int64)
-    np.cumsum(per_bytes[:-1], out=offsets[1:])
-    out = np.empty(total if total else 1, np.uint8)
     block_ptrs = (ctypes.c_void_p * len(blocks))(
         *(b.ctypes.data for b in blocks))
-    wrote = lib.pushcdn_egress_fill(
+
+    # Fused single pass into a pooled buffer: count + prefix + fill in one
+    # matrix walk, zero allocation in the steady state (the lease returns
+    # the buffer once the streams and every pending writer entry drop).
+    # A too-small buffer (first step, or a new high-water mark) sizes
+    # exactly via the count pass and retries once.
+    buf, lease = _egress_take(1)
+    buf_np = np.frombuffer(buf, np.uint8)
+    wrote = lib.pushcdn_egress_encode_fused(
         _ptr(deliver, ctypes.c_uint8), U, N, _ptr(lengths, ctypes.c_int32),
         block_ptrs, len(blocks), rows, stride,
-        _ptr(offsets, ctypes.c_int64), _ptr(out, ctypes.c_uint8), total)
-    if wrote != total:  # can't happen on one snapshot; stay safe
-        return None
+        _ptr(offsets, ctypes.c_int64), _ptr(per_bytes, ctypes.c_int64),
+        _ptr(per_msgs, ctypes.c_int32), _ptr(buf_np, ctypes.c_uint8),
+        len(buf))
+    if wrote < 0:
+        lib.pushcdn_egress_count(
+            _ptr(deliver, ctypes.c_uint8), U, N,
+            _ptr(lengths, ctypes.c_int32),
+            _ptr(per_bytes, ctypes.c_int64), _ptr(per_msgs, ctypes.c_int32))
+        total = int(per_bytes.sum())
+        del buf_np
+        buf, lease = _egress_take(total)
+        buf_np = np.frombuffer(buf, np.uint8)
+        wrote = lib.pushcdn_egress_encode_fused(
+            _ptr(deliver, ctypes.c_uint8), U, N,
+            _ptr(lengths, ctypes.c_int32),
+            block_ptrs, len(blocks), rows, stride,
+            _ptr(offsets, ctypes.c_int64), _ptr(per_bytes, ctypes.c_int64),
+            _ptr(per_msgs, ctypes.c_int32), _ptr(buf_np, ctypes.c_uint8),
+            len(buf))
+        if wrote != total:  # can't happen on one snapshot; stay safe
+            return None
+    _egress_note_need(int(wrote))
     users = np.nonzero(per_msgs)[0].tolist()
-    return EgressStreams(out, users, offsets, per_bytes, per_msgs)
+    return EgressStreams(buf, users, offsets, per_bytes, per_msgs,
+                         lease=lease)
 
 
 def encode_frames(payloads: list[bytes]) -> Optional[bytes]:

@@ -1,0 +1,17 @@
+"""JAX API compatibility: ``shard_map`` moved from
+``jax.experimental.shard_map`` (<= 0.4.x, kwarg ``check_rep``) to
+``jax.shard_map`` (newer, kwarg ``check_vma``). The deployment images span
+both; every call site goes through :func:`shard_map` here."""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check: bool = False):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check)

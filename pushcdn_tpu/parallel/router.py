@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from pushcdn_tpu.parallel.jax_compat import shard_map as _shard_map_compat
 from pushcdn_tpu.parallel.crdt import (
     ABSENT,
     CrdtState,
@@ -352,13 +353,11 @@ def make_mesh_lane_step(mesh: Mesh, gather_bytes: bool = True):
                                     gather_bytes=gather_bytes)
         return jax.tree.map(lambda x: x[None], result)
 
-    sharded = jax.shard_map(
+    sharded = _shard_map_compat(
         per_shard, mesh=mesh,
         in_specs=(P(BROKER_AXIS), P(BROKER_AXIS), P(BROKER_AXIS),
                   P(BROKER_AXIS)),
-        out_specs=P(BROKER_AXIS),
-        check_vma=False,
-    )
+        out_specs=P(BROKER_AXIS))
 
     @jax.jit
     def step(state, batches, directs, liveness=None):
@@ -393,12 +392,10 @@ def make_mesh_routing_step(mesh: Mesh, with_direct: bool = False):
         return jax.tree.map(lambda x: x[None], tuple(result))
 
     n_in = 3 if with_direct else 2
-    sharded = jax.shard_map(
+    sharded = _shard_map_compat(
         per_shard, mesh=mesh,
         in_specs=tuple(P(BROKER_AXIS) for _ in range(n_in)),
-        out_specs=P(BROKER_AXIS),
-        check_vma=False,
-    )
+        out_specs=P(BROKER_AXIS))
 
     def _unpack(out):
         return RouteResult(

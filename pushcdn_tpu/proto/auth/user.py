@@ -13,12 +13,13 @@ Capability parity with cdn-proto/src/connection/auth/user.rs:28-162:
 
 from __future__ import annotations
 
+import asyncio
 import struct
 import time
 from typing import List, Tuple, Type
 
 from pushcdn_tpu.proto.crypto.signature import KeyPair, Namespace, SignatureScheme
-from pushcdn_tpu.proto.error import ErrorKind, bail
+from pushcdn_tpu.proto.error import Error, ErrorKind, bail
 from pushcdn_tpu.proto.message import (
     AuthenticateResponse,
     AuthenticateWithKey,
@@ -73,7 +74,24 @@ async def authenticate_with_broker(
     # handshake), and the broker still reads them in order
     await connection.send_message(AuthenticateWithPermit(permit=permit),
                                   flush=True)
-    await connection.send_message(Subscribe(topics), flush=True)
+    try:
+        await connection.send_message(Subscribe(topics), flush=True)
+    except Error as send_err:
+        # A rejected permit tears the connection down broker-side, so the
+        # pipelined Subscribe's flush can fail before we ever read the
+        # response — but the rejection (permit 0 + reason) is usually
+        # already buffered ahead of the FIN. Surface THAT instead of a
+        # generic write error; fall back to the send error when no
+        # response is readable.
+        try:
+            async with asyncio.timeout(5.0):
+                response = await connection.recv_message()
+        except Exception:
+            raise send_err
+        if isinstance(response, AuthenticateResponse) and response.permit != 1:
+            bail(ErrorKind.AUTHENTICATION,
+                 f"broker rejected permit: {response.context!r}")
+        raise send_err
     response = await connection.recv_message()
     if not isinstance(response, AuthenticateResponse):
         bail(ErrorKind.AUTHENTICATION,

@@ -26,11 +26,16 @@ import enum
 import hashlib
 from dataclasses import dataclass
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:  # the OpenSSL backend; images without it use the pure-Python fallback
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    _HAVE_CRYPTOGRAPHY = True
+except ImportError:  # gated dependency: never installed at import time
+    _HAVE_CRYPTOGRAPHY = False
+    from pushcdn_tpu.proto.crypto import _ed25519_fallback
 
 from pushcdn_tpu.proto.error import ErrorKind, bail
 
@@ -88,12 +93,16 @@ class Ed25519Scheme(SignatureScheme):
     @classmethod
     def generate_keypair(cls, seed: int | None = None) -> KeyPair:
         if seed is None:
-            priv = Ed25519PrivateKey.generate()
+            import os as _os
+            raw = _os.urandom(32)
         else:
             # 32 deterministic bytes from the seed (DeterministicRng parity)
             raw = hashlib.blake2b(seed.to_bytes(8, "little", signed=False),
                                   digest_size=32).digest()
-            priv = Ed25519PrivateKey.from_private_bytes(raw)
+        if not _HAVE_CRYPTOGRAPHY:
+            return KeyPair(public_key=_ed25519_fallback.publickey(raw),
+                           private_key=raw)
+        priv = Ed25519PrivateKey.from_private_bytes(raw)
         from cryptography.hazmat.primitives import serialization
         return KeyPair(
             public_key=priv.public_key().public_bytes(
@@ -107,6 +116,9 @@ class Ed25519Scheme(SignatureScheme):
     def sign(cls, private_key: bytes, namespace: Namespace,
              message: bytes) -> bytes:
         try:
+            if not _HAVE_CRYPTOGRAPHY:
+                return _ed25519_fallback.sign(bytes(private_key),
+                                              _namespaced(namespace, message))
             priv = Ed25519PrivateKey.from_private_bytes(private_key)
             return priv.sign(_namespaced(namespace, message))
         except Exception as exc:
@@ -115,6 +127,13 @@ class Ed25519Scheme(SignatureScheme):
     @classmethod
     def verify(cls, public_key: bytes, namespace: Namespace,
                message: bytes, signature: bytes) -> bool:
+        if not _HAVE_CRYPTOGRAPHY:
+            try:
+                return _ed25519_fallback.verify(
+                    bytes(public_key), _namespaced(namespace, message),
+                    bytes(signature))
+            except Exception:
+                return False
         try:
             pub = Ed25519PublicKey.from_public_bytes(public_key)
             pub.verify(bytes(signature), _namespaced(namespace, message))

@@ -142,8 +142,11 @@ def render_tasks() -> str:
     suspended — the poor man's tokio-console (`GET /tasks`)."""
     lines = []
     for task in sorted(asyncio.all_tasks(), key=lambda t: t.get_name()):
+        # Task.cancelling is 3.11+; 3.10 images just report pending
+        _cancelling = getattr(task, "cancelling", None)
         state = "done" if task.done() else (
-            "cancelling" if task.cancelling() else "pending")
+            "cancelling" if _cancelling is not None and _cancelling()
+            else "pending")
         where = ""
         if not task.done():
             stack = task.get_stack(limit=1)

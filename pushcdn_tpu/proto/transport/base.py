@@ -24,6 +24,7 @@ from __future__ import annotations
 import abc
 import asyncio
 import struct
+import weakref
 from collections import deque
 from typing import List, Optional
 
@@ -125,15 +126,21 @@ class FrameChunk:
 
 class PreEncoded:
     """An already-length-delimited byte stream: the writer sends it
-    verbatim, adding no framing. This is the device-plane egress handoff —
-    the native engine (native.egress_encode) encodes a whole step's worth
-    of frames for one user into one buffer, and the connection flushes it
-    with one write instead of re-framing per message."""
+    verbatim, adding no framing. This is the egress batch handoff — the
+    native engine (native.egress_encode) encodes a whole step's worth of
+    frames for one user into one buffer, the routing loops pre-encode
+    per-peer fan-out batches (FrameEncoder.encode_detached), and the
+    connection flushes either with one write instead of re-framing per
+    message. ``owner`` is an opaque keep-alive (e.g. the EgressStreams
+    whose pooled buffer ``data`` views): it rides the queue entry until
+    the flush completes, so buffer recycling can never race a pending
+    write."""
 
-    __slots__ = ("data",)
+    __slots__ = ("data", "owner")
 
-    def __init__(self, data):
+    def __init__(self, data, owner=None):
         self.data = data  # bytes / memoryview over the step's egress buffer
+        self.owner = owner
 
 
 def _py_scan_frames(buf, max_frame_len: int):
@@ -175,6 +182,15 @@ class RawStream(abc.ABC):
     async def write(self, data) -> None:
         """Buffer ``data`` and flush (may await backpressure)."""
 
+    async def writev(self, bufs) -> None:
+        """Vectored write: flush ``bufs`` back-to-back as one unit.
+        Transports with a gather-capable sink override this (asyncio's
+        ``writelines`` hands the whole run to one transport write); the
+        default is sequential — correctness-equivalent, one flush per
+        buffer."""
+        for b in bufs:
+            await self.write(b)
+
     @abc.abstractmethod
     async def close(self) -> None:
         """Flush and close the write side gracefully."""
@@ -201,7 +217,18 @@ class AsyncioStream(RawStream):
         return data
 
     async def write(self, data) -> None:
+        # memoryviews are materialized here (not passed through): newer
+        # asyncio transports keep buffer references instead of copying,
+        # and the egress pool recycles the underlying buffer as soon as
+        # its lease drops — the transport must own a private copy
         self.writer.write(bytes(data) if isinstance(data, memoryview) else data)
+        await self.writer.drain()
+
+    async def writev(self, bufs) -> None:
+        # one gather handoff: writelines joins the run into a single
+        # transport write (one kernel handoff instead of one per buffer)
+        self.writer.writelines(
+            [bytes(b) if isinstance(b, memoryview) else b for b in bufs])
         await self.writer.drain()
 
     async def close(self) -> None:
@@ -252,7 +279,49 @@ class Connection:
         # handshake-only link whose few flushed sends all take the inline
         # fast path never pays the task spawn (or its batch encoder)
         self._writer_task: Optional[asyncio.Task] = None
+        # True while the writer is in the load regime (last wakeup flushed
+        # a multi-frame batch) — gates the adaptive coalesce window
+        self._coalescing = False
         self._reader_task = asyncio.create_task(self._reader_loop())
+        # Permit-leak backstop (ADVICE r5): a poisoned connection keeps
+        # its receive side deliverable (data-before-FIN), so _poison must
+        # NOT drain it — but an ABANDONED handle (handler crash, dropped
+        # reference, never close()d) would then pin its queued frames'
+        # pool permits forever. The finalizer drains whatever still sits
+        # in the queues when the LAST reference to this connection drops;
+        # anything a consumer already took out is the consumer's to
+        # release, exactly as before.
+        self._finalizer = weakref.finalize(
+            self, Connection._drain_abandoned,
+            self._send_q, self._recv_q, self._recv_pending)
+
+    @staticmethod
+    def _drain_abandoned(send_q: asyncio.Queue, recv_q: asyncio.Queue,
+                         recv_pending: deque) -> None:
+        """Release every queued frame's pool permit (GC-time backstop; the
+        containers are empty when ``close()`` already ran)."""
+        for q in (send_q, recv_q):
+            while True:
+                try:
+                    item = q.get_nowait()
+                except (asyncio.QueueEmpty, RuntimeError):
+                    break
+                if item is _CLOSE or isinstance(item, Error):
+                    continue
+                if isinstance(item, tuple):  # send entry: (payload, done)
+                    item = item[0]
+                    if type(item) is PreEncoded:
+                        continue
+                if isinstance(item, (Bytes, FrameChunk)):
+                    item.release()
+                elif isinstance(item, list):
+                    for p in item:
+                        if isinstance(p, Bytes):
+                            p.release()
+        while recv_pending:
+            item = recv_pending.popleft()
+            if isinstance(item, (Bytes, FrameChunk)):
+                item.release()
 
     def _ensure_writer(self) -> None:
         if self._writer_task is None:
@@ -273,6 +342,13 @@ class Connection:
         async with asyncio.timeout(WRITE_TIMEOUT_S):
             await self._stream.write(buf)
         metrics_mod.BYTES_SENT.inc(len(buf))
+
+    async def _flush_v(self, bufs) -> None:
+        """Vectored twin of :meth:`_flush`: one timeout window, one gather
+        handoff (``writev``) for a run of buffers."""
+        async with asyncio.timeout(WRITE_TIMEOUT_S):
+            await self._stream.writev(bufs)
+        metrics_mod.BYTES_SENT.inc(sum(len(b) for b in bufs))
 
     async def _flush_chunked(self, data) -> None:
         """Flush an already-framed stream (PreEncoded) in bounded chunks so
@@ -297,6 +373,30 @@ class Connection:
         try:
             while True:
                 item = await self._send_q.get()
+                # Adaptive coalesce window: when the PREVIOUS wakeup
+                # coalesced (load regime) and this one would flush a
+                # lone frame, yield one loop tick first — ready producer
+                # tasks enqueue their frames and this flush carries a
+                # batch too. An idle link (previous flush was depth-1)
+                # writes immediately: the latency regime never waits.
+                if self._coalescing and self._send_q.empty():
+                    try:
+                        await asyncio.sleep(0)
+                    except asyncio.CancelledError:
+                        # cancelled in the yield: the dequeued entry is in
+                        # neither the queue nor `batch` — its permits and
+                        # flush future are ours to settle
+                        if item is not _CLOSE:
+                            payload, done = item
+                            if type(payload) is list:
+                                for p in payload:
+                                    if isinstance(p, Bytes):
+                                        p.release()
+                            elif isinstance(payload, Bytes):
+                                payload.release()
+                            if done is not None and not done.done():
+                                done.cancel()
+                        raise
                 # every write section holds the mutex: send_raw's inline
                 # flush fast path writes from the sender's task, and the
                 # two paths must never interleave bytes on the stream
@@ -347,10 +447,20 @@ class Connection:
         if self._send_q.empty():
             payload, done = item
             if type(payload) is PreEncoded:
+                # a PreEncoded entry IS a fan-out batch (routing-loop /
+                # device-plane egress): it counts as the load regime, so
+                # the adaptive window arms for the next wakeup. The entry
+                # rides `batch` during the flush so a timeout/cancel
+                # mid-write settles its flush future via the loop's
+                # handlers (same pattern as the small-frame path below).
+                self._coalescing = True
+                batch.append(item)
                 await self._flush_chunked(payload.data)
+                batch.clear()
                 if done is not None and not done.done():
                     done.set_result(None)
                 return False
+            self._coalescing = False
             if type(payload) is not list:
                 data = payload.data if isinstance(payload, Bytes) \
                     else payload
@@ -404,6 +514,13 @@ class Connection:
                                   else payload)
                 if done is not None:
                     dones.append(done)
+            # load-regime signal for the adaptive coalesce window: a
+            # multi-entry drain OR one entry carrying a whole fan-out
+            # batch (a send_raw_many list or a PreEncoded stream) both
+            # mean traffic is flowing
+            self._coalescing = (len(batch) > 1 or len(frames) > 1
+                                or (len(frames) == 1
+                                    and type(frames[0]) is PreEncoded))
 
             buf = bytearray()
             i, nf = 0, len(frames)
@@ -451,17 +568,20 @@ class Connection:
                         await self._flush(buf)
                         buf = bytearray()
                 else:
-                    if buf:
-                        await self._flush(buf)
-                        buf = bytearray()
-                    await self._flush(bytearray(_LEN.pack(n)))
-                    # large frames flush in bounded chunks so slow
-                    # links get a timeout window per chunk, not one
-                    # window for the whole payload
+                    # large frame: one vectored flush hands any coalesced
+                    # small-frame run + the header + the first chunk to
+                    # the stream together (no separate 4-byte write);
+                    # remaining chunks flush one timeout window each so
+                    # slow links get a window per chunk, not per payload
                     view = memoryview(data)
                     chunk = 4 * self._BATCH_COALESCE_LIMIT
-                    for off in range(0, n, chunk):
-                        await self._flush(bytearray(view[off:off + chunk]))
+                    head = [_LEN.pack(n), view[:chunk]]
+                    if buf:
+                        head.insert(0, buf)
+                        buf = bytearray()
+                    await self._flush_v(head)
+                    for off in range(chunk, n, chunk):
+                        await self._flush(view[off:off + chunk])
                 i += 1
             if buf:
                 await self._flush(buf)
@@ -719,9 +839,23 @@ class Connection:
         # The error marker queues BEHIND them; the owner's eventual
         # ``close()`` returns any never-consumed permits to the pool.
         self._drain_send_queue(err)
-        # Wake any blocked receiver.
+        # Ask a parked writer task to exit: a task blocked on the send
+        # queue holds a reference to this connection forever, which would
+        # keep the abandoned-handle finalizer (permit backstop) from ever
+        # firing.
+        if self._writer_task is not None and not self._writer_task.done():
+            try:
+                self._send_q.put_nowait(_CLOSE)
+            except asyncio.QueueFull:
+                self._writer_task.cancel()
+        # Wake any blocked receiver. The queued marker is a traceback-free
+        # clone: the original's traceback references the reader frame and
+        # thus this connection, and the abandoned-handle finalizer holds
+        # the queue — a full Error would cycle the connection through the
+        # finalizer's own argument and keep GC from ever reclaiming an
+        # abandoned handle (the exact leak the finalizer exists to stop).
         try:
-            self._recv_q.put_nowait(err)
+            self._recv_q.put_nowait(Error(err.kind, err.message))
         except asyncio.QueueFull:
             pass
 
@@ -889,18 +1023,38 @@ class Connection:
         if done is not None:
             await done
 
-    def send_encoded_nowait(self, data) -> None:
+    def send_encoded_nowait(self, data, owner=None) -> None:
         """Queue an ALREADY length-delimited byte stream (one or many
         frames, each u32-BE-prefixed) to be written verbatim — the
         device-plane egress path: the native engine frames a whole step's
         deliveries per user in C, so the writer's only job is the flush.
-        ``data`` may be a memoryview over the step's shared egress buffer
-        (kept alive by this reference until written)."""
+        ``data`` may be a memoryview over the step's shared egress buffer;
+        pass the buffer's holder (e.g. the ``EgressStreams``) as ``owner``
+        so a pooled buffer cannot be recycled under the pending write."""
         self._check()
-        self._send_q.put_nowait((PreEncoded(data), None))
+        self._send_q.put_nowait((PreEncoded(data, owner), None))
         self._ensure_writer()
         if self._error is not None:
             raise self._error
+
+    async def send_encoded(self, data, owner=None, flush: bool = False) -> None:
+        """Awaited twin of :meth:`send_encoded_nowait`: queues behind a
+        bounded send queue instead of raising ``QueueFull`` — the routing
+        loops' pre-encoded egress handoff (one writer entry, one verbatim
+        flush for a whole per-peer fan-out batch)."""
+        self._check()
+        done = asyncio.get_running_loop().create_future() if flush else None
+        q = self._send_q
+        entry = (PreEncoded(data, owner), done)
+        if q.maxsize <= 0:
+            q.put_nowait(entry)  # unbounded: no coroutine hop
+        else:
+            await q.put(entry)
+        self._ensure_writer()
+        if self._error is not None:
+            raise self._error
+        if done is not None:
+            await done
 
     def send_raw_many_nowait(self, raws: list) -> None:
         """Batch variant of :meth:`send_raw_nowait` (one entry, no await),

@@ -88,11 +88,8 @@ from pushcdn_tpu.proto.transport.tls_stream import TlsStream
  _PROBE, _PROBEACK) = range(1, 11)
 
 
-def _grow_socket_buffers(transport) -> None:
+def _tune_socket(sock) -> None:
     import socket as _socket
-    sock = transport.get_extra_info("socket")
-    if sock is None:
-        return
     for opt in (_socket.SO_RCVBUF, _socket.SO_SNDBUF):
         try:
             sock.setsockopt(_socket.SOL_SOCKET, opt, SOCK_BUF)
@@ -102,8 +99,8 @@ def _grow_socket_buffers(transport) -> None:
     # without it the kernel IP-fragments oversized probes, they arrive
     # reassembled, and probing "confirms" a size the path can't carry as
     # single packets. With DF set, an oversized send fails locally
-    # (EMSGSIZE, swallowed by _tx) or is dropped by the path — either way
-    # the probe is simply never acknowledged.
+    # (EMSGSIZE, surfaced to on_msgsize_error) or is dropped by the path —
+    # either way the probe is simply never acknowledged.
     try:
         sock.setsockopt(_socket.IPPROTO_IP, _socket.IP_MTU_DISCOVER,
                         _socket.IP_PMTUDISC_DO)
@@ -218,6 +215,14 @@ class _UdpStream(RawStream):
                                                  # in-order byte not yet ACKed
         self._rx_since_ack = 0                   # data datagrams since last ACK
 
+        # batched-receive ACK coalescing (see begin/end_rx_batch): the
+        # endpoint's drain loop brackets a whole socket backlog, and the
+        # per-datagram ACK decisions collapse into one per wakeup
+        self._rx_batched = False
+        self._batch_ooo = 0
+        self._batch_reack = False
+        self._batch_progress = False
+
         self._error: Optional[Exception] = None
         self._closed = False
         self._last_recv = time.monotonic()
@@ -240,8 +245,11 @@ class _UdpStream(RawStream):
             payload = body[_OFF.size:]
             if off < self._expected:
                 # duplicate of delivered data: re-ACK immediately so a
-                # retransmitting sender converges
-                self._flush_ack()
+                # retransmitting sender converges (once per batched drain)
+                if self._rx_batched:
+                    self._batch_reack = True
+                else:
+                    self._flush_ack()
             elif off == self._expected:
                 # QUIC semantics: ack_delay is measured from the arrival
                 # of the NEWEST data the ACK covers (the sender keys its
@@ -259,9 +267,12 @@ class _UdpStream(RawStream):
                 # every-2nd-datagram rule (keeps slow start ACK-clocked
                 # while datagrams are small), the byte threshold (bounds
                 # ACK latency once MTU probing makes datagrams huge), or
-                # the timer
+                # the timer. Inside a batched drain the decision defers
+                # to end_rx_batch: one coalesced ACK per socket wakeup.
                 self._rx_since_ack += 1
-                if (self._rx_since_ack >= ACK_EVERY_DATAGRAMS
+                if self._rx_batched:
+                    self._batch_progress = True
+                elif (self._rx_since_ack >= ACK_EVERY_DATAGRAMS
                         or self._expected - self._last_acked_rx
                         >= ACK_EVERY_BYTES):
                     self._flush_ack()
@@ -270,8 +281,13 @@ class _UdpStream(RawStream):
             else:
                 self._ooo.setdefault(off, payload)
                 # out-of-order: ACK immediately; the duplicate cumulative
-                # ACKs drive the sender's fast retransmit
-                self._flush_ack()
+                # ACKs drive the sender's fast retransmit (batched drains
+                # coalesce but preserve the dup count, capped — see
+                # end_rx_batch)
+                if self._rx_batched:
+                    self._batch_ooo += 1
+                else:
+                    self._flush_ack()
             self._check_eof()
         elif ptype == _PROBE:
             # the datagram made it across the path — confirm its size, but
@@ -294,10 +310,18 @@ class _UdpStream(RawStream):
                         # re-express the window in the new units — else a
                         # 64 KB-MTU path ramps from a 1200 B-era window
                         # through queue-bloated RTTs, and short flows
-                        # measure the ramp instead of the path. Pacing
-                        # still smooths the larger window onto the wire.
-                        self._cwnd = max(self._cwnd,
-                                         float(CWND_INITIAL_SEGS * new_mtu))
+                        # measure the ramp instead of the path. CAPPED at
+                        # 4x the current window per probe step: one
+                        # PROBEACK is one delivery proof at the new size,
+                        # not license to dump CWND_INITIAL_SEGS jumbo
+                        # segments on a shallow-buffered path in a single
+                        # burst — the ascending probe ladder re-expresses
+                        # in <=4x steps and still reaches the full window
+                        # on paths that confirm every size. Pacing still
+                        # smooths the larger window onto the wire.
+                        self._cwnd = max(self._cwnd, min(
+                            float(CWND_INITIAL_SEGS * new_mtu),
+                            4.0 * self._cwnd))
                     self._mtu = new_mtu
         elif ptype == _ACK:
             ack = _OFF.unpack_from(body)[0]
@@ -437,6 +461,43 @@ class _UdpStream(RawStream):
         self._rx_since_ack = 0
         self._tx(_ACK, _OFF.pack(self._expected)
                  + _ACK_DELAY.pack(self._ack_delay_us()))
+
+    def begin_rx_batch(self) -> None:
+        """Enter batched-receive mode for one endpoint drain: per-datagram
+        ACK decisions defer to :meth:`end_rx_batch` so a whole socket
+        backlog generates ONE coalesced ACK instead of one per datagram
+        (the unbatched per-packet rules still apply outside a drain —
+        tests and exotic endpoints feed ``on_packet`` directly)."""
+        self._rx_batched = True
+        self._batch_ooo = 0
+        self._batch_reack = False
+        self._batch_progress = False
+
+    def end_rx_batch(self) -> None:
+        """Emit the batch's coalesced ACK decision."""
+        self._rx_batched = False
+        if self._closed:
+            return
+        if self._batch_ooo:
+            # A hole is outstanding past delivered data: send the
+            # cumulative ACK, duplicated up to the fast-retransmit
+            # threshold so the sender's dup-ACK clocking sees the same
+            # evidence the per-datagram path produced (each OOO datagram
+            # used to emit one) without re-ACKing a 64-datagram burst
+            # 64 times.
+            self._flush_ack()
+            for _ in range(min(self._batch_ooo, DUP_ACK_FAST_RETX) - 1):
+                self._tx(_ACK, _OFF.pack(self._expected)
+                         + _ACK_DELAY.pack(0))
+        elif self._batch_progress:
+            if (self._rx_since_ack >= ACK_EVERY_DATAGRAMS
+                    or self._expected - self._last_acked_rx
+                    >= ACK_EVERY_BYTES):
+                self._flush_ack()
+            else:
+                self._schedule_ack()
+        elif self._batch_reack:
+            self._flush_ack()
 
     def _schedule_ack(self) -> None:
         if self._ack_handle is None:
@@ -753,62 +814,172 @@ class _UdpStream(RawStream):
                 pass
 
 
-class _ClientEndpoint(asyncio.DatagramProtocol):
+# One endpoint wakeup drains this many datagrams before yielding back to
+# the event loop (level-triggered readiness re-fires if more remain). The
+# old one-callback-per-datagram shape paid a full event-loop round trip +
+# recvfrom per packet; a drained batch shares one wakeup, and every
+# touched stream emits ONE coalesced ACK at the end.
+_RX_BATCH = 128
+_RX_BUF_BYTES = 65536 + 128  # one max datagram + header slack
+
+
+class _UdpEndpoint:
+    """Manual non-blocking UDP socket with a batched receive drain.
+
+    Replaces the asyncio ``DatagramProtocol`` plumbing: readiness fires
+    ``_on_readable`` once per backlog, which drains up to ``_RX_BATCH``
+    datagrams with ``recvfrom_into`` into one reusable buffer, dispatches
+    each, and then lets every touched stream collapse its ACK decisions
+    into a single coalesced ACK (begin/end_rx_batch). Sends are direct
+    (synchronous) ``sendto``/``send`` — EMSGSIZE attributes to the exact
+    stream that sent, kernel-full drops are best-effort (the ARQ
+    retransmits), matching the old error_received semantics without the
+    transport indirection."""
+
+    def __init__(self, sock, loop):
+        self.sock = sock
+        self._loop = loop
+        self._fd = sock.fileno()
+        self._closed = False
+        self._rx_buf = bytearray(_RX_BUF_BYTES)
+        self._rx_view = memoryview(self._rx_buf)
+        loop.add_reader(self._fd, self._on_readable)
+
+    # subclasses: dispatch one datagram (header already length-checked)
+    def _dispatch(self, ptype: int, conn_id: int, body: bytes, addr,
+                  touched: dict) -> None:
+        raise NotImplementedError
+
+    def _on_sock_error(self, exc: OSError) -> None:
+        raise NotImplementedError
+
+    def _on_readable(self) -> None:
+        sock = self.sock
+        buf = self._rx_buf
+        view = self._rx_view
+        touched: dict = {}
+        try:
+            for _ in range(_RX_BATCH):
+                try:
+                    nbytes, addr = sock.recvfrom_into(buf)
+                except (BlockingIOError, InterruptedError):
+                    break
+                except OSError as exc:
+                    self._on_sock_error(exc)
+                    break
+                if nbytes < _HDR.size:
+                    continue  # short datagram: attack surface, drop
+                ptype, conn_id = _HDR.unpack_from(buf)
+                self._dispatch(ptype, conn_id,
+                               bytes(view[_HDR.size:nbytes]), addr, touched)
+        finally:
+            # every touched stream settles its coalesced ACK AFTER the
+            # whole drain (and before any timer gets to run)
+            for stream in touched.values():
+                stream.end_rx_batch()
+
+    @staticmethod
+    def _enter_batch(stream: "_UdpStream", touched: dict) -> None:
+        key = id(stream)
+        if key not in touched:
+            touched[key] = stream
+            stream.begin_rx_batch()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._loop.remove_reader(self._fd)
+        except Exception:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _ClientEndpoint(_UdpEndpoint):
     """One UDP socket per outbound connection (connected to the server)."""
 
-    def __init__(self):
-        self.transport = None
+    def __init__(self, sock, loop):
+        super().__init__(sock, loop)
         self.stream: Optional[_UdpStream] = None
-        self.synack = asyncio.get_running_loop().create_future()
+        self.synack = loop.create_future()
 
-    def connection_made(self, transport):
-        self.transport = transport
+    def send(self, pkt: bytes) -> None:
+        try:
+            self.sock.send(pkt)
+        except (BlockingIOError, InterruptedError):
+            pass  # kernel buffer full: best-effort drop, ARQ retransmits
+        except OSError as exc:
+            if exc.errno == errno.EMSGSIZE:
+                # DF-bit datagram exceeded the path (RFC 8899); the stream
+                # decides probe-bounce vs genuine path-MTU decrease. Never
+                # poison for it — that would kill every connection on real
+                # non-loopback paths ~150 ms after connect when probing
+                # starts.
+                if self.stream is not None:
+                    self.stream.on_msgsize_error()
+            elif self.stream is not None:
+                # any other send error on the connected socket (refused,
+                # net/host unreachable, EPERM...) poisons immediately —
+                # the old DatagramTransport error_received semantics; a
+                # dead route must fail the link now, not at IDLE_TIMEOUT
+                self.stream._poison(exc)
 
-    def datagram_received(self, data, addr):
-        if len(data) < _HDR.size:
-            return
-        ptype, conn_id = _HDR.unpack_from(data)
+    def _dispatch(self, ptype, conn_id, body, addr, touched) -> None:
         if ptype == _SYNACK:
             if not self.synack.done():
                 self.synack.set_result(conn_id)
             return
-        if self.stream is not None and conn_id == self.stream._id:
-            self.stream.on_packet(ptype, data[_HDR.size:])
+        stream = self.stream
+        if stream is not None and conn_id == stream._id:
+            self._enter_batch(stream, touched)
+            stream.on_packet(ptype, body)
 
-    def error_received(self, exc):
-        # EMSGSIZE means a DF-bit datagram exceeded the path (RFC 8899);
-        # the stream decides whether that's an expected probe bounce or a
-        # genuine path-MTU decrease (clamp + re-segment). Never poison for
-        # it — that would kill every connection on real non-loopback paths
-        # ~150 ms after connect when probing starts.
-        if isinstance(exc, OSError) and exc.errno == errno.EMSGSIZE:
+    def _on_sock_error(self, exc: OSError) -> None:
+        # a connected UDP socket surfaces ICMP errors on recv
+        if exc.errno == errno.EMSGSIZE:
             if self.stream is not None:
                 self.stream.on_msgsize_error()
             return
         if self.stream is not None:
             self.stream._poison(exc)
 
-    def connection_lost(self, exc):
-        if self.stream is not None and exc is not None:
-            self.stream._poison(exc)
 
-
-class _ServerEndpoint(asyncio.DatagramProtocol):
+class _ServerEndpoint(_UdpEndpoint):
     """The listener's single UDP socket, demuxing by connection id."""
 
-    def __init__(self, listener: "QuicListener"):
+    def __init__(self, sock, loop, listener: "QuicListener"):
+        super().__init__(sock, loop)
         self.listener = listener
-        self.transport = None
         self.streams: Dict[int, _UdpStream] = {}
         self.addrs: Dict[int, Tuple] = {}
 
-    def connection_made(self, transport):
-        self.transport = transport
+    def _sendto(self, pkt: bytes, addr, conn_id: int) -> None:
+        try:
+            self.sock.sendto(pkt, addr)
+        except (BlockingIOError, InterruptedError):
+            pass  # best-effort; ARQ retransmits
+        except OSError as exc:
+            if exc.errno == errno.EMSGSIZE:
+                # synchronous sendto attributes the bounce to the exact
+                # stream that sent (the old async error_received had to
+                # broadcast it to every stream on the shared socket)
+                stream = self.streams.get(conn_id)
+                if stream is not None:
+                    stream.on_msgsize_error()
+            # other errors: drop; per-stream timers decide
 
-    def datagram_received(self, data, addr):
-        if len(data) < _HDR.size:
-            return
-        ptype, conn_id = _HDR.unpack_from(data)
+    def _sender_for(self, conn_id: int):
+        def send(pkt: bytes) -> None:
+            addr = self.addrs.get(conn_id)
+            if addr is not None and not self._closed:
+                self._sendto(pkt, addr, conn_id)
+        return send
+
+    def _dispatch(self, ptype, conn_id, body, addr, touched) -> None:
         if ptype == _SYN:
             known = conn_id in self.streams
             if not known and not self.listener._closed:
@@ -821,29 +992,23 @@ class _ServerEndpoint(asyncio.DatagramProtocol):
             # (re-)ack the SYN — the client retries until it sees this
             if conn_id in self.streams or known:
                 self.addrs[conn_id] = addr
-                self.transport.sendto(_HDR.pack(_SYNACK, conn_id), addr)
+                self._sendto(_HDR.pack(_SYNACK, conn_id), addr, conn_id)
             return
         stream = self.streams.get(conn_id)
         if stream is not None:
             self.addrs[conn_id] = addr  # follow NAT rebinding
-            stream.on_packet(ptype, data[_HDR.size:])
-
-    def _sender_for(self, conn_id: int):
-        def send(pkt: bytes) -> None:
-            addr = self.addrs.get(conn_id)
-            if addr is not None and self.transport is not None:
-                self.transport.sendto(pkt, addr)
-        return send
+            self._enter_batch(stream, touched)
+            stream.on_packet(ptype, body)
 
     def _drop(self, conn_id: int) -> None:
         self.streams.pop(conn_id, None)
         self.addrs.pop(conn_id, None)
 
-    def error_received(self, exc):
-        # the OS doesn't say which peer the EMSGSIZE belongs to on a
-        # shared socket: let every stream decide (each ignores it while
-        # its own prober is active, clamps + re-segments otherwise)
-        if isinstance(exc, OSError) and exc.errno == errno.EMSGSIZE:
+    def _on_sock_error(self, exc: OSError) -> None:
+        # recv-side ICMP on the shared socket names no peer: EMSGSIZE goes
+        # to every stream (each ignores it while its own prober is
+        # active); anything else is dropped — per-stream timers decide
+        if exc.errno == errno.EMSGSIZE:
             for stream in list(self.streams.values()):
                 stream.on_msgsize_error()
 
@@ -876,7 +1041,6 @@ class QuicListener(Listener):
     def __init__(self):
         self._accept_q: asyncio.Queue = asyncio.Queue()
         self._endpoint: Optional[_ServerEndpoint] = None
-        self._transport = None
         self._ssl_context: Optional[ssl.SSLContext] = None
         self._closed = False
         self.bound_port: int = 0
@@ -894,8 +1058,7 @@ class QuicListener(Listener):
         if self._endpoint is not None:
             for stream in list(self._endpoint.streams.values()):
                 stream.abort()
-        if self._transport is not None:
-            self._transport.close()
+            self._endpoint.close()
         self._accept_q.put_nowait(None)
 
 
@@ -910,20 +1073,28 @@ class Quic(Protocol):
         # CA configuration bails (typed, fatal) without leaking timer tasks
         ctx, server_hostname = client_context_for(use_local_authority, host)
         loop = asyncio.get_running_loop()
-        proto: _ClientEndpoint
+        import socket as _socket
+        sock = None
         try:
-            transport, proto = await loop.create_datagram_endpoint(
-                _ClientEndpoint, remote_addr=(host, port))
+            infos = await loop.getaddrinfo(host, port,
+                                           type=_socket.SOCK_DGRAM)
+            family, stype, _pr, _cn, addr = infos[0]
+            sock = _socket.socket(family, stype)
+            sock.setblocking(False)
+            _tune_socket(sock)
+            sock.connect(addr)  # non-blocking UDP connect is immediate
         except OSError as exc:
+            if sock is not None:
+                sock.close()
             bail(ErrorKind.CONNECTION, f"quic connect to {endpoint} failed", exc)
-        _grow_socket_buffers(transport)
+        proto = _ClientEndpoint(sock, loop)
 
         conn_id = int.from_bytes(os.urandom(8), "big")
         syn = _HDR.pack(_SYN, conn_id)
         try:
             deadline = time.monotonic() + CONNECT_TIMEOUT_S
             while True:
-                transport.sendto(syn)
+                proto.send(syn)
                 try:
                     async with asyncio.timeout(
                             min(0.2, max(0.01, deadline - time.monotonic()))):
@@ -936,11 +1107,11 @@ class Quic(Protocol):
                         bail(ErrorKind.CONNECTION,
                              f"quic connect to {endpoint} timed out")
         except BaseException:
-            transport.close()
+            proto.close()
             raise
 
-        stream = _UdpStream(conn_id, transport.sendto,
-                            on_closed=lambda _id: transport.close())
+        stream = _UdpStream(conn_id, proto.send,
+                            on_closed=lambda _id: proto.close())
         proto.stream = stream
         try:
             async with asyncio.timeout(CONNECT_TIMEOUT_S):
@@ -966,14 +1137,21 @@ class Quic(Protocol):
         loop = asyncio.get_running_loop()
         listener = QuicListener()
         listener._ssl_context = certificate.server_context()
-        endpoint_proto = _ServerEndpoint(listener)
+        import socket as _socket
+        sock = None
         try:
-            transport, _ = await loop.create_datagram_endpoint(
-                lambda: endpoint_proto, local_addr=(host, port))
+            infos = await loop.getaddrinfo(host, port,
+                                           type=_socket.SOCK_DGRAM,
+                                           flags=_socket.AI_PASSIVE)
+            family, stype, _pr, _cn, addr = infos[0]
+            sock = _socket.socket(family, stype)
+            sock.setblocking(False)
+            _tune_socket(sock)
+            sock.bind(addr)
         except OSError as exc:
+            if sock is not None:
+                sock.close()
             bail(ErrorKind.CONNECTION, f"quic bind to {endpoint} failed", exc)
-        _grow_socket_buffers(transport)
-        listener._endpoint = endpoint_proto
-        listener._transport = transport
-        listener.bound_port = transport.get_extra_info("sockname")[1]
+        listener._endpoint = _ServerEndpoint(sock, loop, listener)
+        listener.bound_port = sock.getsockname()[1]
         return listener

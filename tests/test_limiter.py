@@ -78,3 +78,94 @@ async def test_latency_sample_recorded():
     await asyncio.sleep(0.01)
     p.release()
     assert pool.latency_samples and pool.latency_samples[0] >= 0.009
+
+
+# ---------------------------------------------------------------------------
+# batch-release invariants under the coalescing egress paths
+# ---------------------------------------------------------------------------
+
+async def _permit_frames(pool, n, size):
+    frames = []
+    for i in range(n):
+        permit = await pool.allocate(size)
+        frames.append(Bytes(bytes([i % 251]) * size, permit))
+    return frames
+
+
+async def test_batched_send_releases_every_clone():
+    """send_raw_many hands a whole fan-out batch to the writer as ONE
+    entry; after the coalesced flush every clone's permit must be back in
+    the pool (no per-frame path may be skipped by batching)."""
+    from pushcdn_tpu.proto.limiter import Limiter
+    from pushcdn_tpu.proto.transport.memory import gen_testing_connection_pair
+
+    limiter = Limiter(global_pool_bytes=100_000)
+    a, b = await gen_testing_connection_pair()
+    pool = limiter.pool
+    frames = await _permit_frames(pool, 20, 1000)
+    assert pool.available == 80_000
+    clones = [f.clone() for f in frames]
+    await a.send_raw_many(clones, flush=True)  # flush ⇒ writer done
+    for f in frames:
+        f.release()
+    assert pool.available == 100_000  # originals + flushed clones
+    got = 0
+    while got < 20:
+        got += len(await asyncio.wait_for(b.recv_raw_many(), 5))
+    a.close()
+    b.close()
+
+
+async def test_pre_encoded_batch_releases_at_encode_time():
+    """The routing loops' pre-encode path copies the batch into one owned
+    buffer, so the frames' permits free at ENCODE time (before the wire
+    flush) — and the receiver still sees every frame intact."""
+    import pytest as _pytest
+    from pushcdn_tpu.broker.tasks.senders import pre_encode_frames
+    from pushcdn_tpu.proto.transport.memory import gen_testing_connection_pair
+
+    pool = MemoryPool(100_000)
+    frames = await _permit_frames(pool, 10, 2000)
+    encoded = pre_encode_frames(frames)
+    if encoded is None:
+        _pytest.skip("native batch encoder unavailable in this image")
+    for f in frames:
+        f.release()
+    assert pool.available == 100_000  # permits home before any flush
+    a, b = await gen_testing_connection_pair()
+    await a.send_encoded(encoded, flush=True)
+    got = []
+    while len(got) < 10:
+        got.extend(await asyncio.wait_for(b.recv_raw_many(), 5))
+    assert [len(g.data) for g in got] == [2000] * 10
+    assert all(bytes(g.data) == bytes([i % 251]) * 2000
+               for i, g in enumerate(got))
+    for g in got:
+        g.release()
+    a.close()
+    b.close()
+
+
+async def test_close_with_queued_batches_returns_permits():
+    """A connection torn down with un-flushed coalesced batches queued
+    must hand every clone's permit back via the drain (the writer never
+    ran for them)."""
+    from pushcdn_tpu.proto.transport.base import Connection
+    from pushcdn_tpu.proto.transport.memory import _BoundedBuffer, _PipeStream
+
+    pool = MemoryPool(50_000)
+    # a pipe nobody reads from, with a tiny window: the writer jams
+    tx = _BoundedBuffer(64)
+    rx = _BoundedBuffer(64)
+    conn = Connection(_PipeStream(rx=rx, tx=tx), label="jammed")
+    frames = await _permit_frames(pool, 10, 1000)
+    await conn.send_raw_many([f.clone() for f in frames])
+    await conn.send_raw_many([f.clone() for f in frames])
+    await asyncio.sleep(0.05)  # writer picks up batch 1 and jams mid-flush
+    conn.close()
+    await asyncio.sleep(0.05)
+    for f in frames:
+        f.release()
+    # whatever the jammed writer held was cancelled + released; the
+    # queued second batch drained synchronously in close()
+    assert pool.available == 50_000

@@ -4,12 +4,14 @@ lane step collectively (parity with the reference's whole-system tier,
 tests/src/tests/mod.rs:62-143, which is what backs its multi-node
 claims)."""
 
+import functools
 import os
 import socket
 import subprocess
 import sys
 
 import jax
+import pytest
 
 from pushcdn_tpu.parallel.mesh import make_broker_mesh
 from pushcdn_tpu.parallel.multihost import (
@@ -18,6 +20,57 @@ from pushcdn_tpu.parallel.multihost import (
     local_shard_indices,
     pod_broker_mesh,
 )
+
+
+_PROBE = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+rank, port = int(sys.argv[1]), sys.argv[2]
+jax.distributed.initialize(f"127.0.0.1:{port}", 2, rank,
+                           local_device_ids=[0])
+out = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+    jax.numpy.ones((1,)))
+assert float(out[0]) == 2.0
+print("PROBE OK")
+"""
+
+
+@functools.lru_cache(None)
+def _cpu_multiprocess_collectives():
+    """(ok, reason): can this jaxlib run cross-process collectives on the
+    CPU backend? Older jaxlibs raise 'Multiprocess computations aren't
+    implemented on the CPU backend' — the two-process tiers skip there
+    (image capability, not a code path; they run unmodified wherever the
+    runtime supports it)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [subprocess.Popen([sys.executable, "-c", _PROBE, str(rank),
+                               str(port)], env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for rank in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        return False, "two-process collective probe timed out"
+    if all(p.returncode == 0 for p in procs):
+        return True, ""
+    tail = "; ".join(o.strip().rsplit("\n", 1)[-1] for o in outs if o)
+    return False, f"jaxlib cannot run multiprocess CPU collectives ({tail})"
+
+
+def _require_two_process_runtime():
+    ok, reason = _cpu_multiprocess_collectives()
+    if not ok:
+        pytest.skip(reason)
 
 
 def test_single_host_owns_all_shards():
@@ -42,6 +95,7 @@ def test_two_process_spmd_lane_step():
     convergence of claims seeded only on the other process's shards (see
     tests/_spmd_worker.py). This is the multi-node evidence the
     single-process 8-device dryrun cannot provide."""
+    _require_two_process_runtime()
     with socket.socket() as s:  # a free coordinator port
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -75,6 +129,7 @@ def test_two_process_multihost_deployment():
     reaches host 1's client, a direct crosses back via the discovery
     user-slot directory, and both brokers hold ZERO host broker links
     throughout (see tests/_multihost_worker.py)."""
+    _require_two_process_runtime()
     import tempfile
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -119,6 +174,7 @@ def test_two_process_kill_and_redeploy():
     "the restarted host rejoins" is a redeployment — the parity analog of
     the reference's same-identity broker restart at deployment
     granularity (heartbeat.rs:69-107 self-heal)."""
+    _require_two_process_runtime()
     import signal
     import tempfile
     import time as _time

@@ -64,7 +64,7 @@ async def test_single_process_group_routes_and_directory(tmp_path):
         def __init__(self):
             self.streams = []
 
-        def send_encoded_nowait(self, data):
+        def send_encoded_nowait(self, data, owner=None):
             self.streams.append(bytes(data))
 
     class FakeConnections:

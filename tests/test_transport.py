@@ -413,6 +413,243 @@ async def test_quic_tls_handshake_survives_datagram_loss():
         b.abort()
 
 
+@pytest.mark.parametrize("proto,endpoint", TRANSPORTS)
+async def test_coalesced_writes_preserve_frame_boundaries(proto, endpoint):
+    """The writer coalesces whole queued runs into single flushes (and the
+    adaptive window makes that the steady state under load): a mixed burst
+    of sizes — sub-byte, odd, exactly at and beyond the coalesce limit —
+    queued in one breath must arrive intact, in order, on every
+    transport."""
+    from pushcdn_tpu.proto.transport.base import Connection
+
+    listener = await proto.bind(endpoint)
+    try:
+        ep = _endpoint_of(listener, endpoint)
+        connect_task = asyncio.create_task(proto.connect(ep))
+        server = await (await asyncio.wait_for(listener.accept(), 10)) \
+            .finalize()
+        client = await asyncio.wait_for(connect_task, 10)
+
+        limit = Connection._BATCH_COALESCE_LIMIT
+        sizes = [1, 7, 100, 1024, 4096, limit - 4, limit, limit + 1,
+                 3 * limit, 5, 64, limit - 1, 2, 9000, 1]
+        frames = [bytes([i % 251]) * s for i, s in enumerate(sizes)]
+        # no awaits between sends: everything lands in the send queue in
+        # one breath, so the writer drains it as coalesced batches
+        for f in frames:
+            await client.send_raw(f)
+        got = []
+        async with asyncio.timeout(30):
+            while len(got) < len(frames):
+                got.extend(b.data if isinstance(b.data, bytes)
+                           else bytes(b.data)
+                           for b in await server.recv_raw_many())
+        assert [len(g) for g in got] == sizes
+        assert got == frames
+        client.close()
+        server.close()
+    finally:
+        await listener.close()
+
+
+class _TornStream:
+    """RawStream wrapper that forwards writes in ragged sub-writes and, at
+    a chosen write index, tears one mid-buffer (half flushed, then an
+    error) — the fault the writer's poison path must turn into a clean
+    connection error, never a mid-frame resync."""
+
+    def __init__(self, inner, tear_at_write: int):
+        self._inner = inner
+        self._writes = 0
+        self._tear_at = tear_at_write
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def write(self, data) -> None:
+        view = memoryview(data)
+        self._writes += 1
+        if self._writes == self._tear_at:
+            await self._inner.write(view[:max(1, len(view) // 2)])
+            raise ConnectionResetError("torn write (fault injection)")
+        # ragged forwarding: split every write into unaligned pieces so
+        # coalesced flushes never map 1:1 onto reader chunks
+        step = 1237
+        for off in range(0, len(view), step):
+            await self._inner.write(view[off:off + step])
+
+    async def writev(self, bufs) -> None:
+        for b in bufs:
+            await self.write(b)
+
+
+async def test_torn_write_poisons_cleanly_and_keeps_whole_frames():
+    """Fault injection on the coalesced write path: frames flushed before
+    the tear arrive whole; the tear poisons the sender; the receiver gets
+    every fully-flushed frame and then a clean CONNECTION error — no
+    partial frame is ever delivered as data."""
+    from pushcdn_tpu.proto.transport.base import Connection
+    from pushcdn_tpu.proto.transport.memory import _BoundedBuffer, _PipeStream
+
+    a_to_b = _BoundedBuffer(256 * 1024)
+    b_to_a = _BoundedBuffer(256 * 1024)
+    torn = _TornStream(_PipeStream(rx=b_to_a, tx=a_to_b), tear_at_write=3)
+    sender = Connection(torn, label="torn")
+    receiver = Connection(_PipeStream(rx=a_to_b, tx=b_to_a), label="rx")
+
+    payloads = [bytes([i]) * (512 + i) for i in range(40)]
+    # waves with a yield between them: each wave coalesces into its own
+    # flush (write #1, #2, ...) so the tear at write 3 lands mid-stream;
+    # the poison surfaces on a later wave's send
+    with pytest.raises(Error):
+        for wave in range(4):
+            for p in payloads[wave * 10:(wave + 1) * 10]:
+                await sender.send_raw(p)
+            await asyncio.sleep(0.05)
+    # data-before-FIN: everything fully flushed before the tear is still
+    # deliverable; after the prefix the receiver sees the clean error
+    got = []
+    with pytest.raises(Error):
+        async with asyncio.timeout(10):
+            while True:
+                for b in await receiver.recv_raw_many():
+                    got.append(bytes(b.data))
+                    b.release()
+    # every delivered frame is exactly one sent frame, in order (the torn
+    # flush's half-frame must not surface as data)
+    assert 0 < len(got) < len(payloads)
+    assert got == payloads[:len(got)]
+    assert sender.is_closed
+    sender.close()
+    receiver.close()
+
+
+async def test_quic_batched_receive_coalesces_acks():
+    """A burst of in-order datagrams processed in ONE endpoint drain
+    (begin/end_rx_batch) must produce exactly one coalesced ACK covering
+    the lot — not one per datagram — while a drain containing a hole
+    still emits the (capped) duplicate ACKs fast retransmit needs."""
+    from pushcdn_tpu.proto.transport.quic import (
+        _DATA, _OFF, _UdpStream, DUP_ACK_FAST_RETX)
+
+    sent: list[bytes] = []
+    s = _UdpStream(5, sent.append)
+    try:
+        seg = b"d" * 1000
+        # --- in-order burst in one drain: exactly one ACK out ---
+        base_acks = sum(1 for p in sent if p[0] == 4)
+        s.begin_rx_batch()
+        for i in range(16):
+            s.on_packet(_DATA, _OFF.pack(i * 1000) + seg)
+        assert sum(1 for p in sent if p[0] == 4) == base_acks  # deferred
+        s.end_rx_batch()
+        acks = [p for p in sent if p[0] == 4]
+        assert len(acks) == base_acks + 1
+        assert _OFF.unpack_from(acks[-1], 9)[0] == 16 * 1000
+
+        # --- a drain with a hole: dup ACKs preserved, capped ---
+        pre = len([p for p in sent if p[0] == 4])
+        s.begin_rx_batch()
+        for i in range(20, 30):  # offsets past the hole at 16000
+            s.on_packet(_DATA, _OFF.pack(i * 1000) + seg)
+        s.end_rx_batch()
+        dup_acks = [p for p in sent if p[0] == 4][pre:]
+        assert 1 <= len(dup_acks) <= DUP_ACK_FAST_RETX
+        assert all(_OFF.unpack_from(p, 9)[0] == 16 * 1000
+                   for p in dup_acks)
+
+        # --- duplicates of delivered data: one re-ACK per drain ---
+        pre = len([p for p in sent if p[0] == 4])
+        s.begin_rx_batch()
+        for i in range(4):
+            s.on_packet(_DATA, _OFF.pack(i * 1000) + seg)
+        s.end_rx_batch()
+        assert len([p for p in sent if p[0] == 4]) == pre + 1
+    finally:
+        s.abort()
+
+
+async def test_quic_batched_lossy_path_recovers():
+    """Loss recovery through BATCHED drains: the wire delivers packets in
+    endpoint-style batches (begin/end_rx_batch around each group) and
+    drops every 5th datagram; in-order delivery and both directions must
+    still complete — the coalesced-ACK rules preserve the ARQ's recovery
+    dynamics."""
+    from pushcdn_tpu.proto.transport.quic import _UdpStream
+
+    drop = {"a": 0, "b": 0}
+    a = b = None
+    pending: dict = {"a": [], "b": []}
+
+    def wire(key, get_peer):
+        def send(pkt: bytes) -> None:
+            drop[key] += 1
+            if drop[key] % 5 == 0:
+                return
+            pending[key].append(pkt)
+            if len(pending[key]) == 1:
+                asyncio.get_running_loop().call_soon(deliver, key, get_peer)
+        return send
+
+    def deliver(key, get_peer):
+        peer = get_peer()
+        batch, pending[key] = pending[key], []
+        if peer is None or not batch:
+            return
+        peer.begin_rx_batch()
+        try:
+            for pkt in batch:
+                peer.on_packet(pkt[0], pkt[9:])
+        finally:
+            peer.end_rx_batch()
+
+    a = _UdpStream(1, wire("a", lambda: b))
+    b = _UdpStream(1, wire("b", lambda: a))
+    a._prober.cancel()
+    b._prober.cancel()
+    try:
+        payload = bytes(range(256)) * 200  # 51200 B
+        await a.write(payload)
+        got = bytearray()
+        async with asyncio.timeout(30):
+            while len(got) < len(payload):
+                got += await b.read_some(65536)
+        assert bytes(got) == payload
+        await b.write(b"pong" * 1000)
+        back = bytearray()
+        async with asyncio.timeout(30):
+            while len(back) < 4000:
+                back += await a.read_some(65536)
+        assert bytes(back) == b"pong" * 1000
+    finally:
+        a.abort()
+        b.abort()
+
+
+async def test_abandoned_poisoned_connection_returns_permits():
+    """ADVICE r5 backstop: a poisoned connection whose handle is dropped
+    WITHOUT close() must still return its queued frames' pool permits
+    (weakref finalizer) — a crashed handler cannot leak the pool dry."""
+    import gc
+
+    limiter = Limiter(global_pool_bytes=100_000)
+    a, b = await gen_testing_connection_pair(limiter)
+    payload = b"x" * 10_000
+    for _ in range(4):
+        await a.send_message(Direct(recipient=b"", message=payload))
+    # let the frames land in b's receive queue (permits held)
+    await asyncio.sleep(0.2)
+    assert limiter.pool.available < 100_000
+    # poison b (peer abort), then abandon the handle without close()
+    a.close()
+    await asyncio.sleep(0.2)
+    del b
+    for _ in range(3):
+        gc.collect()
+        await asyncio.sleep(0.05)
+    assert limiter.pool.available == 100_000
+
+
 async def test_quic_ack_delay_keeps_rtt_honest():
     """ACKs carry the time the receiver held them (QUIC's ack_delay): a
     timer-delayed ACK must not inflate the sender's RTT estimator, and a
