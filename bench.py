@@ -81,7 +81,21 @@ def main() -> None:
                          "into DIR (view with TensorBoard / xprof) — the "
                          "flamegraph analog of the reference's pprof-in-"
                          "criterion integration")
+    ap.add_argument("--delivery-impl", choices=["auto", "pallas", "jnp"],
+                    default="auto",
+                    help="delivery-matrix implementation: 'pallas' forces "
+                         "the Pallas kernel (interpreter off-TPU), 'jnp' "
+                         "forces the XLA reference — the one-command "
+                         "Pallas-vs-XLA A/B for the moment the TPU tunnel "
+                         "returns; 'auto' (default) picks Pallas on real "
+                         "TPU only")
     args = ap.parse_args()
+
+    # flip the router's module-level switch BEFORE any routing_step jit
+    # trace reads it (trace-time capture, one value per bench process)
+    from pushcdn_tpu.parallel import router as _router
+    _router.USE_PALLAS_DELIVERY = {
+        "auto": None, "pallas": True, "jnp": False}[args.delivery_impl]
 
     # A wedged accelerator tunnel hangs jax init in-process where no
     # timeout can reach it: probe device init + a real transfer in a
@@ -282,6 +296,7 @@ def main() -> None:
         "decision_rate_msgs_s": round(decision_rate, 1),
         "frame_byte_rate_GBps": round(byte_rate / 1e9, 2),
         "device_kind": kind,
+        "delivery_impl": args.delivery_impl,
     }
     if platform_note:
         row["note"] = platform_note

@@ -65,6 +65,12 @@ class Marshal:
         self.listener = await self.run_def.user_def.protocol.bind(
             config.bind_endpoint, certificate=self.certificate)
         if config.metrics_bind_endpoint:
+            # the marshal is the process doing BLS verifications, so it
+            # exports the pk line-table cache counters alongside the core
+            # gauges (the hook only PEEKS at an already-loaded library:
+            # for non-BLS schemes the native lib never loads and the
+            # gauges stay zero — no compile can fire inside /metrics)
+            metrics_mod.register_bls_pk_cache_metrics()
             self._metrics_server = await metrics_mod.serve_metrics(
                 config.metrics_bind_endpoint)
         logger.info("marshal listening on %s", config.bind_endpoint)

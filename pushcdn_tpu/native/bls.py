@@ -85,12 +85,32 @@ def _compile() -> Optional[ctypes.CDLL]:
     lib.bls_sign.argtypes = [u8p, ctypes.c_char_p, ctypes.c_longlong, u8p]
     lib.bls_verify.restype = ctypes.c_int
     lib.bls_verify.argtypes = [u8p, ctypes.c_char_p, ctypes.c_longlong, u8p]
+    lib.bls_verify_cached.restype = ctypes.c_int
+    lib.bls_verify_cached.argtypes = [
+        u8p, ctypes.c_char_p, ctypes.c_longlong, u8p]
     lib.bls_verify_batch.restype = ctypes.c_int
     lib.bls_verify_batch.argtypes = [
         ctypes.c_int, u8p, ctypes.POINTER(ctypes.c_char_p),
         ctypes.POINTER(ctypes.c_longlong), u8p, u8p]
+    lib.bls_verify_batch_cached.restype = ctypes.c_int
+    lib.bls_verify_batch_cached.argtypes = lib.bls_verify_batch.argtypes
+    lib.bls_pk_cache_stats.restype = None
+    lib.bls_pk_cache_stats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+    lib.bls_pk_cache_configure.restype = ctypes.c_int
+    lib.bls_pk_cache_configure.argtypes = [ctypes.c_longlong]
+    lib.bls_pk_cache_clear.restype = None
+    lib.bls_pk_cache_clear.argtypes = []
     lib.bls_self_test.restype = ctypes.c_int
     lib.bls_self_test.argtypes = []
+    # PUSHCDN_BLS_PK_CACHE sizes the per-public-key Miller line-table LRU
+    # (entries; ~17 KB each; 0 disables and the cached entrypoints take
+    # the plain path). Default stays the library's 128 (~2.2 MB bound).
+    env_cap = os.environ.get("PUSHCDN_BLS_PK_CACHE", "").strip()
+    if env_cap:
+        try:
+            lib.bls_pk_cache_configure(int(env_cap))
+        except ValueError:
+            pass
     return lib
 
 
@@ -105,6 +125,13 @@ def _get() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _get() is not None
+
+
+def loaded() -> bool:
+    """True when the library is ALREADY loaded — never triggers the
+    compile. For callers on latency-sensitive paths (the /metrics
+    pre-render hook) that must observe, not provoke, the g++ build."""
+    return _lib is not None
 
 
 def self_test() -> int:
@@ -144,12 +171,18 @@ def sign(sk: bytes, message: bytes) -> bytes:
     return bytes(sig)
 
 
-def verify_batch(items, seed32: bytes) -> bool:
+def verify_batch(items, seed32: bytes, cached: bool = True) -> bool:
     """Batch-verify ``[(pk, message, signature), ...]`` with one shared
     final exponentiation via random linear combination (bls_verify_batch).
     ``seed32`` seeds the per-item 128-bit weights — callers pass fresh
     randomness (os.urandom) so an adversary cannot target the
-    combination. Falls back to False on malformed input."""
+    combination. Falls back to False on malformed input.
+
+    ``cached`` (default) routes through ``bls_verify_batch_cached``: each
+    item's pk-side Miller loop replays that key's line table from the
+    bounded LRU, and every item shares ONE squaring chain with the
+    generator side — same accept/reject semantics, ~2x at batch size 8
+    with warm tables."""
     lib = _get()
     assert lib is not None, "native BLS unavailable"
     assert len(seed32) == 32
@@ -167,7 +200,8 @@ def verify_batch(items, seed32: bytes) -> bool:
         msgs.append(bytes(message))
     msg_arr = (ctypes.c_char_p * n)(*msgs)
     len_arr = (ctypes.c_longlong * n)(*(len(m) for m in msgs))
-    return lib.bls_verify_batch(
+    fn = lib.bls_verify_batch_cached if cached else lib.bls_verify_batch
+    return fn(
         n, _buf(bytes(pks)), msg_arr, len_arr, _buf(bytes(sigs)),
         _buf(seed32)) == 1
 
@@ -179,3 +213,50 @@ def verify(pk: bytes, message: bytes, signature: bytes) -> bool:
         return False
     return lib.bls_verify(_buf(pk), bytes(message), len(message),
                           _buf(signature)) == 1
+
+
+def verify_cached(pk: bytes, message: bytes, signature: bytes) -> bool:
+    """``verify`` through the per-public-key Miller line-table cache: a
+    repeat connector's second and later verifications skip the pk-side
+    Jacobian ladder, the G2 subgroup check, and the pk parse (the LRU key
+    is the exact 128-byte encoding, validated before insert). Identical
+    accept/reject semantics to :func:`verify` for every input —
+    asserted by the in-library self-test including across LRU
+    eviction/repopulation."""
+    lib = _get()
+    assert lib is not None, "native BLS unavailable"
+    if len(pk) != PK_LEN or len(signature) != SIG_LEN:
+        return False
+    return lib.bls_verify_cached(_buf(pk), bytes(message), len(message),
+                                 _buf(signature)) == 1
+
+
+def pk_cache_stats() -> Optional[dict]:
+    """Line-table cache counters, or None when the library is
+    unavailable: hits/misses/evictions since start (or last clear),
+    current entries, capacity, and resident table bytes."""
+    lib = _get()
+    if lib is None:
+        return None
+    out = (ctypes.c_uint64 * 6)()
+    lib.bls_pk_cache_stats(out)
+    return {"hits": int(out[0]), "misses": int(out[1]),
+            "evictions": int(out[2]), "entries": int(out[3]),
+            "capacity": int(out[4]), "bytes": int(out[5])}
+
+
+def pk_cache_configure(capacity: int) -> None:
+    """Resize the line-table LRU (entries, ~17 KB each; 0 disables —
+    cached entrypoints then take the plain uncached path). Shrinking
+    evicts least-recently-used tables immediately."""
+    lib = _get()
+    assert lib is not None, "native BLS unavailable"
+    if lib.bls_pk_cache_configure(int(capacity)) != 0:
+        raise ValueError(f"bad pk cache capacity {capacity!r}")
+
+
+def pk_cache_clear() -> None:
+    """Drop every cached table and zero the counters (test isolation)."""
+    lib = _get()
+    if lib is not None:
+        lib.bls_pk_cache_clear()

@@ -49,6 +49,8 @@ BROKER_AXIS = "brokers"
 
 # None = auto (Pallas on TPU / interpreter elsewhere when shapes align);
 # flip to False to force the jnp reference path (bench comparisons).
+# `bench.py --delivery-impl {auto,pallas,jnp}` sets this before the first
+# routing_step trace — the one-command Pallas-vs-XLA A/B.
 USE_PALLAS_DELIVERY: Optional[bool] = None
 
 

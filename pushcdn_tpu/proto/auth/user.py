@@ -35,14 +35,29 @@ def signable_timestamp(timestamp: int) -> bytes:
     return _TS.pack(timestamp)
 
 
+def presign_timestamp(scheme: Type[SignatureScheme],
+                      keypair: KeyPair) -> Tuple[int, bytes]:
+    """Sign the current timestamp for marshal auth ahead of time — the
+    caller can run this CPU work while the TCP dial is in flight and pass
+    the result to :func:`authenticate_with_marshal`. The ±5 s replay
+    window dwarfs any sane connect time, so signing before the socket
+    exists is safe."""
+    timestamp = int(time.time())
+    return timestamp, scheme.sign(keypair.private_key,
+                                  Namespace.USER_MARSHAL_AUTH,
+                                  signable_timestamp(timestamp))
+
+
 async def authenticate_with_marshal(
         connection: Connection, scheme: Type[SignatureScheme],
-        keypair: KeyPair) -> Tuple[int, str]:
+        keypair: KeyPair,
+        presigned: Tuple[int, bytes] | None = None) -> Tuple[int, str]:
     """Returns ``(permit, broker_public_endpoint)`` or raises
-    ``Error(AUTHENTICATION)``."""
-    timestamp = int(time.time())
-    signature = scheme.sign(keypair.private_key, Namespace.USER_MARSHAL_AUTH,
-                            signable_timestamp(timestamp))
+    ``Error(AUTHENTICATION)``. ``presigned`` is an optional
+    :func:`presign_timestamp` result computed while the dial was in
+    flight (the connect-latency overlap)."""
+    timestamp, signature = (presigned if presigned is not None
+                            else presign_timestamp(scheme, keypair))
     await connection.send_message(AuthenticateWithKey(
         public_key=keypair.public_key, timestamp=timestamp,
         signature=signature), flush=True)

@@ -183,11 +183,18 @@ class BlsBn254Scheme(SignatureScheme):
     @classmethod
     def verify(cls, public_key: bytes, namespace: Namespace,
                message: bytes, signature: bytes) -> bool:
+        """Verification rides the native per-public-key Miller line-table
+        cache (``bls.verify_cached``): a repeat connector — the marshal's
+        reconnect-storm steady state — skips the pk-side pairing ladder
+        and subgroup check after its first verification. Semantics are
+        identical to the uncached path for every input (asserted by the
+        in-library self-test, including across LRU eviction); set
+        ``PUSHCDN_BLS_PK_CACHE=0`` to disable."""
         from pushcdn_tpu.native import bls
         try:
-            return bls.verify(bytes(public_key),
-                              _namespaced(namespace, message),
-                              bytes(signature))
+            return bls.verify_cached(bytes(public_key),
+                                     _namespaced(namespace, message),
+                                     bytes(signature))
         except (AssertionError, TypeError):
             return False
 
@@ -196,7 +203,9 @@ class BlsBn254Scheme(SignatureScheme):
         """Batch-verify ``[(public_key, namespace, message, signature),
         ...]`` with one shared pairing final-exponentiation (random
         linear combination — the connection-storm path). Semantics match
-        verifying each item individually: True iff ALL verify."""
+        verifying each item individually: True iff ALL verify. Per-item
+        pk-side Miller loops replay cached line tables fused on one
+        shared squaring chain (``bls.verify_batch_cached``)."""
         import os as _os
         from pushcdn_tpu.native import bls
         try:

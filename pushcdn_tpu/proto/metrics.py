@@ -118,6 +118,48 @@ def observe_message_latency(seconds: float) -> None:
 # pushing gauge updates from their pump loops.
 PRE_RENDER_HOOKS: list = []
 
+# BLS per-public-key Miller line-table cache (native/bls_bn254.cpp): the
+# auth hot path's amortization state. Gauges (not counters) because the
+# native library owns the monotonic values and a cache clear legitimately
+# zeroes them.
+BLS_PK_CACHE_HITS = Gauge("cdn_bls_pk_cache_hits",
+                          "BLS verify line-table cache hits")
+BLS_PK_CACHE_MISSES = Gauge("cdn_bls_pk_cache_misses",
+                            "BLS verify line-table cache misses")
+BLS_PK_CACHE_EVICTIONS = Gauge("cdn_bls_pk_cache_evictions",
+                               "BLS verify line-table LRU evictions")
+BLS_PK_CACHE_ENTRIES = Gauge("cdn_bls_pk_cache_entries",
+                             "BLS verify line tables currently cached")
+BLS_PK_CACHE_BYTES = Gauge("cdn_bls_pk_cache_bytes",
+                           "Resident bytes of cached BLS line tables")
+
+
+def _refresh_bls_pk_cache() -> None:
+    from pushcdn_tpu.native import bls
+    # peek, never provoke: pk_cache_stats() would lazily COMPILE the
+    # native library (a multi-second synchronous g++ run) and this hook
+    # runs inside the asyncio /metrics handler — a process that never
+    # verified a BLS signature keeps the gauges at zero instead
+    if not bls.loaded():
+        return
+    stats = bls.pk_cache_stats()
+    if stats is None:  # native library unavailable: gauges stay zero
+        return
+    BLS_PK_CACHE_HITS.set(stats["hits"])
+    BLS_PK_CACHE_MISSES.set(stats["misses"])
+    BLS_PK_CACHE_EVICTIONS.set(stats["evictions"])
+    BLS_PK_CACHE_ENTRIES.set(stats["entries"])
+    BLS_PK_CACHE_BYTES.set(stats["bytes"])
+
+
+def register_bls_pk_cache_metrics() -> None:
+    """Idempotent: pull the native cache counters into the gauges on
+    every render. Registered by processes that actually verify BLS
+    signatures (the marshal; brokers via their auth path) — a process
+    that never loads the native library keeps the hook a no-op."""
+    if _refresh_bls_pk_cache not in PRE_RENDER_HOOKS:
+        PRE_RENDER_HOOKS.append(_refresh_bls_pk_cache)
+
 
 _hook_failures: set = set()
 

@@ -373,35 +373,42 @@ class Connection:
         try:
             while True:
                 item = await self._send_q.get()
-                # Adaptive coalesce window: when the PREVIOUS wakeup
-                # coalesced (load regime) and this one would flush a
-                # lone frame, yield one loop tick first — ready producer
-                # tasks enqueue their frames and this flush carries a
-                # batch too. An idle link (previous flush was depth-1)
-                # writes immediately: the latency regime never waits.
-                if self._coalescing and self._send_q.empty():
-                    try:
-                        await asyncio.sleep(0)
-                    except asyncio.CancelledError:
-                        # cancelled in the yield: the dequeued entry is in
-                        # neither the queue nor `batch` — its permits and
-                        # flush future are ours to settle
-                        if item is not _CLOSE:
-                            payload, done = item
-                            if type(payload) is list:
-                                for p in payload:
-                                    if isinstance(p, Bytes):
-                                        p.release()
-                            elif isinstance(payload, Bytes):
-                                payload.release()
-                            if done is not None and not done.done():
-                                done.cancel()
-                        raise
                 # every write section holds the mutex: send_raw's inline
                 # flush fast path writes from the sender's task, and the
-                # two paths must never interleave bytes on the stream
+                # two paths must never interleave bytes on the stream.
+                # The mutex is taken BEFORE the adaptive yield below: a
+                # dequeued-but-unwritten entry with the mutex free would
+                # let a concurrent inline flush write a NEWER frame first
+                # (wire reorder); holding it keeps the inline path out
+                # while producers (who only need the queue) still fill
+                # the coalesce window during the yield.
                 await self._write_mutex.acquire()
                 try:
+                    # Adaptive coalesce window: when the PREVIOUS wakeup
+                    # coalesced (load regime) and this one would flush a
+                    # lone frame, yield one loop tick first — ready
+                    # producer tasks enqueue their frames and this flush
+                    # carries a batch too. An idle link (previous flush
+                    # was depth-1) writes immediately: the latency regime
+                    # never waits.
+                    if self._coalescing and self._send_q.empty():
+                        try:
+                            await asyncio.sleep(0)
+                        except asyncio.CancelledError:
+                            # cancelled in the yield: the dequeued entry
+                            # is in neither the queue nor `batch` — its
+                            # permits and flush future are ours to settle
+                            if item is not _CLOSE:
+                                payload, done = item
+                                if type(payload) is list:
+                                    for p in payload:
+                                        if isinstance(p, Bytes):
+                                            p.release()
+                                elif isinstance(payload, Bytes):
+                                    payload.release()
+                                if done is not None and not done.done():
+                                    done.cancel()
+                            raise
                     closed = await self._writer_item(item, encoder_cell,
                                                      enc_cap, batch)
                 finally:

@@ -484,9 +484,16 @@ class _UdpStream(RawStream):
             # threshold so the sender's dup-ACK clocking sees the same
             # evidence the per-datagram path produced (each OOO datagram
             # used to emit one) without re-ACKing a 64-datagram burst
-            # 64 times.
+            # 64 times. When the same drain ALSO advanced _expected, the
+            # cumulative ACK reads as progress at the sender — not a
+            # duplicate — so it doesn't count toward the threshold and
+            # the full dup count follows it; otherwise the cumulative
+            # ACK itself is the first duplicate.
+            dups = min(self._batch_ooo, DUP_ACK_FAST_RETX)
+            if not self._batch_progress:
+                dups -= 1
             self._flush_ack()
-            for _ in range(min(self._batch_ooo, DUP_ACK_FAST_RETX) - 1):
+            for _ in range(dups):
                 self._tx(_ACK, _OFF.pack(self._expected)
                          + _ACK_DELAY.pack(0))
         elif self._batch_progress:

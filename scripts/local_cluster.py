@@ -34,7 +34,9 @@ def spawn(name: str, *args: str) -> subprocess.Popen:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--duration", type=float, default=20.0)
-    ap.add_argument("--base-port", type=int, default=21700)
+    ap.add_argument("--base-port", type=int, default=21700,
+                    help="0 picks a free contiguous range (CI runs that "
+                         "must not collide with other suites)")
     ap.add_argument("--device-plane", action="store_true",
                     help="brokers route eligible traffic on the attached "
                          "device (single-shard planes)")
@@ -42,6 +44,14 @@ def main() -> int:
 
     db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-cluster-"), "cdn.sqlite")
     bp = args.base_port
+    if bp == 0:
+        # bind one free port and take the following ~100 as the range —
+        # racy in principle, but ephemeral allocations are sparse and the
+        # components fail loudly on a collision
+        import socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            bp = min(s.getsockname()[1], 65000 - 200)
     procs: list[tuple[str, subprocess.Popen]] = []
     try:
         for i in range(2):

@@ -122,6 +122,111 @@ async def test_end_to_end_cluster_on_bls():
         await cluster.stop()
 
 
+def test_cached_verify_matches_plain_cold_and_warm():
+    """The per-pk line-table cache (the marshal's repeat-connector hot
+    path) must be invisible semantically: cold (miss+record), warm
+    (table replay), tampered, wrong-key, and malformed inputs all agree
+    with the uncached pairing loop, and the counters actually move."""
+    bls.pk_cache_clear()
+    kp = BlsBn254Scheme.generate_keypair(seed=700)
+    other = BlsBn254Scheme.generate_keypair(seed=701)
+    ns = Namespace.USER_MARSHAL_AUTH
+    msg = b"repeat connector"
+    from pushcdn_tpu.proto.crypto.signature import _namespaced
+    raw = _namespaced(ns, msg)
+    sig = BlsBn254Scheme.sign(kp.private_key, ns, msg)
+    for _ in range(3):  # miss, then hits
+        assert bls.verify_cached(kp.public_key, raw, sig) \
+            == bls.verify(kp.public_key, raw, sig) is True
+    for bad_pk, bad_raw, bad_sig in [
+            (kp.public_key, raw + b"x", sig),
+            (other.public_key, raw, sig),
+            (kp.public_key, raw, sig[:-1] + bytes([sig[-1] ^ 1])),
+            (b"\xff" * 128, raw, sig),
+            (kp.public_key, raw, b"\x00" * 64)]:
+        assert bls.verify_cached(bad_pk, bad_raw, bad_sig) \
+            == bls.verify(bad_pk, bad_raw, bad_sig) is False
+    stats = bls.pk_cache_stats()
+    assert stats["hits"] >= 2 and stats["misses"] >= 1
+    assert stats["entries"] >= 1
+    # the documented memory bound: ~17 KB per cached table
+    assert stats["bytes"] <= stats["entries"] * 18 * 1024
+
+
+def test_cache_eviction_and_repopulation():
+    """At capacity 2 a third key evicts the least-recently-used table;
+    the evicted key repopulates transparently and still verifies —
+    the Python twin of the in-library evict/repopulate self-test."""
+    saved = bls.pk_cache_stats()["capacity"]
+    bls.pk_cache_clear()
+    bls.pk_cache_configure(2)
+    try:
+        ns = Namespace.USER_MARSHAL_AUTH
+        kps, sigs = [], []
+        for i in range(3):
+            kp = BlsBn254Scheme.generate_keypair(seed=710 + i)
+            kps.append(kp)
+            sigs.append(BlsBn254Scheme.sign(kp.private_key, ns, b"evict"))
+        for kp, sig in zip(kps, sigs):
+            assert BlsBn254Scheme.verify(kp.public_key, ns, b"evict", sig)
+            assert BlsBn254Scheme.verify(kp.public_key, ns, b"evict", sig)
+        stats = bls.pk_cache_stats()
+        assert stats["evictions"] >= 1
+        assert stats["entries"] == 2 == stats["capacity"]
+        # key 0 was evicted; a repopulating verify must still accept,
+        # and a tampered message must still reject through the fresh table
+        assert BlsBn254Scheme.verify(kps[0].public_key, ns, b"evict",
+                                     sigs[0])
+        assert not BlsBn254Scheme.verify(kps[0].public_key, ns, b"evicT",
+                                         sigs[0])
+    finally:
+        bls.pk_cache_clear()
+        bls.pk_cache_configure(saved)
+
+
+def test_cache_disabled_still_verifies():
+    """Capacity 0 = cache off: the cached entrypoints take the plain
+    path (PUSHCDN_BLS_PK_CACHE=0 deployments) with unchanged results."""
+    saved = bls.pk_cache_stats()["capacity"]
+    bls.pk_cache_clear()
+    bls.pk_cache_configure(0)
+    try:
+        ns = Namespace.USER_MARSHAL_AUTH
+        kp = BlsBn254Scheme.generate_keypair(seed=720)
+        sig = BlsBn254Scheme.sign(kp.private_key, ns, b"off")
+        assert BlsBn254Scheme.verify(kp.public_key, ns, b"off", sig)
+        assert not BlsBn254Scheme.verify(kp.public_key, ns, b"ofF", sig)
+        assert BlsBn254Scheme.verify_batch(
+            [(kp.public_key, ns, b"off", sig)] * 2)
+        assert bls.pk_cache_stats()["entries"] == 0  # nothing was cached
+    finally:
+        bls.pk_cache_configure(saved)
+
+
+def test_batch_cached_matches_uncached():
+    """The fused multi-table batch walk agrees with the plain per-item
+    Miller-loop batch on honest and forged inputs, warm or cold."""
+    import os as _os
+    bls.pk_cache_clear()
+    ns = Namespace.USER_MARSHAL_AUTH
+    from pushcdn_tpu.proto.crypto.signature import _namespaced
+    items = []
+    for i in range(5):
+        kp = BlsBn254Scheme.generate_keypair(seed=730 + i)
+        msg = b"fused %d" % i
+        sig = BlsBn254Scheme.sign(kp.private_key, ns, msg)
+        items.append((kp.public_key, _namespaced(ns, msg), sig))
+    seed = _os.urandom(32)
+    for _ in range(2):  # cold tables, then warm
+        assert bls.verify_batch(items, seed, cached=True) \
+            == bls.verify_batch(items, seed, cached=False) is True
+    forged = list(items)
+    forged[2] = (forged[2][0], forged[2][1],
+                 forged[3][2])  # someone else's signature
+    assert bls.verify_batch(forged, seed, cached=True) \
+        == bls.verify_batch(forged, seed, cached=False) is False
+
+
 def test_batch_verify_all_valid():
     ns = Namespace.USER_MARSHAL_AUTH
     items = []

@@ -159,6 +159,99 @@ def test_two_process_multihost_deployment():
         assert f"rank {rank}: MULTIHOST OK" in out, out
 
 
+def test_two_process_stall_and_redeploy():
+    """VERDICT r5 #6, the non-SIGKILL twin of the kill test: one host of a
+    live two-host group PERMANENTLY STALLS (alive, sockets open, heartbeats
+    flowing — a wedged runtime, not a death, so no connection reset ever
+    arrives). The survivor's collective watchdog must fail the group
+    CLOSED in bounded time, host-path service must continue, and a fresh
+    group must redeploy without the stalled host (phase 2). See
+    ``tests/_multihost_stall_worker.py``."""
+    _require_two_process_runtime()
+    import signal
+    import tempfile
+    import time as _time
+
+    tmp = tempfile.mkdtemp(prefix="pushcdn-stall-")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+    db = os.path.join(tmp, "d.sqlite")
+    worker = os.path.join(os.path.dirname(__file__),
+                          "_multihost_stall_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(rank), str(base), db, tmp],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for rank in (0, 1)
+    ]
+    try:
+        # wait for both readiness sentinels (device plane proven live,
+        # rank 1 about to wedge itself)
+        deadline = _time.time() + 240
+        while _time.time() < deadline:
+            if all(os.path.exists(os.path.join(tmp, f"ready-{r}"))
+                   for r in (0, 1)):
+                break
+            for p in procs:
+                if p.poll() is not None:
+                    out, _ = p.communicate()
+                    raise AssertionError(f"worker died pre-stall:\n{out}")
+            _time.sleep(0.2)
+        else:
+            raise AssertionError("workers never reached readiness")
+
+        # rank 1 stalls ITSELF (no signal sent — the stalled process must
+        # stay alive for the whole detection window; that's the scenario)
+        try:
+            out0, _ = procs[0].communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            procs[0].kill()
+            out0, _ = procs[0].communicate(timeout=30)
+            raise AssertionError(
+                f"survivor hung past the watchdog; output:\n{out0}")
+        assert procs[0].returncode == 0, f"survivor failed:\n{out0}"
+        assert "rank 0: STALL OK" in out0, out0
+        # the stalled rank must still be ALIVE (that is the point): it
+        # never exited on its own
+        assert procs[1].poll() is None, \
+            "stalled rank exited by itself — scenario degraded to a death"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+                p.communicate(timeout=30)
+
+    # ---- phase 2: a fresh group redeploys WITHOUT the stalled host -------
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        base2 = s.getsockname()[1]
+    db2 = os.path.join(tmp, "d2.sqlite")
+    worker2 = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+    procs2 = [
+        subprocess.Popen(
+            [sys.executable, worker2, str(rank), str(base2), db2],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for rank in (0, 1)
+    ]
+    outputs = []
+    try:
+        for p in procs2:
+            out, _ = p.communicate(timeout=300)
+            outputs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs2:
+            p.kill()
+        raise
+    for rank, (p, out) in enumerate(zip(procs2, outputs)):
+        assert p.returncode == 0, f"redeploy rank {rank} failed:\n{out}"
+        assert f"rank {rank}: MULTIHOST OK" in out, out
+
+
 def test_two_process_kill_and_redeploy():
     """VERDICT r4 #6: SIGKILL one host of a live two-host group mid-stream.
 
