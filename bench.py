@@ -89,6 +89,15 @@ def main() -> None:
                          "Pallas-vs-XLA A/B for the moment the TPU tunnel "
                          "returns; 'auto' (default) picks Pallas on real "
                          "TPU only")
+    ap.add_argument("--route-impl", choices=["auto", "native", "python"],
+                    default="auto",
+                    help="routing plane for the host_route_msgs_s "
+                         "companion row (decoded broker forwarding): "
+                         "'native' = the cut-through route-plan kernel, "
+                         "'python' = the scalar receive loops — the "
+                         "--delivery-impl analog for the broker data "
+                         "plane (benches/route_bench.py runs the full "
+                         "native-vs-python A/B)")
     args = ap.parse_args()
 
     # flip the router's module-level switch BEFORE any routing_step jit
@@ -265,6 +274,23 @@ def main() -> None:
     except Exception:
         pass
 
+    # companion host row: decoded broker-forwarding through the routing
+    # plane selected by --route-impl (same measurement loop as the
+    # route_bench/configs_bench rows, pushcdn_tpu.testing.routebench;
+    # None = native requested but kernel unavailable — row omitted,
+    # never mislabeled)
+    route_rate = None
+    try:
+        import asyncio as _asyncio
+
+        from pushcdn_tpu.testing.routebench import forward_rate
+        _res = _asyncio.run(forward_rate(args.route_impl, msgs=2_000,
+                                         trials=3))
+        if _res is not None:
+            route_rate = _res["median"]
+    except Exception:
+        pass
+
     msgs_per_sec = K * S / best_bytes               # headline: byte-true
     decision_rate = K * S / best_decision
     byte_rate = K * S * F / best_bytes              # delivered bytes in cone
@@ -297,6 +323,7 @@ def main() -> None:
         "frame_byte_rate_GBps": round(byte_rate / 1e9, 2),
         "device_kind": kind,
         "delivery_impl": args.delivery_impl,
+        "route_impl": args.route_impl,
     }
     if platform_note:
         row["note"] = platform_note
@@ -306,6 +333,8 @@ def main() -> None:
         row["hbm_frac_of_spec"] = round(byte_rate / (spec * 1e9), 4)
     if egress_rate is not None:
         row["host_egress_msgs_s"] = round(egress_rate, 1)
+    if route_rate is not None:
+        row["host_route_msgs_s"] = round(route_rate, 1)
     print(json.dumps(row))
 
 

@@ -422,6 +422,30 @@ async def bench_eight_broker_device_mesh(msgs: int, tput_msgs: int):
         await cluster.stop()
 
 
+async def bench_route_cutthrough(msgs: int):
+    """Single-broker decoded-forwarding headline with the cut-through
+    plane A/B (ISSUE 3): one publisher fanning 512 B broadcasts to 8
+    subscribers through a real injected broker, counted at the receivers'
+    drain — the SAME measurement loop ``benches/route_bench.py`` runs in
+    depth (shared in ``pushcdn_tpu.testing.routebench``). One row per
+    implementation so the headline tracks the cut-through flag; a host
+    without the native kernel emits a skipped row, never a mislabeled
+    scalar-vs-scalar 'A/B'."""
+    from pushcdn_tpu.testing.routebench import forward_rate
+
+    for impl in ("native", "python"):
+        res = await forward_rate(impl, receivers=8, msgs=msgs, trials=3)
+        if res is None:
+            emit("configs1/route_cutthrough", 0, "skipped", impl=impl,
+                 reason="native route-plan kernel unavailable")
+            continue
+        emit("configs1/route_cutthrough", res["median"], "msgs/s",
+             impl=impl, receivers=8, msgs=res["msgs"],
+             payload=res["payload"],
+             delivered_msgs_s=round(res["delivered"], 1),
+             trials=[round(r, 1) for r in res["trials"]])
+
+
 async def amain(quick: bool):
     from pushcdn_tpu.bin.common import tune_gc
     tune_gc()  # the binaries' server GC tuning; see bin/common.py
@@ -433,6 +457,7 @@ async def amain(quick: bool):
     # anything else importing this module must keep the 8 KiB parity.
     prev_window = Memory.set_duplex_window(256 * 1024)
     try:
+        await bench_route_cutthrough(msgs=2_000 if quick else 10_000)
         await bench_two_broker_fanout(msgs=100 if quick else 500)
         await bench_topic_pubsub(per_topic=16 if quick else 64,
                                  rounds=20 if quick else 100)

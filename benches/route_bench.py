@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""Broker routing hot-path bench: decoded broker-forwarding, scalar vs
+cut-through (ISSUE 3 tentpole; the 326K msgs/s round-5 floor is the
+scalar decoded-forwarding number this targets at ≥2x).
+
+Three tiers, each one JSON line per implementation (medians of repeated
+trials, all trials disclosed — the deployment core is shared, so single
+samples lie):
+
+- ``route/plan``: the decode+route+egress-build core, no wire. scalar =
+  per-frame ``deserialize`` → prune → interest query → ``EgressBatch``
+  clone-appends (exactly the receive loops' per-frame work); native = one
+  ``route_plan`` kernel call per chunk + numpy per-peer grouping + the
+  zero-copy/gather egress build. This is the kernel's honest A/B.
+- ``route/forward``: end-to-end broker forwarding — a real injected
+  broker (test harness, Memory transport), one sender fanning Broadcast
+  chunks to N subscribed receivers, counted at the receivers' transport
+  drain. Includes wire + writer + receiver cost, so the ratio is smaller
+  than route/plan's.
+- ``route/ratio``: native/python summary per tier.
+
+Usage: python benches/route_bench.py [--quick] [--route-impl auto|native|python]
+(--route-impl restricts which implementations run; default both.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import gc
+import json
+import os
+import statistics
+import sys
+import time
+from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+RESULTS: list[dict] = []
+
+
+def emit(name: str, value: float, unit: str, **extra) -> None:
+    row = {"bench": name, "value": round(value, 1), "unit": unit, **extra}
+    RESULTS.append(row)
+    print(json.dumps(row), flush=True)
+
+
+def _build_chunk(n_frames: int, payload: int, n_topics: int,
+                 direct_every: int, seed: int = 7):
+    """One FrameChunk-shaped batch: length-delimited buffer + offs/lens.
+    Mostly Broadcasts across ``n_topics`` topics, every ``direct_every``-th
+    frame a Direct to a known local user."""
+    from pushcdn_tpu.proto.message import Broadcast, Direct, serialize
+    rng = np.random.default_rng(seed)
+    body = bytes(rng.integers(0, 256, payload, dtype=np.uint8))
+    frames = []
+    for i in range(n_frames):
+        if direct_every and i % direct_every == direct_every - 1:
+            frames.append(serialize(Direct(b"user-1", body)))
+        else:
+            frames.append(serialize(Broadcast([int(i) % n_topics], body)))
+    buf = bytearray()
+    offs, lens = [], []
+    for f in frames:
+        offs.append(len(buf) + 4)
+        lens.append(len(f))
+        buf += len(f).to_bytes(4, "big") + f
+    return bytes(buf), offs, lens
+
+
+# ---------------------------------------------------------------------------
+# tier 1: decode+route+egress-build, no wire (the kernel A/B)
+# ---------------------------------------------------------------------------
+
+async def bench_plan(impls, n_users: int, n_frames: int, trials: int) -> dict:
+    from pushcdn_tpu.broker.tasks import cutthrough
+    from pushcdn_tpu.broker.tasks.handlers import (
+        EgressBatch, route_broadcast, route_direct)
+    from pushcdn_tpu.broker.tasks.senders import pre_encode_frames
+    from pushcdn_tpu.broker.test_harness import TestDefinition
+    from pushcdn_tpu.proto.def_ import no_hook
+    from pushcdn_tpu.proto.limiter import Bytes
+    from pushcdn_tpu.proto.message import Broadcast, Direct, deserialize
+
+    # 8 subscribers on topic 0 (the fan-out set), the rest parked on the
+    # other TEST topic (realistic table size, not hit by the traffic); a
+    # peer broker subscribed to topic 0 and owning one remote direct user
+    run = await TestDefinition(
+        connected_users=[[0]] * 8 + [[1]] * (n_users - 8),
+        connected_brokers=[([0], [b"remote-user"])],
+    ).run()
+    medians: dict = {}
+    try:
+        broker = run.broker
+        buf, offs, lens = _build_chunk(n_frames, payload=256, n_topics=1,
+                                       direct_every=8)
+        results = {}
+
+        if "python" in impls:
+            hook = no_hook
+            topics = broker.run_def.topics
+            rates = []
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                egress = EgressBatch(broker)
+                interest_cache: dict = {}
+                for o, ln in zip(offs, lens):
+                    raw = Bytes(buf[o:o + ln])
+                    message = deserialize(raw.data)
+                    if hook(b"user-0", message):
+                        pass
+                    if isinstance(message, Direct):
+                        route_direct(broker, message.recipient, raw,
+                                     to_user_only=False, egress=egress)
+                    elif isinstance(message, Broadcast):
+                        pruned, _bad = topics.prune(message.topics)
+                        if pruned:
+                            route_broadcast(broker, pruned, raw,
+                                            to_users_only=False,
+                                            egress=egress,
+                                            interest_cache=interest_cache)
+                    raw.release()
+                # egress-build: the flush's per-peer pre-encode (the copy
+                # the scalar path pays before the writer), wire excluded
+                for frames_l in list(egress.users.values()) \
+                        + list(egress.brokers.values()):
+                    if len(frames_l) >= 2:
+                        pre_encode_frames(frames_l)
+                    for f in frames_l:
+                        f.release()
+                egress.users.clear()
+                egress.brokers.clear()
+                rates.append(n_frames / (time.perf_counter() - t0))
+            results["python"] = rates
+
+        if "native" in impls:
+            planner = None
+            state = cutthrough.acquire(broker, no_hook)
+            if state is not None and state._refresh():
+                planner = state.planner
+            if planner is None:
+                emit("route/plan", 0, "skipped", impl="native",
+                     reason="native route-plan kernel unavailable")
+            else:
+                offs_np = np.asarray(offs, np.int64)
+                lens_np = np.asarray(lens, np.int64)
+                rates = []
+                for _ in range(trials):
+                    t0 = time.perf_counter()
+                    pos, n = 0, len(offs)
+                    built = 0
+                    while pos < n:
+                        consumed, stop, peers, frames = planner.plan(
+                            buf, offs_np, lens_np, pos, 0)
+                        # per-peer grouping + egress-build (the same numpy
+                        # path _send_plan runs, minus the writer enqueue)
+                        if len(peers):
+                            order = np.argsort(peers, kind="stable")
+                            speers = peers[order]
+                            sframes = frames[order]
+                            bounds = np.nonzero(np.diff(speers))[0] + 1
+                            starts = np.concatenate(([0], bounds))
+                            ends = np.concatenate((bounds, [len(speers)]))
+                            mv = memoryview(buf)
+                            for s, e in zip(starts.tolist(), ends.tolist()):
+                                idx = sframes[s:e]
+                                first, last = int(idx[0]), int(idx[-1])
+                                if last - first + 1 == len(idx):
+                                    built += len(
+                                        mv[int(offs_np[first]) - 4:
+                                           int(offs_np[last])
+                                           + int(lens_np[last])])
+                                else:
+                                    built += len(planner.gather(
+                                        buf, offs_np, lens_np, idx))
+                        pos += consumed
+                        if stop == 1:  # residual (none in this mix)
+                            pos += 1
+                    rates.append(n_frames / (time.perf_counter() - t0))
+                results["native"] = rates
+
+        for impl, rates in results.items():
+            med = statistics.median(rates)
+            medians[impl] = med
+            emit("route/plan", med, "msgs/s", impl=impl,
+                 frames=n_frames, users=n_users, payload=256,
+                 trials=[round(r, 1) for r in rates],
+                 max=round(max(rates), 1))
+    finally:
+        await run.shutdown()
+    return medians
+
+
+# ---------------------------------------------------------------------------
+# tier 2: end-to-end broker forwarding through the wire
+# ---------------------------------------------------------------------------
+
+async def bench_forward(impl: str, receivers: int, msgs: int,
+                        trials: int) -> Optional[float]:
+    # the measurement loop lives in pushcdn_tpu.testing.routebench so the
+    # configs_bench headline row and bench.py's companion host row track
+    # the SAME loop (no drifting copies)
+    from pushcdn_tpu.testing.routebench import forward_rate
+    res = await forward_rate(impl, receivers=receivers, msgs=msgs,
+                             trials=trials)
+    if res is None:
+        emit("route/forward", 0, "skipped", impl=impl,
+             reason="native route-plan kernel unavailable")
+        return None
+    emit("route/forward", res["median"], "msgs/s", impl=impl,
+         receivers=receivers, msgs=res["msgs"], payload=res["payload"],
+         delivered_msgs_s=round(res["delivered"], 1),
+         trials=[round(r, 1) for r in res["trials"]],
+         max=round(max(res["trials"]), 1))
+    return res["median"]
+
+
+async def amain(quick: bool, impl_arg: str) -> None:
+    from pushcdn_tpu.bin.common import tune_gc
+    tune_gc()
+    impls = ("native", "python") if impl_arg == "auto" else (impl_arg,)
+
+    plan_medians = await bench_plan(
+        impls, n_users=64, n_frames=2048 if quick else 8192,
+        trials=3 if quick else 5)
+    if "native" in plan_medians and "python" in plan_medians \
+            and plan_medians["python"]:
+        emit("route/ratio", plan_medians["native"] / plan_medians["python"],
+             "x", tier="plan")
+
+    fwd: dict = {}
+    for impl in impls:
+        fwd[impl] = await bench_forward(
+            impl, receivers=8, msgs=2_000 if quick else 10_000,
+            trials=2 if quick else 3)
+        gc.collect()
+    if fwd.get("native") and fwd.get("python"):
+        emit("route/ratio", fwd["native"] / fwd["python"], "x",
+             tier="forward")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--route-impl", choices=["auto", "native", "python"],
+                    default="auto",
+                    help="which routing implementation(s) to bench; "
+                         "'auto' runs the native-vs-python A/B")
+    args = ap.parse_args()
+    asyncio.run(amain(args.quick, args.route_impl))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,316 @@
+// Batch route-plan kernel: the broker data-plane's cut-through core.
+//
+// PR 1 committed the finding that the broker's forwarding floor is
+// per-message Python, not the wire: transports deliver whole FrameChunk
+// batches and egress is vectorized, but the receive loops still peeled one
+// frame at a time (deserialize -> hook -> route_*), materializing a Python
+// message object per frame. This translation unit removes that: ONE call
+// scans a chunk's frame headers in place (kind tag, topic words, dest key,
+// length/offset), matches Broadcast topic bitmasks against a snapshot of
+// the broker's interest table and Direct dest keys against a DirectMap
+// hash snapshot, and returns a flat (peer, frame) fan-out pair list. The
+// caller groups pairs per peer (stable sort keeps per-(sender->receiver)
+// frame order identical to the scalar path) and hands the chunk's byte
+// ranges straight to egress — payload bytes never become Python objects.
+//
+// Control frames (Subscribe/Sync/auth/malformed) STOP the plan at their
+// index: the scalar path applies them (they mutate routing state, which
+// invalidates this snapshot), then planning resumes. This is what keeps
+// batch-vs-scalar semantics identical for mixes like
+// [Subscribe(t), Broadcast(t)] arriving in one chunk.
+//
+// Same discipline as the reference's "deserialize once per hop, forward
+// raw bytes" rule (cdn-broker handler.rs hot path); plain C ABI for
+// ctypes like framing.cpp (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+namespace {
+
+constexpr uint8_t KIND_DIRECT = 4;
+constexpr uint8_t KIND_BROADCAST = 5;
+
+constexpr int MASK_WORDS = 4;  // 4 x u64 = the full u8 topic space
+
+struct DirectSlot {
+  uint64_t hash;     // 0 = empty (hash is forced non-zero)
+  int64_t key_off;   // into keys blob
+  int32_t key_len;
+  int32_t peer;      // user peer index, or >= n_users for a broker peer
+};
+
+struct RouteTable {
+  int32_t n_users = 0;
+  int32_t n_brokers = 0;
+  uint64_t valid_mask[MASK_WORDS] = {0, 0, 0, 0};
+
+  // inverted interest index: topic t -> peer indices subscribed to t
+  // (users and brokers in one space: users [0, n_users), brokers
+  // [n_users, n_users + n_brokers))
+  int32_t* topic_offsets = nullptr;  // [257] CSR starts
+  int32_t* topic_peers = nullptr;    // flattened peer lists
+
+  // DirectMap snapshot: open-addressed hash of recipient key -> peer
+  DirectSlot* dmap = nullptr;
+  uint64_t dmap_mask = 0;  // table size - 1 (power of two)
+  uint8_t* keys_blob = nullptr;
+  int64_t keys_blob_len = 0;
+
+  // per-frame dedupe stamps for broadcast fan-out (u64: a u32 would wrap
+  // within hours at sustained multi-M frames/s on a stable deployment
+  // that never rebuilds, and a wrapped stamp silently skips a peer)
+  uint64_t* stamp = nullptr;
+  uint64_t stamp_cur = 0;
+};
+
+uint64_t fnv1a(const uint8_t* data, int32_t len) {
+  uint64_t h = 1469598103934665603ull;
+  for (int32_t i = 0; i < len; ++i) {
+    h ^= data[i];
+    h *= 1099511628211ull;
+  }
+  return h ? h : 1ull;  // 0 is the empty-slot marker
+}
+
+void free_table_storage(RouteTable* t) {
+  std::free(t->topic_offsets);
+  std::free(t->topic_peers);
+  std::free(t->dmap);
+  std::free(t->keys_blob);
+  std::free(t->stamp);
+  t->topic_offsets = nullptr;
+  t->topic_peers = nullptr;
+  t->dmap = nullptr;
+  t->keys_blob = nullptr;
+  t->stamp = nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pushcdn_route_table_create() {
+  return new (std::nothrow) RouteTable();
+}
+
+void pushcdn_route_table_destroy(void* handle) {
+  RouteTable* t = (RouteTable*)handle;
+  if (t == nullptr) return;
+  free_table_storage(t);
+  delete t;
+}
+
+// (Re)build the routing snapshot.
+//   peer_masks:  [ (n_users + n_brokers) * 4 ] u64 interest bitmasks
+//   valid_mask:  [4] u64 — the deployment's valid-topic set
+//   dkeys_blob / dkey_offs / dkey_lens / dkey_owner: DirectMap entries
+//     whose owner resolves to a CONNECTED peer (local user -> that user's
+//     peer index; remote owner -> its broker peer index). Unresolvable
+//     owners are omitted by the caller — a plan miss is a drop, exactly
+//     like the scalar flush finding no connection.
+// Returns 0 on success, -1 on allocation failure (table left empty; the
+// caller must fall back to the scalar path).
+int32_t pushcdn_route_table_build(
+    void* handle, int32_t n_users, int32_t n_brokers,
+    const uint64_t* valid_mask, const uint64_t* peer_masks,
+    const uint8_t* dkeys_blob, const int64_t* dkey_offs,
+    const int32_t* dkey_lens, const int32_t* dkey_owner, int32_t n_dkeys) {
+  RouteTable* t = (RouteTable*)handle;
+  if (t == nullptr || n_users < 0 || n_brokers < 0 || n_dkeys < 0) return -1;
+  free_table_storage(t);
+  t->n_users = n_users;
+  t->n_brokers = n_brokers;
+  t->stamp_cur = 0;
+  std::memcpy(t->valid_mask, valid_mask, sizeof(t->valid_mask));
+  const int64_t n_peers = (int64_t)n_users + n_brokers;
+
+  // inverted index: two passes over the peer masks
+  t->topic_offsets = (int32_t*)std::calloc(257, sizeof(int32_t));
+  if (t->topic_offsets == nullptr) return -1;
+  int64_t total = 0;
+  for (int64_t p = 0; p < n_peers; ++p) {
+    const uint64_t* m = peer_masks + p * MASK_WORDS;
+    for (int w = 0; w < MASK_WORDS; ++w)
+      for (uint64_t bits = m[w]; bits; bits &= bits - 1) {
+        ++t->topic_offsets[w * 64 + __builtin_ctzll(bits) + 1];
+        ++total;
+      }
+  }
+  for (int tt = 0; tt < 256; ++tt)
+    t->topic_offsets[tt + 1] += t->topic_offsets[tt];
+  t->topic_peers = (int32_t*)std::malloc(
+      (total ? total : 1) * sizeof(int32_t));
+  if (t->topic_peers == nullptr) { free_table_storage(t); return -1; }
+  int32_t* cursor = (int32_t*)std::calloc(256, sizeof(int32_t));
+  if (cursor == nullptr) { free_table_storage(t); return -1; }
+  for (int64_t p = 0; p < n_peers; ++p) {
+    const uint64_t* m = peer_masks + p * MASK_WORDS;
+    for (int w = 0; w < MASK_WORDS; ++w)
+      for (uint64_t bits = m[w]; bits; bits &= bits - 1) {
+        const int tt = w * 64 + __builtin_ctzll(bits);
+        t->topic_peers[t->topic_offsets[tt] + cursor[tt]++] = (int32_t)p;
+      }
+  }
+  std::free(cursor);
+
+  // direct-map hash (open addressing, power-of-two, 2x load headroom)
+  uint64_t cap = 16;
+  while (cap < (uint64_t)n_dkeys * 2 + 1) cap <<= 1;
+  t->dmap = (DirectSlot*)std::calloc(cap, sizeof(DirectSlot));
+  if (t->dmap == nullptr) { free_table_storage(t); return -1; }
+  t->dmap_mask = cap - 1;
+  int64_t blob_len = 0;
+  for (int32_t i = 0; i < n_dkeys; ++i) blob_len += dkey_lens[i];
+  t->keys_blob = (uint8_t*)std::malloc(blob_len ? blob_len : 1);
+  if (t->keys_blob == nullptr) { free_table_storage(t); return -1; }
+  t->keys_blob_len = blob_len;
+  int64_t pos = 0;
+  for (int32_t i = 0; i < n_dkeys; ++i) {
+    const uint8_t* key = dkeys_blob + dkey_offs[i];
+    const int32_t klen = dkey_lens[i];
+    std::memcpy(t->keys_blob + pos, key, (size_t)klen);
+    const uint64_t h = fnv1a(key, klen);
+    uint64_t slot = h & t->dmap_mask;
+    while (t->dmap[slot].hash != 0) {
+      DirectSlot& s = t->dmap[slot];
+      if (s.hash == h && s.key_len == klen &&
+          std::memcmp(t->keys_blob + s.key_off, key, (size_t)klen) == 0) {
+        break;  // duplicate key: last entry wins (caller emits each once)
+      }
+      slot = (slot + 1) & t->dmap_mask;
+    }
+    DirectSlot& s = t->dmap[slot];
+    s.hash = h;
+    s.key_off = pos;
+    s.key_len = klen;
+    s.peer = dkey_owner[i];
+    pos += klen;
+  }
+
+  t->stamp = (uint64_t*)std::calloc(n_peers ? n_peers : 1, sizeof(uint64_t));
+  if (t->stamp == nullptr) { free_table_storage(t); return -1; }
+  return 0;
+}
+
+// Plan frames [start, start+count) of one chunk.
+//   mode 0: user-origin  (Direct forwards anywhere; Broadcast reaches
+//           interested users AND brokers) — handler.rs user path
+//   mode 1: broker-origin (Direct to OUR user only; Broadcast to local
+//           users only — loop prevention) — handler.rs broker path
+// Emits (peer, frame-index) pairs in frame order. Stops at the first
+// frame that is not a well-formed Direct/Broadcast (*stop_reason = 1:
+// the scalar path owns it) or when the pair buffer cannot be guaranteed
+// to hold the next frame's worst-case fan-out (*stop_reason = 2: call
+// again from the returned index). *stop_reason = 0 means the whole range
+// was planned. Returns the number of frames consumed, or -1 on bad args.
+int64_t pushcdn_route_plan(
+    void* handle, const uint8_t* buf, int64_t buf_len,
+    const int64_t* offs, const int64_t* lens, int64_t start, int64_t count,
+    int32_t mode, int32_t* out_peer, int32_t* out_frame, int64_t pair_cap,
+    int64_t* n_pairs, int32_t* stop_reason) {
+  RouteTable* t = (RouteTable*)handle;
+  *n_pairs = 0;
+  *stop_reason = 0;
+  if (t == nullptr || start < 0 || count < 0) return -1;
+  const int64_t n_peers = (int64_t)t->n_users + t->n_brokers;
+  int64_t pairs = 0;
+  int64_t i = start;
+  const int64_t end = start + count;
+  for (; i < end; ++i) {
+    const int64_t o = offs[i];
+    const int64_t n = lens[i];
+    if (o < 0 || n < 1 || o + n > buf_len) { *stop_reason = 1; break; }
+    if (pair_cap - pairs < n_peers) { *stop_reason = 2; break; }
+    const uint8_t kind = buf[o];
+    if (kind == KIND_BROADCAST && n >= 3) {
+      const int64_t nt = (int64_t)buf[o + 1] | ((int64_t)buf[o + 2] << 8);
+      if (3 + nt > n) { *stop_reason = 1; break; }  // malformed: scalar
+      uint64_t mask[MASK_WORDS] = {0, 0, 0, 0};
+      for (int64_t k = 0; k < nt; ++k) {
+        const uint8_t topic = buf[o + 3 + k];
+        mask[topic >> 6] |= 1ull << (topic & 63);
+      }
+      bool any = false;
+      for (int w = 0; w < MASK_WORDS; ++w) {
+        mask[w] &= t->valid_mask[w];
+        any |= mask[w] != 0;
+      }
+      if (!any) continue;  // pruned empty: drop (scalar parity)
+      const uint64_t st = ++t->stamp_cur;
+      for (int w = 0; w < MASK_WORDS; ++w)
+        for (uint64_t bits = mask[w]; bits; bits &= bits - 1) {
+          const int tt = w * 64 + __builtin_ctzll(bits);
+          const int32_t lo = t->topic_offsets[tt];
+          const int32_t hi = t->topic_offsets[tt + 1];
+          for (int32_t k = lo; k < hi; ++k) {
+            const int32_t peer = t->topic_peers[k];
+            if (mode == 1 && peer >= t->n_users) continue;  // users only
+            if (t->stamp[peer] == st) continue;  // already gets this frame
+            t->stamp[peer] = st;
+            out_peer[pairs] = peer;
+            out_frame[pairs] = (int32_t)i;
+            ++pairs;
+          }
+        }
+    } else if (kind == KIND_DIRECT && n >= 5) {
+      const int64_t rlen = (int64_t)buf[o + 1] | ((int64_t)buf[o + 2] << 8) |
+                           ((int64_t)buf[o + 3] << 16) |
+                           ((int64_t)buf[o + 4] << 24);
+      if (5 + rlen > n) { *stop_reason = 1; break; }  // malformed: scalar
+      const uint8_t* key = buf + o + 5;
+      const uint64_t h = fnv1a(key, (int32_t)rlen);
+      uint64_t slot = h & t->dmap_mask;
+      int32_t peer = -1;
+      while (t->dmap[slot].hash != 0) {
+        const DirectSlot& s = t->dmap[slot];
+        if (s.hash == h && s.key_len == (int32_t)rlen &&
+            std::memcmp(t->keys_blob + s.key_off, key, (size_t)rlen) == 0) {
+          peer = s.peer;
+          break;
+        }
+        slot = (slot + 1) & t->dmap_mask;
+      }
+      if (peer < 0) continue;  // unknown recipient: drop
+      if (mode == 1 && peer >= t->n_users) continue;  // to_user_only
+      out_peer[pairs] = peer;
+      out_frame[pairs] = (int32_t)i;
+      ++pairs;
+    } else {
+      // control kind, short frame, or unknown tag: the scalar path owns
+      // this frame (and everything after it until the caller re-plans)
+      *stop_reason = 1;
+      break;
+    }
+  }
+  *n_pairs = pairs;
+  return i - start;
+}
+
+// Gather a peer's fan-out into one wire-ready buffer: for each listed
+// frame, write [u32 BE length][payload] — byte-identical to the transport
+// framing the chunk arrived with. Returns bytes written, or -1 when `out`
+// is too small / an index is out of range.
+int64_t pushcdn_route_gather(
+    const uint8_t* buf, int64_t buf_len, const int64_t* offs,
+    const int64_t* lens, const int32_t* frame_idx, int64_t n_idx,
+    uint8_t* out, int64_t out_cap) {
+  int64_t pos = 0;
+  for (int64_t k = 0; k < n_idx; ++k) {
+    const int64_t i = frame_idx[k];
+    const int64_t o = offs[i];
+    const int64_t n = lens[i];
+    if (o < 0 || n < 0 || o + n > buf_len || pos + 4 + n > out_cap) return -1;
+    out[pos] = (uint8_t)(n >> 24);
+    out[pos + 1] = (uint8_t)(n >> 16);
+    out[pos + 2] = (uint8_t)(n >> 8);
+    out[pos + 3] = (uint8_t)n;
+    std::memcpy(out + pos + 4, buf + o, (size_t)n);
+    pos += 4 + n;
+  }
+  return pos;
+}
+
+}  // extern "C"

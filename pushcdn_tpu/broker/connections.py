@@ -269,6 +269,13 @@ class Connections:
         double-connect kick across brokers (connections/mod.rs:154-162)."""
         incoming = VersionedMap.deserialize_entries(payload)
         changed = self.direct_map.merge(incoming)
+        if changed:
+            # DirectMap mutations change Direct-routing answers: bump the
+            # version so route snapshots (cut-through plan tables, batch
+            # interest caches) can't serve a pre-merge owner. The scalar
+            # interest caches key only on topic queries, which a DirectMap
+            # merge can't affect, so the extra bump is conservative there.
+            self.interest_version += 1
         evict: List[UserPublicKey] = []
         for key, _old, new in changed:
             if new is not None and new != self.identity and key in self.users:

@@ -1,0 +1,508 @@
+"""Cut-through routing plane: whole-``FrameChunk`` batches from socket to
+egress without per-frame Python objects.
+
+PR 1's syscall attribution pinned the broker's forwarding floor on
+per-message Python: the transports deliver parse batches (``FrameChunk``)
+and egress is vectorized, but ``user_receive_loop``/``broker_receive_loop``
+still peeled one frame at a time — recv → ``deserialize`` → hook →
+``route_*`` — materializing a message object per frame before
+``EgressBatch`` re-batched on the way out. This module closes that gap:
+
+- a **route-plan kernel** (native/route_plan.cpp via
+  ``pushcdn_tpu.native.routeplan``) scans a chunk's frame headers in place
+  and matches them against a snapshot of the broker's routing state
+  (interest bitmasks + DirectMap hash), returning per-peer fan-out index
+  lists;
+- the egress handoff is (buffer, offset, length) **slices of the pooled
+  chunk**: a peer receiving a contiguous run of frames gets a zero-copy
+  ``memoryview`` of the chunk (its wire framing is byte-identical to what
+  arrived), with the chunk's pool permit transferred batch-wise via
+  :class:`pushcdn_tpu.proto.limiter.BytesLease`; non-contiguous fan-out
+  gathers with one C call into one owned buffer;
+- **control frames** (Subscribe/Sync/auth/malformed) stop the plan at
+  their index and take the existing scalar semantics, then planning
+  resumes against the (possibly rebuilt) snapshot — so batch-vs-scalar
+  behavior is identical even for mixes like ``[Subscribe(t),
+  Broadcast(t)]`` landing in one chunk.
+
+The scalar loops in ``handlers.py`` remain the correctness twin. Selection:
+``PUSHCDN_ROUTE_CUTTHROUGH`` env (``auto``/``native``/``python``, with
+``1``/``0`` aliases) or the ``--route-impl`` bench flag set
+:data:`ROUTE_IMPL`; ``auto`` engages the native plane when the library
+compiles AND the connection is eligible (no device plane — staged traffic
+already routes in batched jitted steps — and the default no-op message
+hook; a real hook must see every message, so those deployments stay
+scalar). Observability: ``cdn_route_batch_*`` counters via ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import TYPE_CHECKING, List, Optional
+
+import numpy as np
+
+from pushcdn_tpu.broker.tasks.handlers import (
+    EgressBatch,
+    route_broadcast,
+    route_direct,
+)
+from pushcdn_tpu.native import routeplan
+from pushcdn_tpu.proto import metrics as metrics_mod
+from pushcdn_tpu.proto.def_ import no_hook
+from pushcdn_tpu.proto.error import Error
+from pushcdn_tpu.proto.limiter import Bytes
+from pushcdn_tpu.proto.message import (
+    Broadcast,
+    Direct,
+    Subscribe,
+    TopicSync,
+    Unsubscribe,
+    UserSync,
+    deserialize,
+)
+from pushcdn_tpu.proto.transport.base import FrameChunk
+from pushcdn_tpu.proto.util import mnemonic
+
+if TYPE_CHECKING:
+    from pushcdn_tpu.broker.broker import Broker
+
+logger = logging.getLogger("pushcdn.broker")
+
+# Routing implementation selector: "auto" (native cut-through when
+# available and eligible), "native" (insist; still degrades with a
+# warning if the library can't compile), "python" (scalar loops only).
+# Mirrors the --delivery-impl precedent in bench.py.
+_env = os.environ.get("PUSHCDN_ROUTE_CUTTHROUGH", "auto").strip().lower()
+ROUTE_IMPL = {"1": "native", "0": "python", "true": "native",
+              "false": "python", "": "auto"}.get(_env, _env)
+
+_MODE_USER = 0    # user-origin: Direct anywhere, Broadcast users+brokers
+_MODE_BROKER = 1  # broker-origin: local users only (loop prevention)
+
+# Rebuild churn guard: a snapshot rebuild is O(users + brokers + DirectMap
+# entries). When the previous snapshot amortized over fewer than
+# _REBUILD_MIN_FRAMES planned frames (a client interleaving control frames
+# with traffic, gossip-heavy DirectMap churn), the next _REBUILD_BACKOFF
+# invalidations route scalar instead of paying another full rebuild — the
+# scalar path is always correct, so the guard only trades speed.
+_REBUILD_MIN_FRAMES = 64
+_REBUILD_BACKOFF = 16
+
+_warned_unavailable = False
+
+
+def acquire(broker: "Broker", hook) -> Optional["RouteState"]:
+    """The receive loops' entry: the broker's shared cut-through state, or
+    None when the scalar path should run (implementation forced to python,
+    native kernel unavailable, a non-default message hook, or a device
+    plane owning the eligible traffic)."""
+    global _warned_unavailable
+    impl = ROUTE_IMPL
+    if impl not in ("auto", "native"):
+        return None
+    if hook is not no_hook or broker.device_plane is not None:
+        return None
+    state = getattr(broker, "_route_state", None)
+    if state is None:
+        planner = routeplan.RoutePlanner.create()
+        if planner is None:
+            if impl == "native" and not _warned_unavailable:
+                _warned_unavailable = True
+                logger.warning("route cut-through requested but the native "
+                               "kernel is unavailable; using scalar routing")
+            return None
+        state = RouteState(broker, planner)
+        broker._route_state = state
+    return state
+
+
+class RouteState:
+    """Shared per-broker snapshot + planner (both receive loops use it).
+
+    The snapshot keys on ``Connections.interest_version``, which every
+    routing-state mutation bumps (subscriptions, membership, DirectMap
+    merges) — the same token the scalar path's per-batch interest caches
+    validate against. The version is revalidated before EVERY plan call
+    (egress awaits can park the drain while another task mutates routing
+    state), so a stale snapshot can never route a frame the scalar
+    path's per-message version check would have routed differently.
+    """
+
+    __slots__ = ("broker", "planner", "version", "user_keys", "broker_ids",
+                 "usable", "_frames_since_rebuild", "_skip_rebuilds")
+
+    def __init__(self, broker: "Broker", planner):
+        self.broker = broker
+        self.planner = planner
+        self.version = -1
+        self.user_keys: List[bytes] = []
+        self.broker_ids: List[str] = []
+        self.usable = True
+        # cold start counts as amortized: the first build must not arm
+        # the churn backoff
+        self._frames_since_rebuild = 1 << 30
+        self._skip_rebuilds = 0
+
+    # -- snapshot ------------------------------------------------------------
+
+    def _refresh(self) -> bool:
+        conns = self.broker.connections
+        if self.version == conns.interest_version and self.usable:
+            return True
+        if self._skip_rebuilds > 0:
+            # churn backoff: the last snapshot didn't amortize — route
+            # scalar for this invalidation instead of rebuilding again
+            self._skip_rebuilds -= 1
+            return False
+        users = list(conns.users.keys())
+        brokers = list(conns.brokers.keys())
+        n_u, n_b = len(users), len(brokers)
+        peer_masks = np.zeros((max(n_u + n_b, 1), routeplan.MASK_WORDS),
+                              np.uint64)
+        for i, key in enumerate(users):
+            topics = conns.user_topics.get_values_of_key(key)
+            if topics:
+                peer_masks[i] = routeplan.topic_mask(topics)
+        for j, ident in enumerate(brokers):
+            topics = conns.broker_topics.get_values_of_key(ident)
+            if topics:
+                peer_masks[n_u + j] = routeplan.topic_mask(topics)
+        valid = routeplan.topic_mask(self.broker.run_def.topics.valid)
+        user_index = {key: i for i, key in enumerate(users)}
+        broker_index = {ident: n_u + j for j, ident in enumerate(brokers)}
+        identity = conns.identity
+        dkeys: List[bytes] = []
+        owners: List[int] = []
+        for key, owner in conns.direct_map.items():
+            peer = user_index.get(key) if owner == identity \
+                else broker_index.get(owner)
+            if peer is not None:
+                dkeys.append(bytes(key))
+                owners.append(peer)
+            # unresolvable owner (user/broker not connected): omitted — a
+            # plan miss drops the frame, exactly like the scalar flush
+            # finding no connection
+        self.usable = self.planner.build(
+            n_u, n_b, valid, peer_masks, dkeys,
+            np.asarray(owners, np.int32))
+        if self.usable:
+            self.version = conns.interest_version
+            self.user_keys = users
+            self.broker_ids = brokers
+            metrics_mod.ROUTE_TABLE_REBUILDS.inc()
+            if self._frames_since_rebuild < _REBUILD_MIN_FRAMES:
+                self._skip_rebuilds = _REBUILD_BACKOFF
+            self._frames_since_rebuild = 0
+        return self.usable
+
+    # -- egress --------------------------------------------------------------
+
+    async def _send_plan(self, chunk: FrameChunk, offs: np.ndarray,
+                         lens: np.ndarray, peers: np.ndarray,
+                         frames: np.ndarray) -> None:
+        """Hand one plan's fan-out to the per-peer writers. Pairs arrive in
+        frame order; a stable sort groups them per peer without disturbing
+        per-(sender→receiver) frame order. Failure ⇒ removal, exactly like
+        ``EgressBatch.flush``."""
+        if len(peers) == 0:
+            return
+        broker = self.broker
+        # Phase 1 — SYNCHRONOUS build: resolve peer indices against the
+        # snapshot lists and assemble every per-peer stream before any
+        # await. The pair arrays are views into the planner's shared
+        # scratch and the index→key lists are replaced on rebuild; a
+        # concurrent drain (another connection's receive loop running
+        # during a send await) may re-plan or rebuild, so nothing below
+        # the first await may touch planner scratch or snapshot state.
+        n_users = self.planner.n_users
+        order = np.argsort(peers, kind="stable")
+        speers = peers[order]
+        sframes = frames[order]
+        bounds = np.nonzero(np.diff(speers))[0] + 1
+        starts = np.concatenate(([0], bounds))
+        ends = np.concatenate((bounds, [len(speers)]))
+        buf = chunk.buf
+        mv = None
+        sends: list = []  # (is_user, key_or_ident, data, owner)
+        for s, e in zip(starts.tolist(), ends.tolist()):
+            peer = int(speers[s])
+            idx = sframes[s:e]
+            if peer < n_users:
+                target = (True, self.user_keys[peer])
+            else:
+                target = (False, self.broker_ids[peer - n_users])
+            first, last = int(idx[0]), int(idx[-1])
+            if last - first + 1 == len(idx):
+                # contiguous run: the chunk's own bytes ARE the wire
+                # stream (frames sit back-to-back, length-prefixed) —
+                # zero-copy view + batch-wise permit lease
+                if mv is None:
+                    mv = memoryview(buf)
+                data = mv[int(offs[first]) - 4:
+                          int(offs[last]) + int(lens[last])]
+                owner = chunk.lease()
+            else:
+                data = self.planner.gather(buf, offs, lens, idx)
+                owner = None
+                if data is None:  # can't happen on in-range indices
+                    continue
+            sends.append((*target, data, owner))
+        # Phase 2 — sends (may await). Connections are looked up by
+        # stable identity here, like the scalar flush: a peer that left
+        # mid-batch drops its frames; failure ⇒ removal.
+        for is_user_peer, key, data, owner in sends:
+            if is_user_peer:
+                conn = broker.connections.get_user_connection(key)
+            else:
+                conn = broker.connections.get_broker_connection(key)
+            if conn is None:
+                continue  # peer left since the plan: drop (scalar parity)
+            try:
+                await conn.send_encoded(data, owner)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                if is_user_peer:
+                    logger.info("send to user %s failed (%r); removing",
+                                mnemonic(key), exc)
+                    broker.connections.remove_user(key, reason="send failed")
+                else:
+                    logger.info("send to broker %s failed (%r); removing",
+                                key, exc)
+                    broker.connections.remove_broker(key,
+                                                     reason="send failed")
+                broker.update_metrics()
+
+    # -- scalar twins for residual / depth-1 traffic -------------------------
+
+    def _route_one_scalar(self, sender_id, message, raw: Bytes,
+                          is_user: bool, egress: EgressBatch,
+                          interest_cache: dict) -> bool:
+        """Route ONE already-deserialized message with the scalar rules
+        (no device plane, no-op hook — both guaranteed by ``acquire``).
+        Returns False when the sender must be disconnected."""
+        broker = self.broker
+        topics_space = broker.run_def.topics
+        if isinstance(message, Direct):
+            route_direct(broker, message.recipient, raw,
+                         to_user_only=not is_user, egress=egress)
+        elif isinstance(message, Broadcast):
+            pruned, _bad = topics_space.prune(message.topics)
+            if pruned:
+                route_broadcast(broker, pruned, raw,
+                                to_users_only=not is_user, egress=egress,
+                                interest_cache=interest_cache)
+        elif is_user and isinstance(message, Subscribe):
+            pruned, bad = topics_space.prune(message.topics)
+            if bad:
+                return False  # unknown topic ⇒ disconnect (scalar parity)
+            broker.connections.subscribe_user_to(sender_id, pruned)
+        elif is_user and isinstance(message, Unsubscribe):
+            pruned, _bad = topics_space.prune(message.topics)
+            broker.connections.unsubscribe_user_from(sender_id, pruned)
+        elif not is_user and isinstance(message, UserSync):
+            broker.connections.apply_user_sync(message.payload)
+            broker.update_metrics()
+        elif not is_user and isinstance(message, TopicSync):
+            broker.connections.apply_topic_sync(sender_id, message.payload)
+        else:
+            # users may not send auth/sync post-handshake; brokers may not
+            # send auth/subscribe — disconnect (scalar parity, including
+            # the broker-loop diagnostic; the user loop logs nothing here)
+            if not is_user:
+                logger.warning("broker %s sent unexpected %s; dropping link",
+                               sender_id, type(message).__name__)
+            return False
+        return True
+
+    def _log_malformed(self, sender_id, is_user: bool) -> None:
+        """The scalar loops' malformed-frame diagnostics, verbatim."""
+        if is_user:
+            logger.info("user %s sent malformed frame; disconnecting",
+                        mnemonic(sender_id))
+        else:
+            logger.warning("broker %s sent malformed frame; dropping link",
+                           sender_id)
+
+    # -- drains --------------------------------------------------------------
+
+    async def route_drain(self, sender_id, items: list,
+                          is_user: bool) -> bool:
+        """Route one ``recv_frames()`` drain (a mix of :class:`FrameChunk`
+        batches and depth-1 :class:`Bytes` frames), preserving arrival
+        order end to end. Returns False when the sender must be
+        disconnected; every item's pool permit is settled either way."""
+        mode = _MODE_USER if is_user else _MODE_BROKER
+        egress = EgressBatch(self.broker)
+        interest_cache: dict = {}
+        alive = True
+        idx = 0  # items[idx:] are the ones whose release is still owed
+        try:
+            while idx < len(items):
+                item = items[idx]
+                idx += 1
+                if type(item) is not FrameChunk:
+                    # depth-1 frame (the latency regime): scalar-route it
+                    # through the accumulating egress (which clones), then
+                    # settle its permit here
+                    metrics_mod.ROUTE_RESIDUAL_FRAMES.inc()
+                    try:
+                        try:
+                            message = deserialize(item.data)
+                        except Error:
+                            self._log_malformed(sender_id, is_user)
+                            alive = False
+                        else:
+                            alive = self._route_one_scalar(
+                                sender_id, message, item, is_user, egress,
+                                interest_cache)
+                    finally:
+                        item.release()
+                    if not alive:
+                        break
+                    continue
+                # handoff guard: until _route_chunk/_chunk_scalar take
+                # ownership (they release in their finally), an exception
+                # or cancellation here must settle this chunk's permit —
+                # the outer finally only covers items[idx:]
+                try:
+                    usable = self._refresh()
+                    if usable:
+                        # a chunk's plan enqueues per-peer streams
+                        # immediately; flush accumulated singles first so
+                        # per-peer order follows arrival order
+                        await egress.flush()
+                except BaseException:
+                    item.release()
+                    raise
+                if usable:
+                    alive = await self._route_chunk(sender_id, item, mode,
+                                                    is_user, egress,
+                                                    interest_cache)
+                else:
+                    # snapshot build failed (allocation): scalar-route the
+                    # chunk frame by frame — correctness over speed
+                    alive = await self._chunk_scalar(sender_id, item,
+                                                     is_user, egress,
+                                                     interest_cache)
+                if not alive:
+                    break
+        finally:
+            try:
+                await egress.flush()
+            finally:
+                for item in items[idx:]:
+                    item.release()
+        return alive
+
+    async def _route_chunk(self, sender_id, chunk: FrameChunk, mode: int,
+                           is_user: bool, egress: EgressBatch,
+                           interest_cache: dict) -> bool:
+        """Cut-through one chunk: plan → egress views → residual scalar →
+        resume. The chunk's permit is released here (leases keep it alive
+        under pending zero-copy flushes)."""
+        offs = np.asarray(chunk.offs, np.int64)
+        lens = np.asarray(chunk.lens, np.int64)
+        buf = chunk.buf
+        n = len(offs)
+        pos = chunk._pos  # 0 unless someone partially took frames
+        planner = self.planner
+        try:
+            while pos < n:
+                # Revalidate the snapshot before EVERY plan call: the
+                # egress awaits below can park this task while another
+                # task mutates routing state (a subscribe on a different
+                # connection), and the scalar path's per-message
+                # interest_version check would see that mutation — so
+                # must we. Two int compares when nothing changed.
+                if not self._refresh():
+                    return await self._chunk_scalar_from(
+                        sender_id, chunk, offs, lens, pos, is_user,
+                        egress, interest_cache)
+                consumed, stop, peers, frames = planner.plan(
+                    buf, offs, lens, pos, mode)
+                if consumed:
+                    metrics_mod.ROUTE_BATCH_SIZE.observe(consumed)
+                    metrics_mod.ROUTE_CUTTHROUGH_FRAMES.inc(consumed)
+                    self._frames_since_rebuild += consumed
+                    await self._send_plan(chunk, offs, lens, peers, frames)
+                pos += consumed
+                if stop == routeplan.STOP_END:
+                    break
+                if stop == routeplan.STOP_CAPACITY:
+                    if consumed == 0:  # cannot make progress (can't
+                        return await self._chunk_scalar_from(  # happen:
+                            sender_id, chunk, offs, lens, pos,  # cap >=
+                            is_user, egress, interest_cache)    # n_peers)
+                    continue
+                # STOP_RESIDUAL: the frame at `pos` is a control frame or
+                # malformed — scalar semantics, then re-plan (the control
+                # frame bumps interest_version, so the next plan call
+                # rebuilds the snapshot first)
+                metrics_mod.ROUTE_RESIDUAL_FRAMES.inc()
+                o, ln = int(offs[pos]), int(lens[pos])
+                try:
+                    message = deserialize(memoryview(buf)[o:o + ln])
+                except Error:
+                    self._log_malformed(sender_id, is_user)
+                    return False  # malformed ⇒ disconnect/drop link
+                if isinstance(message, (Direct, Broadcast)):
+                    # defensive only: a well-formed hot frame never stops
+                    # the plan; route it scalar-wise to stay correct
+                    frame = Bytes(buf[o:o + ln])
+                    alive = self._route_one_scalar(sender_id, message,
+                                                   frame, is_user, egress,
+                                                   interest_cache)
+                    frame.release()
+                else:
+                    alive = self._route_one_scalar(sender_id, message,
+                                                   None, is_user, egress,
+                                                   interest_cache)
+                if not alive:
+                    return False
+                pos += 1  # loop top revalidates the (likely bumped) snapshot
+        finally:
+            chunk.release()
+        return True
+
+    async def _chunk_scalar(self, sender_id, chunk: FrameChunk,
+                            is_user: bool, egress: EgressBatch,
+                            interest_cache: dict) -> bool:
+        offs = np.asarray(chunk.offs, np.int64)
+        lens = np.asarray(chunk.lens, np.int64)
+        try:
+            return await self._chunk_scalar_from(
+                sender_id, chunk, offs, lens, chunk._pos, is_user, egress,
+                interest_cache)
+        finally:
+            chunk.release()
+
+    async def _chunk_scalar_from(self, sender_id, chunk: FrameChunk,
+                                 offs, lens, pos: int, is_user: bool,
+                                 egress: EgressBatch,
+                                 interest_cache: dict) -> bool:
+        """Scalar fallback over a chunk's remaining frames (snapshot build
+        failed). Mirrors the handlers.py loop bodies exactly."""
+        buf = chunk.buf
+        for i in range(pos, len(offs)):
+            metrics_mod.ROUTE_SCALAR_FRAMES.inc()
+            o, ln = int(offs[i]), int(lens[i])
+            try:
+                message = deserialize(memoryview(buf)[o:o + ln])
+            except Error:
+                self._log_malformed(sender_id, is_user)
+                return False
+            if isinstance(message, (Direct, Broadcast)):
+                frame = Bytes(buf[o:o + ln])
+                ok = self._route_one_scalar(sender_id, message, frame,
+                                            is_user, egress, interest_cache)
+                frame.release()
+            else:
+                ok = self._route_one_scalar(sender_id, message, None,
+                                            is_user, egress, interest_cache)
+            if not ok:
+                return False
+        return True

@@ -252,12 +252,25 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
     kicked (user/handler.rs:104-161). Messages are drained and routed in
     batches: one ``recv_raw_many`` wakeup routes every pending frame, and
     the fan-out goes out as per-peer ``send_raw_many`` batches."""
+    from pushcdn_tpu.broker.tasks import cutthrough  # lazy: import cycle
     hook = broker.run_def.user_def.hook
     topics = broker.run_def.topics
     alive = True
     try:
         while alive:
+            # Cut-through plane: when eligible (native kernel compiled, no
+            # device plane, default hook), whole FrameChunk batches route
+            # via one plan call with zero per-frame Python — the scalar
+            # body below is the correctness twin (and the path control
+            # frames always take).
+            cut = cutthrough.acquire(broker, hook)
+            if cut is not None:
+                items = await connection.recv_frames()
+                alive = await cut.route_drain(public_key, items,
+                                              is_user=True)
+                continue
             raws = await connection.recv_raw_many()
+            metrics_mod.ROUTE_SCALAR_FRAMES.inc(len(raws))
             egress = EgressBatch(broker)
             interest_cache: dict = {}
             # device-eligible (message, raw, pruned_topics) collected during
@@ -379,12 +392,22 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                               connection) -> None:
     """Pump a peer broker's messages (broker/handler.rs:121-193), batched
     the same way as the user loop."""
+    from pushcdn_tpu.broker.tasks import cutthrough  # lazy: import cycle
     hook = broker.run_def.broker_def.hook
     topics = broker.run_def.topics
     alive = True
     try:
         while alive:
+            # same cut-through seam as the user loop (broker-origin mode:
+            # local-users-only broadcast, to_user_only direct)
+            cut = cutthrough.acquire(broker, hook)
+            if cut is not None:
+                items = await connection.recv_frames()
+                alive = await cut.route_drain(identifier, items,
+                                              is_user=False)
+                continue
             raws = await connection.recv_raw_many()
+            metrics_mod.ROUTE_SCALAR_FRAMES.inc(len(raws))
             egress = EgressBatch(broker)
             interest_cache: dict = {}
             stage_items: list = []

@@ -1,17 +1,51 @@
 """JAX API compatibility: ``shard_map`` moved from
 ``jax.experimental.shard_map`` (<= 0.4.x, kwarg ``check_rep``) to
 ``jax.shard_map`` (newer, kwarg ``check_vma``). The deployment images span
-both; every call site goes through :func:`shard_map` here."""
+both — INCLUDING the jax 0.5.x window where ``jax.shard_map`` already
+exists but still takes ``check_rep`` — so the check kwarg is keyed on the
+function's actual signature, not on ``hasattr(jax, "shard_map")``. Every
+call site goes through :func:`shard_map` here."""
 
 from __future__ import annotations
+
+import inspect
 
 import jax
 
 
+def _resolve(mod=None):
+    """Pick (shard_map function, check-kwarg name) for ``mod`` (default:
+    the installed jax). Signature inspection first; for opaque signatures
+    (``**kwargs`` wrappers) the version tuple decides; no ``jax.shard_map``
+    at all means the old experimental module."""
+    if mod is None:
+        mod = jax
+    fn = getattr(mod, "shard_map", None)
+    if fn is not None:
+        try:
+            params = inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            params = None
+        if params is not None:
+            if "check_vma" in params:
+                return fn, "check_vma"
+            if "check_rep" in params:
+                # the 0.5.x window: top-level name, old kwarg
+                return fn, "check_rep"
+            if any(p.kind is inspect.Parameter.VAR_KEYWORD
+                   for p in params.values()):
+                ver = getattr(mod, "__version_info__", None) or (0, 0, 0)
+                return fn, ("check_vma" if tuple(ver) >= (0, 6)
+                            else "check_rep")
+        # inspectable but with neither kwarg and no **kwargs: fall through
+        # to the experimental module rather than guess
+    from jax.experimental.shard_map import shard_map as legacy
+    return legacy, "check_rep"
+
+
+_SHARD_MAP, _CHECK_KW = _resolve()
+
+
 def shard_map(f, mesh, in_specs, out_specs, check: bool = False):
-    if hasattr(jax, "shard_map"):
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=check)
-    from jax.experimental.shard_map import shard_map as _shard_map
-    return _shard_map(f, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, check_rep=check)
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_CHECK_KW: check})

@@ -155,6 +155,33 @@ class Bytes:
         return bytes(self.data)
 
 
+class BytesLease:
+    """Batch-wise permit transfer: holds ONE clone reference of a
+    :class:`Bytes` until this object is garbage-collected.
+
+    The cut-through routing plane hands whole-chunk byte ranges to
+    connection writers as zero-copy views (``PreEncoded.data``); the
+    chunk's single pool permit must outlive every pending flush that
+    still reads its buffer. A lease rides each writer entry's ``owner``
+    seat, so the permit releases when the LAST flush (or queue drain)
+    drops its entry — the chunk-granular analog of the per-frame
+    ``Bytes.clone()`` fan-out accounting.
+    """
+
+    __slots__ = ("_b",)
+
+    def __init__(self, b: "Bytes"):
+        self._b = b.clone()
+
+    def __del__(self):
+        b, self._b = self._b, None
+        if b is not None:
+            try:
+                b.release()
+            except Exception:
+                pass
+
+
 class MemoryPool:
     """Global byte budget for in-flight message buffers.
 

@@ -113,6 +113,29 @@ def observe_message_latency(seconds: float) -> None:
     LATENCY.observe(seconds)
 
 
+# Cut-through routing plane (broker/tasks/cutthrough.py): one native plan
+# call routes a whole FrameChunk without per-frame Python. The histogram
+# buckets are FRAME COUNTS per plan call, not seconds.
+ROUTE_BATCH_SIZE = Histogram(
+    "cdn_route_batch_size_frames",
+    "Frames covered by one cut-through route-plan call",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096))
+ROUTE_CUTTHROUGH_FRAMES = Counter(
+    "cdn_route_batch_cutthrough_frames",
+    "Frames routed by the native cut-through plan (no per-frame Python)")
+ROUTE_RESIDUAL_FRAMES = Counter(
+    "cdn_route_batch_residual_frames",
+    "Frames the cut-through plane handed to the scalar path "
+    "(control frames, malformed frames, depth-1 singles)")
+ROUTE_SCALAR_FRAMES = Counter(
+    "cdn_route_batch_scalar_frames",
+    "Frames routed entirely by the scalar receive loops "
+    "(cut-through off or ineligible)")
+ROUTE_TABLE_REBUILDS = Counter(
+    "cdn_route_table_rebuilds",
+    "Cut-through snapshot rebuilds (routing state changed)")
+
+
 # Callables run before every render: components whose counters move on
 # hot paths (device-plane steps) register a refresh here instead of
 # pushing gauge updates from their pump loops.

@@ -117,6 +117,16 @@ class FrameChunk:
         finally:
             self.release()
 
+    def lease(self):
+        """A :class:`pushcdn_tpu.proto.limiter.BytesLease` over the
+        chunk's master reference: keeps the buffer + pool permit alive
+        until the lease is dropped. The cut-through routing plane attaches
+        one to each writer entry that flushes a zero-copy view of this
+        chunk, so ``release()``-ing the chunk after planning cannot free
+        the permit under a pending flush."""
+        from pushcdn_tpu.proto.limiter import BytesLease
+        return BytesLease(self._master)
+
     def release(self) -> None:
         """Drop the untaken remainder (idempotent)."""
         if self._pos < len(self.offs):
@@ -413,6 +423,12 @@ class Connection:
                                                      enc_cap, batch)
                 finally:
                     self._write_mutex.release()
+                # Drop the entry reference BEFORE parking on the queue: a
+                # flushed entry's ``owner`` keep-alive (egress-buffer
+                # lease, cut-through chunk permit lease) must release when
+                # the flush completes, not when the NEXT send arrives on
+                # an idle link.
+                item = None
                 if closed:
                     return
         except asyncio.CancelledError:

@@ -59,6 +59,7 @@ from __future__ import annotations
 
 import asyncio
 import errno
+import logging
 import os
 import ssl
 import struct
@@ -84,8 +85,42 @@ from pushcdn_tpu.proto.transport.base import (
 )
 from pushcdn_tpu.proto.transport.tls_stream import TlsStream
 
+logger = logging.getLogger("pushcdn.transport")
+
 (_SYN, _SYNACK, _DATA, _ACK, _FIN, _FINACK, _PING, _RST,
  _PROBE, _PROBEACK) = range(1, 11)
+
+
+def _socket_for_first_usable(infos, action):
+    """Iterate ALL ``getaddrinfo`` results (dual-stack hostnames resolve
+    to v6 first on many hosts; a v6-less host must fall through to the v4
+    record — the behavior ``create_datagram_endpoint`` used to give this
+    transport). ``action(sock, addr)`` attempts connect/bind; the first
+    family that completes wins. Raises the LAST OSError when none do."""
+    import socket as _socket
+    last_exc: Optional[Exception] = None
+    for family, stype, _pr, _cn, addr in infos:
+        try:
+            sock = _socket.socket(family, stype)
+        except OSError as exc:
+            last_exc = exc
+            continue
+        try:
+            sock.setblocking(False)
+            _tune_socket(sock)
+            action(sock, addr)
+            return sock
+        except (OSError, TypeError, ValueError) as exc:
+            # OSError: unroutable/unsupported family on this host;
+            # Type/ValueError: family/address-shape mismatch from a
+            # degenerate resolver row — either way, try the next record
+            last_exc = exc
+            sock.close()
+    if isinstance(last_exc, OSError):
+        raise last_exc
+    # callers translate OSError into the typed Error(CONNECTION); a
+    # degenerate row's TypeError/ValueError must not escape as-is
+    raise OSError(f"no usable getaddrinfo result ({last_exc!r})")
 
 
 def _tune_socket(sock) -> None:
@@ -830,6 +865,33 @@ _RX_BATCH = 128
 _RX_BUF_BYTES = 65536 + 128  # one max datagram + header slack
 
 
+class _FallbackDatagramProtocol(asyncio.DatagramProtocol):
+    """Per-datagram dispatch shim for event loops without ``add_reader``:
+    feeds the owning endpoint's ``_dispatch`` exactly like the batched
+    drain does, one datagram per batch bracket (the coalesced-ACK
+    machinery still runs, it just never sees more than one datagram per
+    'drain'). Errors route to the endpoint's ``_on_sock_error`` — the old
+    ``error_received`` semantics."""
+
+    def __init__(self, endpoint: "_UdpEndpoint"):
+        self._endpoint = endpoint
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        ep = self._endpoint
+        if ep._closed or len(data) < _HDR.size:
+            return
+        ptype, conn_id = _HDR.unpack_from(data)
+        touched: dict = {}
+        try:
+            ep._dispatch(ptype, conn_id, data[_HDR.size:], addr, touched)
+        finally:
+            for stream in touched.values():
+                stream.end_rx_batch()
+
+    def error_received(self, exc: OSError) -> None:
+        self._endpoint._on_sock_error(exc)
+
+
 class _UdpEndpoint:
     """Manual non-blocking UDP socket with a batched receive drain.
 
@@ -850,7 +912,30 @@ class _UdpEndpoint:
         self._closed = False
         self._rx_buf = bytearray(_RX_BUF_BYTES)
         self._rx_view = memoryview(self._rx_buf)
-        loop.add_reader(self._fd, self._on_readable)
+        # datagram-endpoint fallback transport for loops without a
+        # readiness API (Windows ProactorEventLoop raises
+        # NotImplementedError from add_reader); created by the async
+        # ``ensure_transport`` since __init__ can't await
+        self._transport = None
+        try:
+            loop.add_reader(self._fd, self._on_readable)
+            self._reader_attached = True
+        except NotImplementedError:
+            self._reader_attached = False
+
+    async def ensure_transport(self) -> None:
+        """Attach the ``create_datagram_endpoint`` fallback when the loop
+        rejected ``add_reader``. One warning line: the batched-recv drain
+        (and its ACK coalescing) degrades to per-datagram dispatch."""
+        if self._reader_attached or self._transport is not None:
+            return
+        logger.warning(
+            "event loop %s has no add_reader (proactor?); QUIC endpoint "
+            "falling back to the datagram-endpoint path (per-datagram "
+            "dispatch, no batched receive drain)",
+            type(self._loop).__name__)
+        self._transport, _ = await self._loop.create_datagram_endpoint(
+            lambda: _FallbackDatagramProtocol(self), sock=self.sock)
 
     # subclasses: dispatch one datagram (header already length-checked)
     def _dispatch(self, ptype: int, conn_id: int, body: bytes, addr,
@@ -896,10 +981,16 @@ class _UdpEndpoint:
         if self._closed:
             return
         self._closed = True
-        try:
-            self._loop.remove_reader(self._fd)
-        except Exception:
-            pass
+        if self._reader_attached:
+            try:
+                self._loop.remove_reader(self._fd)
+            except Exception:
+                pass
+        if self._transport is not None:
+            try:
+                self._transport.close()  # closes the socket it owns
+            except Exception:
+                pass
         try:
             self.sock.close()
         except OSError:
@@ -915,6 +1006,12 @@ class _ClientEndpoint(_UdpEndpoint):
         self.synack = loop.create_future()
 
     def send(self, pkt: bytes) -> None:
+        if self._transport is not None:  # datagram-endpoint fallback
+            try:
+                self._transport.sendto(pkt)  # connected socket: no addr
+            except Exception:
+                pass  # errors surface via error_received
+            return
         try:
             self.sock.send(pkt)
         except (BlockingIOError, InterruptedError):
@@ -965,6 +1062,12 @@ class _ServerEndpoint(_UdpEndpoint):
         self.addrs: Dict[int, Tuple] = {}
 
     def _sendto(self, pkt: bytes, addr, conn_id: int) -> None:
+        if self._transport is not None:  # datagram-endpoint fallback
+            try:
+                self._transport.sendto(pkt, addr)
+            except Exception:
+                pass  # errors surface via error_received (broadcast EMSGSIZE)
+            return
         try:
             self.sock.sendto(pkt, addr)
         except (BlockingIOError, InterruptedError):
@@ -1081,20 +1184,23 @@ class Quic(Protocol):
         ctx, server_hostname = client_context_for(use_local_authority, host)
         loop = asyncio.get_running_loop()
         import socket as _socket
-        sock = None
         try:
             infos = await loop.getaddrinfo(host, port,
                                            type=_socket.SOCK_DGRAM)
-            family, stype, _pr, _cn, addr = infos[0]
-            sock = _socket.socket(family, stype)
-            sock.setblocking(False)
-            _tune_socket(sock)
-            sock.connect(addr)  # non-blocking UDP connect is immediate
+            # non-blocking UDP connect is immediate; try every resolved
+            # family in order (v6-first hostname on a v6-less host must
+            # fall through to its A record)
+            sock = _socket_for_first_usable(
+                infos, lambda s, addr: s.connect(addr))
         except OSError as exc:
-            if sock is not None:
-                sock.close()
             bail(ErrorKind.CONNECTION, f"quic connect to {endpoint} failed", exc)
         proto = _ClientEndpoint(sock, loop)
+        try:
+            await proto.ensure_transport()
+        except Exception as exc:
+            proto.close()
+            bail(ErrorKind.CONNECTION,
+                 f"quic endpoint setup for {endpoint} failed", exc)
 
         conn_id = int.from_bytes(os.urandom(8), "big")
         syn = _HDR.pack(_SYN, conn_id)
@@ -1145,20 +1251,20 @@ class Quic(Protocol):
         listener = QuicListener()
         listener._ssl_context = certificate.server_context()
         import socket as _socket
-        sock = None
         try:
             infos = await loop.getaddrinfo(host, port,
                                            type=_socket.SOCK_DGRAM,
                                            flags=_socket.AI_PASSIVE)
-            family, stype, _pr, _cn, addr = infos[0]
-            sock = _socket.socket(family, stype)
-            sock.setblocking(False)
-            _tune_socket(sock)
-            sock.bind(addr)
+            sock = _socket_for_first_usable(
+                infos, lambda s, addr: s.bind(addr))
         except OSError as exc:
-            if sock is not None:
-                sock.close()
             bail(ErrorKind.CONNECTION, f"quic bind to {endpoint} failed", exc)
         listener._endpoint = _ServerEndpoint(sock, loop, listener)
+        try:
+            await listener._endpoint.ensure_transport()
+        except Exception as exc:
+            listener._endpoint.close()
+            bail(ErrorKind.CONNECTION,
+                 f"quic endpoint setup for {endpoint} failed", exc)
         listener.bound_port = sock.getsockname()[1]
         return listener

@@ -1,0 +1,80 @@
+"""Shared decoded-broker-forwarding measurement (ISSUE 3 A/B).
+
+One injected broker (test harness, Memory transport), one publisher
+fanning Broadcast batches to N subscribed receivers, counted at the
+receivers' transport drain. Kept here — like :class:`Cluster` — so the
+three consumers (`benches/route_bench.py`, `benches/configs_bench.py`'s
+headline row, and `bench.py`'s companion host row) measure the SAME loop
+instead of drifting copies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import statistics
+import time
+from typing import Optional
+
+
+async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
+                       trials: int = 3, payload: int = 512,
+                       batch: int = 64) -> Optional[dict]:
+    """Measure broker forwarding msgs/s with the routing plane forced to
+    ``impl`` (``auto``/``native``/``python``). Returns ``None`` when
+    ``impl == "native"`` but the kernel is unavailable (callers emit a
+    skipped row — never a mislabeled A/B), else a dict with the median,
+    all trials, and the delivered rate."""
+    from pushcdn_tpu.broker.tasks import cutthrough
+    from pushcdn_tpu.broker.test_harness import TestDefinition
+    from pushcdn_tpu.native import routeplan
+    from pushcdn_tpu.proto.message import Broadcast, serialize
+    from pushcdn_tpu.proto.transport.base import FrameChunk
+    from pushcdn_tpu.proto.transport.memory import Memory
+
+    if impl == "native" and not routeplan.available():
+        return None
+    # the global-state restore must survive a failing harness start OR a
+    # failing shutdown: callers swallow exceptions, and a leaked forced
+    # impl / widened duplex window would distort every later row (and
+    # cross-contaminate tests) in the same process
+    prev_impl = cutthrough.ROUTE_IMPL
+    prev_win = Memory.set_duplex_window(256 * 1024)
+    try:
+        cutthrough.ROUTE_IMPL = impl
+        run = await TestDefinition(
+            connected_users=[[]] + [[0]] * receivers).run()
+        try:
+            frame = serialize(Broadcast([0], os.urandom(payload)))
+            sender = run.user(0).remote
+            msgs = max(batch, (msgs // batch) * batch)
+
+            async def drain(conn, n):
+                got = 0
+                async with asyncio.timeout(120):
+                    while got < n:
+                        for item in await conn.recv_frames(n - got):
+                            got += item.remaining \
+                                if type(item) is FrameChunk else 1
+                            item.release()
+
+            rates = []
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                drains = [asyncio.create_task(
+                    drain(run.user(1 + r).remote, msgs))
+                    for r in range(receivers)]
+                for _ in range(msgs // batch):
+                    await sender.send_raw_many([frame] * batch)
+                    await asyncio.sleep(0)
+                await asyncio.gather(*drains)
+                rates.append(msgs / (time.perf_counter() - t0))
+            med = statistics.median(rates)
+            return {"median": med, "trials": rates, "msgs": msgs,
+                    "receivers": receivers, "payload": payload,
+                    "delivered": med * receivers}
+        finally:
+            await run.shutdown()
+    finally:
+        cutthrough.ROUTE_IMPL = prev_impl
+        Memory.set_duplex_window(prev_win)

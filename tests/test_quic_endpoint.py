@@ -1,0 +1,141 @@
+"""QUIC endpoint plumbing regressions (ISSUE 3 satellites):
+
+- ``connect``/``bind`` iterate ALL ``getaddrinfo`` results instead of
+  only the first — the dual-stack-hostname / v6-less-host behavior the
+  old ``create_datagram_endpoint`` path had (round-6 review finding).
+  Exercised with a mixed-family resolver stub whose FIRST record always
+  fails (bogus family / unroutable bind address).
+- Event loops without ``add_reader`` (Windows ``ProactorEventLoop``) fall
+  back to the datagram-endpoint path with a one-line warning instead of
+  crashing the manual non-blocking-socket endpoint. Exercised by faking a
+  loop whose public ``add_reader`` raises NotImplementedError (asyncio's
+  own selector datagram transport uses the private ``_add_reader``, so
+  the fallback still functions under the fake).
+"""
+
+import asyncio
+import logging
+import socket as _socket
+
+import pytest
+
+from pushcdn_tpu.proto.error import Error
+from pushcdn_tpu.proto.message import Direct
+from pushcdn_tpu.proto.transport import Quic
+
+
+async def _echo_once(listener, endpoint):
+    """connect → accept → one round trip → close. Returns nothing; raises
+    on any failure."""
+    connect_task = asyncio.create_task(Quic.connect(endpoint))
+    unfinalized = await asyncio.wait_for(listener.accept(), 10)
+    server_conn = await unfinalized.finalize()
+    client_conn = await asyncio.wait_for(connect_task, 10)
+    try:
+        await client_conn.send_message(Direct(b"srv", b"ping"))
+        got = await asyncio.wait_for(server_conn.recv_message(), 10)
+        assert isinstance(got, Direct) and bytes(got.message) == b"ping"
+        await server_conn.send_message(Direct(b"cli", b"pong"))
+        got2 = await asyncio.wait_for(client_conn.recv_message(), 10)
+        assert bytes(got2.message) == b"pong"
+    finally:
+        client_conn.close()
+        server_conn.close()
+
+
+async def test_connect_iterates_mixed_family_resolver():
+    """First resolver record is a dead family; connect must fall through
+    to the second instead of failing outright."""
+    listener = await Quic.bind("127.0.0.1:0")
+    try:
+        port = listener.bound_port
+        loop = asyncio.get_running_loop()
+        real_getaddrinfo = loop.getaddrinfo
+        calls = []
+
+        async def stub(host, p, **kw):
+            infos = await real_getaddrinfo(host, p, **kw)
+            calls.append((host, p))
+            # a "v6" record on a v6-less host: AF_INET6-shaped row whose
+            # socket/connect cannot complete here (family 9999 does not
+            # exist, so socket() raises like a kernel without v6 support)
+            dead = (9999, _socket.SOCK_DGRAM, 0, "", ("::1", p, 0, 0))
+            return [dead] + list(infos)
+
+        loop.getaddrinfo = stub
+        try:
+            await _echo_once(listener, f"127.0.0.1:{port}")
+        finally:
+            loop.getaddrinfo = real_getaddrinfo
+        assert calls, "resolver stub was never consulted"
+    finally:
+        await listener.close()
+
+
+async def test_connect_all_families_dead_raises_typed_error():
+    loop = asyncio.get_running_loop()
+    real_getaddrinfo = loop.getaddrinfo
+
+    async def stub(host, p, **kw):
+        # dead family (socket() raises OSError), then a family/address
+        # shape mismatch (connect raises TypeError): BOTH must surface as
+        # the typed Error(CONNECTION), never a raw TypeError
+        return [(9999, _socket.SOCK_DGRAM, 0, "", ("::1", p, 0, 0)),
+                (_socket.AF_INET, _socket.SOCK_DGRAM, 0, "",
+                 ("::1", p, 0, 0))]
+
+    loop.getaddrinfo = stub
+    try:
+        with pytest.raises(Error):
+            await Quic.connect("127.0.0.1:1")
+    finally:
+        loop.getaddrinfo = real_getaddrinfo
+
+
+async def test_bind_iterates_mixed_family_resolver():
+    """First resolver record binds to an address this host doesn't own
+    (the v6-record-on-v6-less-host shape); bind must fall through."""
+    loop = asyncio.get_running_loop()
+    real_getaddrinfo = loop.getaddrinfo
+
+    async def stub(host, p, **kw):
+        infos = await real_getaddrinfo(host, p, **kw)
+        # TEST-NET-3 address: EADDRNOTAVAIL on any sane host
+        dead = (_socket.AF_INET, _socket.SOCK_DGRAM, 0, "",
+                ("203.0.113.7", p))
+        return [dead] + list(infos)
+
+    loop.getaddrinfo = stub
+    try:
+        listener = await Quic.bind("127.0.0.1:0")
+    finally:
+        loop.getaddrinfo = real_getaddrinfo
+    try:
+        assert listener.bound_port
+        await _echo_once(listener, f"127.0.0.1:{listener.bound_port}")
+    finally:
+        await listener.close()
+
+
+async def test_proactor_style_loop_falls_back_to_datagram_endpoint(caplog):
+    """A loop whose add_reader raises NotImplementedError (the Windows
+    ProactorEventLoop behavior) must still carry QUIC traffic via the
+    datagram-endpoint fallback, with a one-line warning."""
+    loop = asyncio.get_running_loop()
+
+    def no_add_reader(*_a, **_kw):
+        raise NotImplementedError("proactor-style loop")
+
+    loop.add_reader = no_add_reader  # instance attr shadows the method
+    try:
+        with caplog.at_level(logging.WARNING, logger="pushcdn.transport"):
+            listener = await Quic.bind("127.0.0.1:0")
+            try:
+                assert listener._endpoint._transport is not None
+                await _echo_once(listener, f"127.0.0.1:{listener.bound_port}")
+            finally:
+                await listener.close()
+        assert any("falling back to the datagram-endpoint" in r.message
+                   for r in caplog.records)
+    finally:
+        del loop.add_reader

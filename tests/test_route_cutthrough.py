@@ -1,0 +1,230 @@
+"""Batch-vs-scalar routing equivalence (ISSUE 3 property test).
+
+Drives IDENTICAL seeded frame mixes (broadcast / direct / control /
+garbage) through both ``--route-impl`` paths — the native cut-through
+plane and the scalar receive loops — on identical broker topologies, and
+asserts:
+
+- identical per-peer delivery SEQUENCES (payload lists, order included:
+  per-(sender→receiver) order is part of the cut-through contract);
+- identical disconnect decisions (malformed frames, invalid-topic
+  subscribes, kind-policy violations);
+- permit balance: the broker's byte pool refills completely once every
+  receiver has drained and released (no leaked chunk permits, no leaked
+  egress leases).
+
+The mixes deliberately include the frames that force the plan to stop and
+resume (Subscribe before a Broadcast on the just-subscribed topic, sync
+payloads, truncated/garbage frames), because that residual seam is where
+batch and scalar semantics could drift.
+"""
+
+import asyncio
+import gc
+
+import numpy as np
+import pytest
+
+from pushcdn_tpu.broker.tasks import cutthrough
+from pushcdn_tpu.broker.test_harness import TestDefinition
+from pushcdn_tpu.broker.versioned_map import VersionedMap
+from pushcdn_tpu.proto.message import (
+    AuthenticateWithPermit,
+    Broadcast,
+    Direct,
+    Subscribe,
+    TopicSync,
+    Unsubscribe,
+    UserSync,
+    serialize,
+)
+from pushcdn_tpu.proto.transport.base import FrameChunk
+from pushcdn_tpu.proto.transport.memory import Memory
+
+pytestmark = pytest.mark.skipif(
+    not cutthrough.routeplan.available(),
+    reason="native route-plan kernel unavailable (no working g++)")
+
+# topology shared by every mix: sender is user 0 / peer 0; receivers are
+# users 1-4 (topics {0}, {0}, {1}, {}) and peer brokers (topic sets below)
+USER_TOPICS = [[], [0], [0], [1], []]
+BROKER_DEFS = [([0], [b"remote-user"]), ([1], [])]
+KNOWN_DIRECTS = [b"user-1", b"user-2", b"user-3", b"user-4",
+                 b"remote-user", b"nobody-home"]
+
+
+def _sync_payload(ident: str) -> bytes:
+    m = VersionedMap(local_identity=ident)
+    m.insert(b"synced-user", ident)
+    return VersionedMap.serialize_entries(m.full())
+
+
+def _gen_frames(rng: np.random.Generator, n: int, as_user: bool):
+    """A seeded mix of wire frames. Returns (frames, may_disconnect)."""
+    frames = []
+    for _ in range(n):
+        roll = rng.integers(0, 100)
+        payload = bytes(rng.integers(0, 256, int(rng.integers(1, 64)),
+                                     dtype=np.uint8))
+        if roll < 55:
+            # broadcasts, sometimes with invalid (7) or duplicate topics
+            topics = [int(t) for t in rng.choice(
+                [0, 1, 7], size=int(rng.integers(1, 4)))]
+            frames.append(serialize(Broadcast(topics, payload)))
+        elif roll < 80:
+            rcpt = KNOWN_DIRECTS[int(rng.integers(0, len(KNOWN_DIRECTS)))]
+            frames.append(serialize(Direct(rcpt, payload)))
+        elif roll < 88:
+            topics = [int(t) for t in rng.choice(
+                [0, 1, 7] if not as_user else [0, 1],
+                size=int(rng.integers(1, 3)))]
+            frames.append(serialize(Subscribe(topics)))
+        elif roll < 93:
+            frames.append(serialize(Unsubscribe([0])))
+        elif roll < 96:
+            frames.append(serialize(UserSync(_sync_payload(
+                "testbrokerpub-0:0/testbrokerpriv-0:0"))))
+        elif roll < 98:
+            frames.append(serialize(TopicSync(_sync_payload(
+                "testbrokerpub-0:0/testbrokerpriv-0:0"))))
+        elif roll < 99:
+            frames.append(serialize(AuthenticateWithPermit(permit=7)))
+        else:
+            frames.append(b"\xfe" + payload)  # garbage: unknown kind
+    return frames
+
+
+async def _drain_all(conn, settle_s: float = 0.05):
+    """Collect every delivered frame (as bytes) until silence."""
+    got = []
+    while True:
+        try:
+            items = await asyncio.wait_for(conn.recv_frames(), settle_s)
+        except (asyncio.TimeoutError, Exception):
+            return got
+        for item in items:
+            if type(item) is FrameChunk:
+                got.extend(bytes(mv) for mv in item.views())
+            else:
+                got.append(bytes(item.data))
+            item.release()
+
+
+async def _run_mix(impl: str, frames, as_user: bool, chunked: bool):
+    """Run one mix through one implementation. Returns (per-peer delivery
+    lists, sender-still-connected, pool-balanced)."""
+    prev_impl = cutthrough.ROUTE_IMPL
+    prev_win = Memory.set_duplex_window(512 * 1024)
+    cutthrough.ROUTE_IMPL = impl
+    try:
+        run = await TestDefinition(connected_users=USER_TOPICS,
+                                   connected_brokers=BROKER_DEFS).run()
+        try:
+            sender = (run.user(0) if as_user else run.peer(0)).remote
+            try:
+                if chunked:
+                    # one batch ⇒ arrives as FrameChunk(s): the plan path
+                    await sender.send_raw_many(list(frames), flush=True)
+                else:
+                    # flushed singles ⇒ depth-1 Bytes: the residual path
+                    for f in frames:
+                        await sender.send_raw(f, flush=True)
+            except Exception:
+                pass  # peer disconnected us mid-send: a legal outcome
+            await asyncio.sleep(0.15)
+
+            deliveries = {}
+            for i in range(1, len(USER_TOPICS)):
+                deliveries[f"user-{i}"] = await _drain_all(
+                    run.user(i).remote)
+            for j in range(len(BROKER_DEFS)):
+                if not (not as_user and j == 0):  # skip the sender itself
+                    deliveries[f"peer-{j}"] = await _drain_all(
+                        run.peer(j).remote)
+            if as_user:
+                deliveries["user-0"] = await _drain_all(run.user(0).remote)
+
+            if as_user:
+                alive = run.broker.connections.has_user(b"user-0")
+            else:
+                alive = run.broker.connections.has_broker(
+                    run.peer(0).identifier)
+
+            # permit balance: everything drained+released above; the pool
+            # must refill (leases release via refcount/GC)
+            pool = run.broker.limiter.pool
+            balanced = True
+            if pool is not None:
+                for _ in range(10):
+                    gc.collect()
+                    if pool.available == pool.capacity:
+                        break
+                    await asyncio.sleep(0.02)
+                balanced = pool.available == pool.capacity
+            return deliveries, alive, balanced
+        finally:
+            await run.shutdown()
+    finally:
+        cutthrough.ROUTE_IMPL = prev_impl
+        Memory.set_duplex_window(prev_win)
+
+
+@pytest.mark.parametrize("seed", range(8))
+async def test_user_mix_equivalence(seed):
+    rng = np.random.default_rng(1000 + seed)
+    frames = _gen_frames(rng, 60, as_user=True)
+    d_native, alive_n, bal_n = await _run_mix("native", frames,
+                                              as_user=True, chunked=True)
+    d_python, alive_p, bal_p = await _run_mix("python", frames,
+                                              as_user=True, chunked=True)
+    assert alive_n == alive_p, f"seed {seed}: disconnect decisions differ"
+    assert d_native == d_python, f"seed {seed}: delivery sets differ"
+    assert bal_n and bal_p, f"seed {seed}: pool permits leaked"
+
+
+@pytest.mark.parametrize("seed", range(4))
+async def test_broker_mix_equivalence(seed):
+    rng = np.random.default_rng(2000 + seed)
+    frames = _gen_frames(rng, 60, as_user=False)
+    d_native, alive_n, bal_n = await _run_mix("native", frames,
+                                              as_user=False, chunked=True)
+    d_python, alive_p, bal_p = await _run_mix("python", frames,
+                                              as_user=False, chunked=True)
+    assert alive_n == alive_p, f"seed {seed}: link-drop decisions differ"
+    assert d_native == d_python, f"seed {seed}: delivery sets differ"
+    assert bal_n and bal_p, f"seed {seed}: pool permits leaked"
+
+
+async def test_subscribe_then_broadcast_same_chunk():
+    """The residual seam: a Subscribe and a Broadcast on the just-
+    subscribed topic land in ONE chunk — the plan must stop, apply the
+    subscription, rebuild, and deliver the broadcast back to the sender
+    (scalar parity with test_broadcast_from_user's self-delivery)."""
+    frames = [serialize(Subscribe([1])),
+              serialize(Broadcast([1], b"fresh-topic")),
+              serialize(Unsubscribe([1])),
+              serialize(Broadcast([1], b"after-unsub"))]
+    for impl in ("native", "python"):
+        deliveries, alive, balanced = await _run_mix(
+            impl, frames, as_user=True, chunked=True)
+        assert alive and balanced, impl
+        assert deliveries["user-0"] == [
+            serialize(Broadcast((1,), b"fresh-topic"))], (impl, deliveries)
+        # user-3 is subscribed to topic 1 throughout: gets both broadcasts
+        assert deliveries["user-3"] == [
+            serialize(Broadcast((1,), b"fresh-topic")),
+            serialize(Broadcast((1,), b"after-unsub"))], (impl, deliveries)
+
+
+async def test_depth1_singles_equivalence():
+    """Flushed singles ride the depth-1 Bytes path through the cut-through
+    drain; decisions must still match the scalar loops."""
+    rng = np.random.default_rng(77)
+    frames = _gen_frames(rng, 25, as_user=True)
+    d_native, alive_n, bal_n = await _run_mix("native", frames,
+                                              as_user=True, chunked=False)
+    d_python, alive_p, bal_p = await _run_mix("python", frames,
+                                              as_user=True, chunked=False)
+    assert alive_n == alive_p
+    assert d_native == d_python
+    assert bal_n and bal_p
