@@ -222,10 +222,11 @@ def main() -> int:
                 f"http://127.0.0.1:{metrics_port}/metrics",
                 timeout=5).read().decode()
             for line in body.splitlines():
-                if line.startswith("cdn_bls_pk_cache_") and " " in line:
+                # labeled family: cdn_bls_pk_cache{stat="hits"} 12
+                if line.startswith('cdn_bls_pk_cache{stat="') \
+                        and " " in line:
                     k, v = line.rsplit(" ", 1)
-                    cache_lines[k.replace("cdn_bls_pk_cache_", "")] = \
-                        float(v)
+                    cache_lines[k.split('"')[1]] = float(v)
         except Exception as exc:
             cache_lines = {"scrape_error": repr(exc)}
     finally:

@@ -195,6 +195,36 @@ async def bench_plan(impls, n_users: int, n_frames: int, trials: int) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# tier 3: trace overhead (ISSUE 4) — same forwarding loop, every 1024th
+# frame stamped with the lifecycle-trace wire flag (what a publisher at
+# the default PUSHCDN_TRACE_SAMPLE=1024 produces). Budget: tracing ON
+# within 2% of OFF — traced frames take the instrumented scalar path,
+# the other 1023 stay on the batch plan.
+# ---------------------------------------------------------------------------
+
+async def bench_trace_overhead(impl: str, receivers: int, msgs: int,
+                               trials: int, sample: int = 1024) -> None:
+    from pushcdn_tpu.testing.routebench import forward_rate
+    off = await forward_rate(impl, receivers=receivers, msgs=msgs,
+                             trials=trials)
+    on = await forward_rate(impl, receivers=receivers, msgs=msgs,
+                            trials=trials, trace_every=sample)
+    if off is None or on is None:
+        emit("route/trace_overhead", 0, "skipped", impl=impl,
+             reason="native route-plan kernel unavailable")
+        return
+    emit("route/trace_overhead", off["median"], "msgs/s", impl=impl,
+         trace="off", receivers=receivers, msgs=off["msgs"],
+         trials=[round(r, 1) for r in off["trials"]])
+    emit("route/trace_overhead", on["median"], "msgs/s", impl=impl,
+         trace="on", sample=sample, receivers=receivers, msgs=on["msgs"],
+         trials=[round(r, 1) for r in on["trials"]])
+    if off["median"]:
+        emit("route/trace_overhead", on["median"] / off["median"], "x",
+             impl=impl, tier="on-vs-off")
+
+
+# ---------------------------------------------------------------------------
 # tier 2: end-to-end broker forwarding through the wire
 # ---------------------------------------------------------------------------
 
@@ -240,6 +270,16 @@ async def amain(quick: bool, impl_arg: str) -> None:
     if fwd.get("native") and fwd.get("python"):
         emit("route/ratio", fwd["native"] / fwd["python"], "x",
              tier="forward")
+
+    # trace-overhead A/B on the primary deployment path (native when it
+    # compiled here; otherwise the scalar loops get the same row so the
+    # budget is still tracked)
+    from pushcdn_tpu.native import routeplan
+    trace_impl = "native" if ("native" in impls
+                              and routeplan.available()) else "python"
+    await bench_trace_overhead(
+        trace_impl, receivers=8, msgs=2_000 if quick else 10_000,
+        trials=2 if quick else 3)
 
 
 def main() -> None:

@@ -32,6 +32,11 @@ namespace {
 
 constexpr uint8_t KIND_DIRECT = 4;
 constexpr uint8_t KIND_BROADCAST = 5;
+// Kind-tag high bit: "16-byte trace block follows" (proto/message.py
+// TRACE_FLAG). Traced frames take the instrumented scalar path so span
+// emission lives OFF the batch plan — the plan stops at them exactly like
+// it stops at control frames, and the rest of the chunk stays batched.
+constexpr uint8_t KIND_TRACE_FLAG = 0x80;
 
 constexpr int MASK_WORDS = 4;  // 4 x u64 = the full u8 topic space
 
@@ -225,6 +230,7 @@ int64_t pushcdn_route_plan(
     if (o < 0 || n < 1 || o + n > buf_len) { *stop_reason = 1; break; }
     if (pair_cap - pairs < n_peers) { *stop_reason = 2; break; }
     const uint8_t kind = buf[o];
+    if (kind & KIND_TRACE_FLAG) { *stop_reason = 1; break; }  // traced: scalar
     if (kind == KIND_BROADCAST && n >= 3) {
       const int64_t nt = (int64_t)buf[o + 1] | ((int64_t)buf[o + 2] << 8);
       if (3 + nt > n) { *stop_reason = 1; break; }  // malformed: scalar

@@ -101,7 +101,7 @@ class Connections:
         if existing is not None:
             logger.info("user %s reconnected here; evicting old connection",
                         mnemonic(public_key))
-            self._teardown(existing)
+            self._teardown(existing, "evicted by reconnect")
             self.user_topics.remove_key(public_key)
         self.interest_version += 1
         self.users[public_key] = UserHandle(connection, abort_handle)
@@ -117,7 +117,7 @@ class Connections:
         handle = self.users.pop(public_key, None)
         if handle is None:
             return
-        self._teardown(handle)
+        self._teardown(handle, reason)
         self.interest_version += 1
         self.user_topics.remove_key(public_key)
         # Release our DirectMap claim only if we still hold it — a newer
@@ -145,7 +145,7 @@ class Connections:
         existing = self.brokers.pop(identifier, None)
         if existing is not None:
             logger.info("broker %s reconnected; evicting old link", identifier)
-            self._teardown(existing)
+            self._teardown(existing, "evicted by reconnect")
             self.broker_topics.remove_key(identifier)
         self.interest_version += 1
         self.brokers[identifier] = BrokerHandle(
@@ -157,7 +157,7 @@ class Connections:
         handle = self.brokers.pop(identifier, None)
         if handle is None:
             return
-        self._teardown(handle)
+        self._teardown(handle, reason)
         self.interest_version += 1
         self.broker_topics.remove_key(identifier)
         # Forget (locally, without tombstoning) every user the dead peer
@@ -187,6 +187,8 @@ class Connections:
                           topics: List[Topic]) -> None:
         if public_key in self.users and topics:
             self.interest_version += 1
+            self.users[public_key].connection.flightrec.record(
+                "subscribe", topics)
             self.user_topics.associate_key_with_values(public_key, topics)
             if self.observer is not None:
                 self.observer.on_subscription_changed(
@@ -196,6 +198,9 @@ class Connections:
                               topics: List[Topic]) -> None:
         if topics:
             self.interest_version += 1
+            handle = self.users.get(public_key)
+            if handle is not None:
+                handle.connection.flightrec.record("unsubscribe", topics)
             self.user_topics.dissociate_key_from_values(public_key, topics)
             if self.observer is not None:
                 self.observer.on_subscription_changed(
@@ -293,6 +298,8 @@ class Connections:
         handle = self.brokers.get(from_broker)
         if handle is None:
             return
+        handle.connection.flightrec.record("topic-sync",
+                                           f"{len(payload)} B")
         incoming = VersionedMap.deserialize_entries(payload)
         changed = handle.topic_sync_map.merge(incoming)
         for topic, _old, new in changed:
@@ -303,8 +310,18 @@ class Connections:
 
     # ---- teardown ---------------------------------------------------------
 
-    @staticmethod
-    def _teardown(handle) -> None:
+    # removal reasons that mean "something went wrong" — they arm the
+    # connection's flight recorder so its trail hits the diagnostics log
+    _ABNORMAL_REASONS = frozenset(
+        ("send failed", "user connected elsewhere"))
+
+    @classmethod
+    def _teardown(cls, handle, reason: str = "disconnected") -> None:
+        rec = getattr(handle.connection, "flightrec", None)
+        if rec is not None:
+            rec.record("removed", reason,
+                       abnormal=reason in cls._ABNORMAL_REASONS)
+            rec.maybe_dump(reason)
         if handle.abort_handle is not None:
             handle.abort_handle.abort()
         try:

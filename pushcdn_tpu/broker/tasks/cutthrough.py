@@ -51,6 +51,7 @@ from pushcdn_tpu.broker.tasks.handlers import (
 )
 from pushcdn_tpu.native import routeplan
 from pushcdn_tpu.proto import metrics as metrics_mod
+from pushcdn_tpu.proto import trace as trace_mod
 from pushcdn_tpu.proto.def_ import no_hook
 from pushcdn_tpu.proto.error import Error
 from pushcdn_tpu.proto.limiter import Bytes
@@ -226,7 +227,7 @@ class RouteState:
         ends = np.concatenate((bounds, [len(speers)]))
         buf = chunk.buf
         mv = None
-        sends: list = []  # (is_user, key_or_ident, data, owner)
+        sends: list = []  # (is_user, key_or_ident, data, owner, n_frames)
         for s, e in zip(starts.tolist(), ends.tolist()):
             peer = int(speers[s])
             idx = sframes[s:e]
@@ -249,17 +250,19 @@ class RouteState:
                 owner = None
                 if data is None:  # can't happen on in-range indices
                     continue
-            sends.append((*target, data, owner))
+            sends.append((*target, data, owner, len(idx)))
         # Phase 2 — sends (may await). Connections are looked up by
         # stable identity here, like the scalar flush: a peer that left
         # mid-batch drops its frames; failure ⇒ removal.
-        for is_user_peer, key, data, owner in sends:
+        for is_user_peer, key, data, owner, n_frames in sends:
             if is_user_peer:
                 conn = broker.connections.get_user_connection(key)
             else:
                 conn = broker.connections.get_broker_connection(key)
             if conn is None:
                 continue  # peer left since the plan: drop (scalar parity)
+            (metrics_mod.EGRESS_FRAMES_USER if is_user_peer
+             else metrics_mod.EGRESS_FRAMES_BROKER).inc(n_frames)
             try:
                 await conn.send_encoded(data, owner)
             except asyncio.CancelledError:
@@ -287,14 +290,38 @@ class RouteState:
         broker = self.broker
         topics_space = broker.run_def.topics
         if isinstance(message, Direct):
+            tr = message.trace
+            if tr is not None:
+                trace_mod.emit("ingress", tr, "residual")
+            a0 = egress.appended
             route_direct(broker, message.recipient, raw,
                          to_user_only=not is_user, egress=egress)
+            if tr is not None:
+                # a plan span tagged "dropped" (and no egress span) means
+                # the broker itself dropped the message — unknown
+                # recipient / to-user-only suppression, not a downstream
+                # loss
+                if egress.appended > a0:
+                    trace_mod.emit("plan", tr, "residual")
+                    egress.note_trace(tr)
+                else:
+                    trace_mod.emit("plan", tr, "dropped")
         elif isinstance(message, Broadcast):
+            tr = message.trace
+            if tr is not None:
+                trace_mod.emit("ingress", tr, "residual")
+            a0 = egress.appended
             pruned, _bad = topics_space.prune(message.topics)
             if pruned:
                 route_broadcast(broker, pruned, raw,
                                 to_users_only=not is_user, egress=egress,
                                 interest_cache=interest_cache)
+            if tr is not None:
+                if egress.appended > a0:
+                    trace_mod.emit("plan", tr, "residual")
+                    egress.note_trace(tr)
+                else:
+                    trace_mod.emit("plan", tr, "dropped")
         elif is_user and isinstance(message, Subscribe):
             pruned, bad = topics_space.prune(message.topics)
             if bad:
@@ -318,23 +345,31 @@ class RouteState:
             return False
         return True
 
-    def _log_malformed(self, sender_id, is_user: bool) -> None:
-        """The scalar loops' malformed-frame diagnostics, verbatim."""
+    def _log_malformed(self, sender_id, is_user: bool, conn) -> None:
+        """The scalar loops' malformed-frame diagnostics, verbatim (plus a
+        flight-recorder event, so the disconnect dump shows the trigger).
+        ``conn`` is the drain's OWN connection object — never resolved by
+        identity here, because a quick reconnect swaps the map entry and
+        the event would land on (and arm) the innocent new link."""
         if is_user:
             logger.info("user %s sent malformed frame; disconnecting",
                         mnemonic(sender_id))
         else:
             logger.warning("broker %s sent malformed frame; dropping link",
                            sender_id)
+        if conn is not None:
+            conn.flightrec.record("malformed-frame", abnormal=True)
 
     # -- drains --------------------------------------------------------------
 
     async def route_drain(self, sender_id, items: list,
-                          is_user: bool) -> bool:
+                          is_user: bool, conn=None) -> bool:
         """Route one ``recv_frames()`` drain (a mix of :class:`FrameChunk`
         batches and depth-1 :class:`Bytes` frames), preserving arrival
         order end to end. Returns False when the sender must be
-        disconnected; every item's pool permit is settled either way."""
+        disconnected; every item's pool permit is settled either way.
+        ``conn`` is the sender's own connection (flight-recorder seat for
+        malformed-frame events)."""
         mode = _MODE_USER if is_user else _MODE_BROKER
         egress = EgressBatch(self.broker)
         interest_cache: dict = {}
@@ -353,7 +388,7 @@ class RouteState:
                         try:
                             message = deserialize(item.data)
                         except Error:
-                            self._log_malformed(sender_id, is_user)
+                            self._log_malformed(sender_id, is_user, conn)
                             alive = False
                         else:
                             alive = self._route_one_scalar(
@@ -381,13 +416,13 @@ class RouteState:
                 if usable:
                     alive = await self._route_chunk(sender_id, item, mode,
                                                     is_user, egress,
-                                                    interest_cache)
+                                                    interest_cache, conn)
                 else:
                     # snapshot build failed (allocation): scalar-route the
                     # chunk frame by frame — correctness over speed
                     alive = await self._chunk_scalar(sender_id, item,
                                                      is_user, egress,
-                                                     interest_cache)
+                                                     interest_cache, conn)
                 if not alive:
                     break
         finally:
@@ -400,7 +435,7 @@ class RouteState:
 
     async def _route_chunk(self, sender_id, chunk: FrameChunk, mode: int,
                            is_user: bool, egress: EgressBatch,
-                           interest_cache: dict) -> bool:
+                           interest_cache: dict, conn=None) -> bool:
         """Cut-through one chunk: plan → egress views → residual scalar →
         resume. The chunk's permit is released here (leases keep it alive
         under pending zero-copy flushes)."""
@@ -421,7 +456,7 @@ class RouteState:
                 if not self._refresh():
                     return await self._chunk_scalar_from(
                         sender_id, chunk, offs, lens, pos, is_user,
-                        egress, interest_cache)
+                        egress, interest_cache, conn)
                 consumed, stop, peers, frames = planner.plan(
                     buf, offs, lens, pos, mode)
                 if consumed:
@@ -436,7 +471,7 @@ class RouteState:
                     if consumed == 0:  # cannot make progress (can't
                         return await self._chunk_scalar_from(  # happen:
                             sender_id, chunk, offs, lens, pos,  # cap >=
-                            is_user, egress, interest_cache)    # n_peers)
+                            is_user, egress, interest_cache, conn)
                     continue
                 # STOP_RESIDUAL: the frame at `pos` is a control frame or
                 # malformed — scalar semantics, then re-plan (the control
@@ -447,11 +482,16 @@ class RouteState:
                 try:
                     message = deserialize(memoryview(buf)[o:o + ln])
                 except Error:
-                    self._log_malformed(sender_id, is_user)
+                    self._log_malformed(sender_id, is_user, conn)
                     return False  # malformed ⇒ disconnect/drop link
                 if isinstance(message, (Direct, Broadcast)):
-                    # defensive only: a well-formed hot frame never stops
-                    # the plan; route it scalar-wise to stay correct
+                    # TRACED hot frames stop the plan on the kind-tag flag
+                    # bit (route_plan.cpp) and take this instrumented
+                    # scalar path — the raw frame (flag + trace block
+                    # intact) is forwarded verbatim so receivers emit the
+                    # delivery span; the rest of the chunk stays batched.
+                    # Untraced well-formed hot frames never stop the plan
+                    # (this branch is then defensive only).
                     frame = Bytes(buf[o:o + ln])
                     alive = self._route_one_scalar(sender_id, message,
                                                    frame, is_user, egress,
@@ -463,6 +503,12 @@ class RouteState:
                                                    interest_cache)
                 if not alive:
                     return False
+                # A residual hot frame (traced, or the defensive case)
+                # landed in the egress ACCUMULATOR; the resumed plan's
+                # _send_plan enqueues straight to the writers, so flush
+                # now or the rest of the chunk overtakes it on the wire.
+                # No-op for control frames (empty accumulator).
+                await egress.flush()
                 pos += 1  # loop top revalidates the (likely bumped) snapshot
         finally:
             chunk.release()
@@ -470,20 +516,20 @@ class RouteState:
 
     async def _chunk_scalar(self, sender_id, chunk: FrameChunk,
                             is_user: bool, egress: EgressBatch,
-                            interest_cache: dict) -> bool:
+                            interest_cache: dict, conn=None) -> bool:
         offs = np.asarray(chunk.offs, np.int64)
         lens = np.asarray(chunk.lens, np.int64)
         try:
             return await self._chunk_scalar_from(
                 sender_id, chunk, offs, lens, chunk._pos, is_user, egress,
-                interest_cache)
+                interest_cache, conn)
         finally:
             chunk.release()
 
     async def _chunk_scalar_from(self, sender_id, chunk: FrameChunk,
                                  offs, lens, pos: int, is_user: bool,
                                  egress: EgressBatch,
-                                 interest_cache: dict) -> bool:
+                                 interest_cache: dict, conn=None) -> bool:
         """Scalar fallback over a chunk's remaining frames (snapshot build
         failed). Mirrors the handlers.py loop bodies exactly."""
         buf = chunk.buf
@@ -493,7 +539,7 @@ class RouteState:
             try:
                 message = deserialize(memoryview(buf)[o:o + ln])
             except Error:
-                self._log_malformed(sender_id, is_user)
+                self._log_malformed(sender_id, is_user, conn)
                 return False
             if isinstance(message, (Direct, Broadcast)):
                 frame = Bytes(buf[o:o + ln])

@@ -26,10 +26,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import TYPE_CHECKING, List, Optional, Sequence
 
 from pushcdn_tpu.broker.staging import StageResult
 from pushcdn_tpu.proto import metrics as metrics_mod
+from pushcdn_tpu.proto import trace as trace_mod
 from pushcdn_tpu.proto.def_ import HookResult
 from pushcdn_tpu.proto.error import Error
 from pushcdn_tpu.proto.limiter import Bytes
@@ -60,26 +62,48 @@ class EgressBatch:
     ``send_raw_many`` (one queue entry, one writer wakeup). Per-peer frame
     order is the processing order, so per-(sender→receiver) ordering is
     identical to the per-frame path. Failure ⇒ removal semantics are the
-    senders' (sender.rs:17-58)."""
+    senders' (sender.rs:17-58).
 
-    __slots__ = ("broker", "users", "brokers")
+    Lifecycle tracing: a routed TRACED message notes its context here
+    (:meth:`note_trace`); ``flush()`` emits the ``egress`` span when the
+    batch has been handed to every peer's writer queue. Deliberately NOT
+    a wire-flush wait: forcing ``flush=True`` would let one backpressured
+    peer head-of-line-block the sender's whole receive drain for up to
+    the write timeout on every sampled message — the wire-side residence
+    is observable via ``cdn_writer_queue_depth`` and the receiver's
+    ``delivery`` span instead. ``appended`` counts fan-out clones routed
+    into the batch, so span emission can tell a routed message from a
+    dropped one (unknown recipient, no interest)."""
+
+    __slots__ = ("broker", "users", "brokers", "appended", "_traces")
 
     def __init__(self, broker: "Broker"):
         self.broker = broker
         self.users: dict = {}
         self.brokers: dict = {}
+        self.appended = 0
+        self._traces: Optional[list] = None
+
+    def note_trace(self, tr) -> None:
+        """Remember a traced message routed into this batch; its egress
+        span is emitted when the batch flushes."""
+        if self._traces is None:
+            self._traces = []
+        self._traces.append((tr, time.monotonic()))
 
     def to_user(self, public_key: bytes, raw: Bytes) -> None:
         lst = self.users.get(public_key)
         if lst is None:
             lst = self.users[public_key] = []
         lst.append(raw.clone())
+        self.appended += 1
 
     def to_broker(self, identifier: str, raw: Bytes) -> None:
         lst = self.brokers.get(identifier)
         if lst is None:
             lst = self.brokers[identifier] = []
         lst.append(raw.clone())
+        self.appended += 1
 
     def release_all(self) -> None:
         for frames in self.users.values():
@@ -114,6 +138,7 @@ class EgressBatch:
 
     async def flush(self) -> None:
         broker = self.broker
+        traces, self._traces = self._traces, None
         try:
             # brokers first (reference fan-out order, handler.rs:240-272)
             while self.brokers:
@@ -123,6 +148,7 @@ class EgressBatch:
                     for f in frames:
                         f.release()
                     continue
+                metrics_mod.EGRESS_FRAMES_BROKER.inc(len(frames))
                 try:
                     await self._send_batch(conn, frames)
                 except asyncio.CancelledError:
@@ -140,6 +166,7 @@ class EgressBatch:
                     for f in frames:
                         f.release()
                     continue
+                metrics_mod.EGRESS_FRAMES_USER.inc(len(frames))
                 try:
                     await self._send_batch(conn, frames)
                 except asyncio.CancelledError:
@@ -154,6 +181,47 @@ class EgressBatch:
             # peers' clones must still return their pool permits
             self.release_all()
             raise
+        if traces:
+            # the whole batch is in the peers' writer queues: that handoff
+            # IS the egress hop (wire residence is visible via
+            # cdn_writer_queue_depth and the receiver's delivery span)
+            now = time.monotonic()
+            for tr, t0 in traces:
+                trace_mod.emit("egress", tr,
+                               f"writer-handoff {now - t0:.6f}s")
+
+
+def _emit_staged_trace(message) -> None:
+    """Span emission for a traced message the DEVICE plane accepted: the
+    frame rides the staging ring and the device egress verbatim (flag +
+    trace block intact — the receiver still emits ``delivery``), so the
+    broker-side hops collapse to the stage handoff: ``plan`` = the stage
+    decision, ``egress`` = handed to the device pump's egress (the pump
+    itself is a batched jitted step with no per-message seam)."""
+    tr = message.trace
+    if tr is not None:
+        trace_mod.emit("ingress", tr, "device")
+        trace_mod.emit("plan", tr, "device-staged")
+        trace_mod.emit("egress", tr, "device-staged")
+
+
+def _emit_scalar_trace(message, egress: EgressBatch, before: int) -> None:
+    """Span emission for a traced message routed by the scalar loops:
+    ingress and plan collapse to adjacent instants (the scalar body is one
+    synchronous block), the egress span completes at batch flush. One
+    class-attribute load for the untraced 1023/1024. ``before`` is
+    ``egress.appended`` captured before the route call — a message the
+    route decision DROPPED (unknown recipient, no interest) gets its plan
+    span tagged ``dropped`` and NO egress span, so a chain ending at
+    ``plan`` means the broker itself dropped the message."""
+    tr = message.trace
+    if tr is not None:
+        trace_mod.emit("ingress", tr, "scalar")
+        if egress.appended > before:
+            trace_mod.emit("plan", tr, "scalar")
+            egress.note_trace(tr)
+        else:
+            trace_mod.emit("plan", tr, "dropped")
 
 
 def route_direct(broker: "Broker", recipient: bytes, raw: Bytes,
@@ -267,7 +335,8 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
             if cut is not None:
                 items = await connection.recv_frames()
                 alive = await cut.route_drain(public_key, items,
-                                              is_user=True)
+                                              is_user=True,
+                                              conn=connection)
                 continue
             raws = await connection.recv_raw_many()
             metrics_mod.ROUTE_SCALAR_FRAMES.inc(len(raws))
@@ -288,6 +357,8 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                         logger.info(
                             "user %s sent malformed frame; disconnecting",
                             mnemonic(public_key))
+                        connection.flightrec.record("malformed-frame",
+                                                    abnormal=True)
                         alive = False
                         break
                     result = hook(public_key, message)
@@ -304,18 +375,22 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                         if device is not None:
                             stage_items.append((message, raw, None))
                             continue
+                        a0 = egress.appended
                         route_direct(broker, message.recipient, raw,
                                      to_user_only=False, egress=egress)
+                        _emit_scalar_trace(message, egress, a0)
                     elif isinstance(message, Broadcast):
                         pruned, _bad = topics.prune(message.topics)
                         if pruned:
                             if device is not None:
                                 stage_items.append((message, raw, pruned))
                                 continue
+                            a0 = egress.appended
                             route_broadcast(
                                 broker, pruned, raw, to_users_only=False,
                                 egress=egress,
                                 interest_cache=interest_cache)
+                            _emit_scalar_trace(message, egress, a0)
                     elif isinstance(message, Subscribe):
                         pruned, bad = topics.prune(message.topics)
                         if bad:
@@ -346,16 +421,21 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                             res = await _stage_with_backpressure(
                                 device, message, raw)
                         staged = res == StageResult.STAGED
+                        if staged:
+                            _emit_staged_trace(message)
                         if isinstance(message, Direct):
                             if not staged:
+                                a0 = egress.appended
                                 route_direct(broker, message.recipient, raw,
                                              to_user_only=False,
                                              egress=egress)
+                                _emit_scalar_trace(message, egress, a0)
                         else:
                             # host side: remaining fan-out — all of it when
                             # not staged; only out-of-group/interest
                             # forwarding when the device covers users
                             # (+ group peers over ICI)
+                            a0 = egress.appended
                             route_broadcast(
                                 broker, pruned, raw, to_users_only=False,
                                 egress=egress, users_via_device=staged,
@@ -364,6 +444,8 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                                         device.covered_broker_idents())
                                     if staged else frozenset()),
                                 interest_cache=interest_cache)
+                            if not staged:
+                                _emit_scalar_trace(message, egress, a0)
             finally:
                 try:
                     await egress.flush()
@@ -404,7 +486,8 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
             if cut is not None:
                 items = await connection.recv_frames()
                 alive = await cut.route_drain(identifier, items,
-                                              is_user=False)
+                                              is_user=False,
+                                              conn=connection)
                 continue
             raws = await connection.recv_raw_many()
             metrics_mod.ROUTE_SCALAR_FRAMES.inc(len(raws))
@@ -427,6 +510,8 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                         logger.warning(
                             "broker %s sent malformed frame; dropping link",
                             identifier)
+                        connection.flightrec.record("malformed-frame",
+                                                    abnormal=True)
                         alive = False
                         break
                     result = hook(identifier, message)
@@ -444,8 +529,10 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                         if single_shard:
                             stage_items.append((message, raw, None))
                             continue
+                        a0 = egress.appended
                         route_direct(broker, message.recipient, raw,
                                      to_user_only=True, egress=egress)
+                        _emit_scalar_trace(message, egress, a0)
                     elif isinstance(message, Broadcast):
                         # users only — prevents broadcast loops
                         # (broker/handler.rs:156-161)
@@ -454,10 +541,12 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                             if single_shard:
                                 stage_items.append((message, raw, pruned))
                                 continue
+                            a0 = egress.appended
                             route_broadcast(broker, pruned, raw,
                                             to_users_only=True,
                                             egress=egress,
                                             interest_cache=interest_cache)
+                            _emit_scalar_trace(message, egress, a0)
                     elif isinstance(message, UserSync):
                         broker.connections.apply_user_sync(message.payload)
                         broker.update_metrics()
@@ -480,7 +569,9 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                             res = await _stage_with_backpressure(
                                 device, message, raw)
                         if res == StageResult.STAGED:
+                            _emit_staged_trace(message)
                             continue
+                        a0 = egress.appended
                         if isinstance(message, Direct):
                             route_direct(broker, message.recipient, raw,
                                          to_user_only=True, egress=egress)
@@ -489,6 +580,7 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                                             to_users_only=True,
                                             egress=egress,
                                             interest_cache=interest_cache)
+                        _emit_scalar_trace(message, egress, a0)
             finally:
                 try:
                     await egress.flush()

@@ -51,6 +51,8 @@ async def handle_user_connection(broker: "Broker", unfinalized) -> None:
             connection.close()
             return
 
+        connection.flightrec.label += f" user={mnemonic(public_key)}"
+        connection.flightrec.record("auth-ok", mnemonic(public_key))
         loop_task = asyncio.create_task(
             user_receive_loop(broker, public_key, connection))
         broker.connections.add_user(public_key, connection, pruned,
@@ -66,6 +68,9 @@ async def handle_user_connection(broker: "Broker", unfinalized) -> None:
     except (Error, asyncio.TimeoutError) as exc:
         logger.info("user connection failed auth: %r", exc)
         if connection is not None:
+            # routine under connection storms: recorded (visible at
+            # /debug/flightrec while the handle lives) but not dumped
+            connection.flightrec.record("auth-fail", repr(exc))
             connection.close()
     except asyncio.CancelledError:
         if connection is not None:
@@ -112,6 +117,8 @@ async def handle_broker_connection(broker: "Broker", connection_or_unfinalized,
             connection.close()
             return
 
+        connection.flightrec.label += f" broker={peer_id}"
+        connection.flightrec.record("auth-ok", peer_id)
         loop_task = asyncio.create_task(
             broker_receive_loop(broker, peer_id, connection))
         broker.connections.add_broker(peer_id, connection,

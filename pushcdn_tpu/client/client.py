@@ -25,6 +25,7 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Set, Type
 
+from pushcdn_tpu.proto import trace as trace_mod
 from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME, KeyPair, SignatureScheme
 from pushcdn_tpu.proto.error import Error, ErrorKind, bail
 from pushcdn_tpu.proto.limiter import Limiter, NO_LIMIT
@@ -37,6 +38,7 @@ from pushcdn_tpu.proto.message import (
     Unsubscribe,
     deserialize_owned,
     serialize,
+    with_trace,
 )
 from pushcdn_tpu.proto.transport.base import Connection, Protocol
 
@@ -67,12 +69,21 @@ class Client:
         self._topics: Set[int] = set(config.subscribed_topics)
         self._connection: Optional[Connection] = None
         self._reconnect_sem = asyncio.Semaphore(1)  # single-flight guard
+        # lifecycle tracing: deterministic 1-in-N publish sampler; the
+        # first publish after a (re)connect reuses the connection's trace
+        # id so the marshal-auth span chains to a message lifecycle
+        self._sampler = trace_mod.Sampler()
 
     # -- connection management ---------------------------------------------
 
     async def _connect_once(self) -> Connection:
         """One full marshal→broker dance (ClientRef::connect, lib.rs:79-121)."""
         c = self.config
+        # lifecycle tracing: the connection trace originates at dial time;
+        # the marshal stamps the auth span on it, and the first publish
+        # after connect reuses the id (a complete chain per connect under
+        # any sampling rate)
+        conn_trace = trace_mod.new_trace() if trace_mod.ENABLED else None
         # hop 1: marshal — the timestamp signature (pure CPU; ~0.13 ms for
         # a pairing scheme) is computed WHILE the dial waits on the
         # marshal's accept, so the two costs overlap instead of adding.
@@ -99,7 +110,8 @@ class Client:
             presigned = None  # authenticate_with_marshal signs fresh
         try:
             permit, broker_endpoint = await user_auth.authenticate_with_marshal(
-                marshal_conn, c.scheme, c.keypair, presigned=presigned)
+                marshal_conn, c.scheme, c.keypair, presigned=presigned,
+                trace=conn_trace)
         finally:
             marshal_conn.close()
         # hop 2: the assigned broker
@@ -111,6 +123,13 @@ class Client:
         except BaseException:
             broker_conn.close()
             raise
+        if conn_trace is not None:
+            # the first publish reuses the connection trace id; the AUTH
+            # span is the MARSHAL's to emit (server-side stamp/strip) —
+            # a client-side twin would double-populate the hop histogram
+            # with a second latency population and let the chain check
+            # pass even when the marshal path is broken
+            self._sampler.pending = conn_trace[0]
         logger.info("connected to broker at %s", broker_endpoint)
         return broker_conn
 
@@ -151,6 +170,15 @@ class Client:
         conn = self._connection  # fast path: live connection, no coroutine
         if conn is None or conn.is_closed:
             conn = await self._get_connection()
+        # sampled lifecycle tracing: every Nth hot message is stamped with
+        # a trace context (one class-attr check + one counter inc on the
+        # untraced 1023/1024; nothing at all when tracing is disabled)
+        if trace_mod.ENABLED and message.kind in (Broadcast.kind, Direct.kind) \
+                and message.trace is None:
+            tr = self._sampler.next_trace()
+            if tr is not None:
+                message = with_trace(message, tr)
+                trace_mod.emit("publish", tr, f"{len(message.message)} B")
         try:
             await conn.send_message(message)
         except Exception as exc:
@@ -171,10 +199,15 @@ class Client:
         if conn is None or conn.is_closed:
             conn = await self._get_connection()
         try:
-            return await conn.recv_message()
+            message = await conn.recv_message()
         except Exception as exc:
             self._disconnect_on_error()
             bail(ErrorKind.CONNECTION, "receive failed; connection reset", exc)
+        if trace_mod.ENABLED:
+            tr = getattr(message, "trace", None)
+            if tr is not None:
+                trace_mod.emit("delivery", tr)
+        return message
 
     async def receive_messages(self, max_messages: int = 1024
                                ) -> List[Message]:
@@ -214,6 +247,11 @@ class Client:
         finally:
             for item in items:
                 item.release()
+        if trace_mod.ENABLED:
+            for m in out:
+                tr = getattr(m, "trace", None)
+                if tr is not None:
+                    trace_mod.emit("delivery", tr)
         return out
 
     # -- subscriptions -------------------------------------------------------

@@ -99,6 +99,8 @@ class Marshal:
         except (Error, asyncio.TimeoutError) as exc:
             logger.info("marshal auth failed: %r", exc)
             if connection is not None:
+                # routine under storms: recorded, not dumped
+                connection.flightrec.record("auth-fail", repr(exc))
                 connection.close()
         except asyncio.CancelledError:
             if connection is not None:

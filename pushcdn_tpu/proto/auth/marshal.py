@@ -13,11 +13,16 @@ from __future__ import annotations
 import time
 from typing import Tuple, Type
 
+from pushcdn_tpu.proto import trace as trace_mod
 from pushcdn_tpu.proto.auth.user import signable_timestamp
 from pushcdn_tpu.proto.crypto.signature import Namespace, SignatureScheme
 from pushcdn_tpu.proto.discovery.base import DiscoveryClient
 from pushcdn_tpu.proto.error import ErrorKind, bail
-from pushcdn_tpu.proto.message import AuthenticateResponse, AuthenticateWithKey
+from pushcdn_tpu.proto.message import (
+    AuthenticateResponse,
+    AuthenticateWithKey,
+    deserialize_owned,
+)
 from pushcdn_tpu.proto.transport.base import Connection
 
 # parity constants (marshal.rs:76-83, :121-135)
@@ -44,7 +49,16 @@ async def verify_user(connection: Connection, discovery: DiscoveryClient,
     crypto.batch.BatchVerifier) amortizes concurrent pairing checks under
     connection storms; semantics are identical to ``scheme.verify``.
     """
-    message = await connection.recv_message()
+    # Frame-level trace strip: a client that sampled this connection set
+    # the kind-tag trace flag on its auth frame (proto.trace); the span is
+    # emitted after a SUCCESSFUL verification, so the auth hop measures
+    # dial + handshake + verify from the client's dial-time origin.
+    raw = await connection.recv_raw()
+    try:
+        frame, auth_trace = trace_mod.strip_frame(raw.data)
+    finally:
+        raw.release()
+    message = deserialize_owned(frame)
     if not isinstance(message, AuthenticateWithKey):
         await _reject(connection, "expected AuthenticateWithKey")
 
@@ -80,4 +94,7 @@ async def verify_user(connection: Connection, discovery: DiscoveryClient,
         AuthenticateResponse(permit=permit,
                              context=broker.public_advertise_endpoint),
         flush=True)
+    connection.flightrec.record("auth-ok")
+    if auth_trace is not None:
+        trace_mod.emit("auth", auth_trace, "marshal-verify")
     return message.public_key, permit

@@ -51,16 +51,26 @@ def presign_timestamp(scheme: Type[SignatureScheme],
 async def authenticate_with_marshal(
         connection: Connection, scheme: Type[SignatureScheme],
         keypair: KeyPair,
-        presigned: Tuple[int, bytes] | None = None) -> Tuple[int, str]:
+        presigned: Tuple[int, bytes] | None = None,
+        trace=None) -> Tuple[int, str]:
     """Returns ``(permit, broker_public_endpoint)`` or raises
     ``Error(AUTHENTICATION)``. ``presigned`` is an optional
     :func:`presign_timestamp` result computed while the dial was in
-    flight (the connect-latency overlap)."""
+    flight (the connect-latency overlap). ``trace`` is an optional
+    lifecycle-trace context ``(trace_id, origin_ns)``: the auth frame is
+    stamped with it (kind-tag flag bit + 16-byte block) so the marshal
+    emits the ``auth`` span on the same trace id the client's first
+    published message will carry."""
+    from pushcdn_tpu.proto import trace as trace_mod
+    from pushcdn_tpu.proto.message import serialize
     timestamp, signature = (presigned if presigned is not None
                             else presign_timestamp(scheme, keypair))
-    await connection.send_message(AuthenticateWithKey(
+    frame = serialize(AuthenticateWithKey(
         public_key=keypair.public_key, timestamp=timestamp,
-        signature=signature), flush=True)
+        signature=signature))
+    if trace is not None:
+        frame = trace_mod.stamp_frame(frame, trace)
+    await connection.send_raw(frame, flush=True)
 
     response = await connection.recv_message()
     if not isinstance(response, AuthenticateResponse):

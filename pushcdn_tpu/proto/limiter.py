@@ -24,9 +24,14 @@ from __future__ import annotations
 
 import asyncio
 import time
+import weakref
 from typing import Optional
 
 from pushcdn_tpu.proto.error import ErrorKind, bail
+
+# Live pools, for the metrics pre-render occupancy gauge
+# (cdn_pool_bytes{state=...}); weak so a dropped Limiter's pool vanishes.
+LIVE_POOLS: "weakref.WeakSet[MemoryPool]" = weakref.WeakSet()
 
 
 class _ByteSemaphore:
@@ -197,6 +202,7 @@ class MemoryPool:
         # latency proxy: permit alloc→release lifetimes (metrics hook)
         self.latency_samples: list[float] = []
         self._latency_cap = 4096
+        LIVE_POOLS.add(self)
 
     async def allocate(self, nbytes: int) -> AllocationPermit:
         """Reserve ``nbytes``; blocks (backpressuring the reader) until the

@@ -51,6 +51,19 @@ _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 _U16 = struct.Struct("<H")
 
+# --- trace carrier (ISSUE 4) ----------------------------------------------
+# The kind tag's high bit was reserved (kinds are 1-9): setting it means a
+# fixed <u64 trace_id, u64 origin_ns> block follows the kind byte, then the
+# frame continues exactly as before. Untraced frames are byte-identical to
+# the pre-trace wire and pay zero decode work (hot dispatch tests exact
+# kind values). Only Direct/Broadcast decode the flag here; the marshal
+# auth frame carries it at the frame level (proto.trace.stamp/strip_frame).
+TRACE_FLAG = 0x80
+# the single source of truth for the block layout — proto.trace imports it
+TRACE_BLOCK = struct.Struct("<QQ")
+_TRACE_BLOCK = TRACE_BLOCK
+_TRACED_HOT = frozenset((KIND_DIRECT | TRACE_FLAG, KIND_BROADCAST | TRACE_FLAG))
+
 
 @dataclass(frozen=True, slots=True)
 class AuthenticateWithKey:
@@ -103,6 +116,9 @@ class Direct:
     __slots__ = ("recipient", "message")
 
     kind = KIND_DIRECT
+    # lifecycle-trace context; None on the untraced hot path (class
+    # attribute, so plain Directs pay nothing — see TracedDirect)
+    trace = None
 
     def __init__(self, recipient: bytes, message: BytesLike):
         self.recipient = recipient
@@ -130,6 +146,7 @@ class Broadcast:
     __slots__ = ("topics", "message")
 
     kind = KIND_BROADCAST
+    trace = None  # see Direct.trace
 
     def __init__(self, topics: Sequence[Topic], message: BytesLike):
         self.topics = topics if type(topics) is tuple else tuple(topics)
@@ -144,6 +161,53 @@ class Broadcast:
 
     def __repr__(self):
         return f"Broadcast(topics={self.topics!r}, <{len(self.message)} B>)"
+
+
+class TracedDirect(Direct):
+    """A :class:`Direct` carrying a lifecycle-trace context
+    ``(trace_id, origin_ns)``. Same ``kind``; ``isinstance(m, Direct)``
+    still matches, so routing treats it as a plain Direct — span-emission
+    sites branch on ``m.trace is not None``."""
+
+    __slots__ = ("trace",)
+
+    def __init__(self, recipient: bytes, message: BytesLike, trace):
+        self.recipient = recipient
+        self.message = message
+        self.trace = trace
+
+    def __eq__(self, other):
+        return (isinstance(other, Direct)
+                and self.recipient == other.recipient
+                and self.message == other.message)
+
+    __hash__ = Direct.__hash__
+
+    def __repr__(self):
+        return (f"TracedDirect(recipient={self.recipient!r}, "
+                f"<{len(self.message)} B>, trace={self.trace!r})")
+
+
+class TracedBroadcast(Broadcast):
+    """A :class:`Broadcast` carrying a lifecycle-trace context (see
+    :class:`TracedDirect`)."""
+
+    __slots__ = ("trace",)
+
+    def __init__(self, topics: Sequence[Topic], message: BytesLike, trace):
+        self.topics = topics if type(topics) is tuple else tuple(topics)
+        self.message = message
+        self.trace = trace
+
+    def __eq__(self, other):
+        return (isinstance(other, Broadcast) and self.topics == other.topics
+                and self.message == other.message)
+
+    __hash__ = Broadcast.__hash__
+
+    def __repr__(self):
+        return (f"TracedBroadcast(topics={self.topics!r}, "
+                f"<{len(self.message)} B>, trace={self.trace!r})")
 
 
 @dataclass(frozen=True, slots=True)
@@ -229,12 +293,24 @@ def serialize(msg: Message) -> bytes:
     try:
         if kind == KIND_DIRECT:
             recipient = msg.recipient
-            frame = b"".join((b"\x04", _U32.pack(len(recipient)), recipient,
-                              msg.message))
+            trace = msg.trace
+            if trace is None:
+                frame = b"".join((b"\x04", _U32.pack(len(recipient)),
+                                  recipient, msg.message))
+            else:
+                frame = b"".join((b"\x84", _TRACE_BLOCK.pack(*trace),
+                                  _U32.pack(len(recipient)), recipient,
+                                  msg.message))
         elif kind == KIND_BROADCAST:
             topics = msg.topics
-            frame = b"".join((b"\x05", _U16.pack(len(topics)), bytes(topics),
-                              msg.message))
+            trace = msg.trace
+            if trace is None:
+                frame = b"".join((b"\x05", _U16.pack(len(topics)),
+                                  bytes(topics), msg.message))
+            else:
+                frame = b"".join((b"\x85", _TRACE_BLOCK.pack(*trace),
+                                  _U16.pack(len(topics)), bytes(topics),
+                                  msg.message))
         elif kind in (KIND_SUBSCRIBE, KIND_UNSUBSCRIBE):
             topics = msg.topics
             out = bytearray(1 + 2 + len(topics))
@@ -339,6 +415,26 @@ def deserialize(frame: BytesLike) -> Message:
                 bail(ErrorKind.DESERIALIZE,
                      "AuthenticateResponse context is not UTF-8", exc)
             return AuthenticateResponse(permit=permit, context=context)
+        if kind in _TRACED_HOT:
+            # traced hot frame: 16-byte trace block after the kind byte,
+            # then the ordinary layout (rare by construction: 1/1024
+            # default sampling)
+            off = 1 + _TRACE_BLOCK.size
+            if n < off:
+                bail(ErrorKind.DESERIALIZE, "truncated trace block")
+            trace = _TRACE_BLOCK.unpack_from(view, 1)
+            if kind & ~TRACE_FLAG == KIND_DIRECT:
+                (rlen,) = _U32.unpack_from(view, off)
+                p = off + 4 + rlen
+                if p > n:
+                    bail(ErrorKind.DESERIALIZE,
+                         "Direct recipient overruns frame")
+                return TracedDirect(bytes(view[off + 4:p]), view[p:], trace)
+            (ntopics,) = _U16.unpack_from(view, off)
+            p = off + 2 + ntopics
+            if p > n:
+                bail(ErrorKind.DESERIALIZE, "Broadcast topics overrun frame")
+            return TracedBroadcast(tuple(view[off + 2:p]), view[p:], trace)
     except struct.error as exc:
         bail(ErrorKind.DESERIALIZE, f"truncated frame for kind {kind}", exc)
     bail(ErrorKind.DESERIALIZE, f"unknown message kind {kind}")
@@ -355,8 +451,12 @@ def materialize(msg: Message) -> Message:
     """
     kind = msg.kind
     if kind == KIND_DIRECT and isinstance(msg.message, memoryview):
+        if msg.trace is not None:
+            return TracedDirect(msg.recipient, bytes(msg.message), msg.trace)
         return Direct(recipient=msg.recipient, message=bytes(msg.message))
     if kind == KIND_BROADCAST and isinstance(msg.message, memoryview):
+        if msg.trace is not None:
+            return TracedBroadcast(msg.topics, bytes(msg.message), msg.trace)
         return Broadcast(topics=msg.topics, message=bytes(msg.message))
     if kind in (KIND_USER_SYNC, KIND_TOPIC_SYNC) and isinstance(msg.payload, memoryview):
         cls = UserSync if kind == KIND_USER_SYNC else TopicSync
@@ -456,6 +556,18 @@ def decode_frames(buf: bytes, offs, lens, start: int = 0) -> list:
                     continue
         append(deserialize_owned(bytes(buf[o:o + n])))
     return out
+
+
+def with_trace(msg: Message, trace) -> Message:
+    """The traced twin of a hot message (Direct/Broadcast); other kinds
+    are returned unchanged (their frames carry traces at the frame level
+    only — see proto.trace.stamp_frame)."""
+    kind = msg.kind
+    if kind == KIND_DIRECT:
+        return TracedDirect(msg.recipient, msg.message, trace)
+    if kind == KIND_BROADCAST:
+        return TracedBroadcast(msg.topics, msg.message, trace)
+    return msg
 
 
 def peek_kind(frame: BytesLike) -> int:

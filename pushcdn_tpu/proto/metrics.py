@@ -8,91 +8,210 @@ LATENCY histogram of permit-allocation lifetime).
 Dependency-free: a tiny registry + asyncio HTTP server producing the
 Prometheus text format. Metrics are always collected (cheap int adds); the
 endpoint is opt-in per binary, matching the reference's `metrics` feature.
+
+Label support (ISSUE 4 registry upgrade): every metric type takes an
+optional ``labels=(...)`` tuple of label NAMES; ``m.labels(name=value)``
+returns (creating on first use) a child series that renders as
+``name{label="value"} v`` and exposes the same mutator API — call sites
+hold the child and pay a plain attribute call per update, exactly like
+before. A labeled Counter also renders a bare total line (own value + the
+children's sum) so pre-label dashboards keep working.
+
+Thread-safety: mutators (``inc``/``set``/``observe``) and child creation
+take one process-wide lock — native-code callers and bench threads observe
+from off-loop threads, and an unlocked ``Histogram.observe`` loses updates
+in its sum/bucket read-modify-write. The lock is uncontended in steady
+state (hot paths update per *batch*, not per frame) and a render takes it
+per-metric, so a scrape racing live updates sees each metric atomically.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import sys
 import threading
-import time
-from typing import Dict, List, Optional
+from typing import Dict, List
+
+_LOCK = threading.Lock()
 
 
-class Counter:
+def _escape_label(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+class _LabeledMixin:
+    """Shared child-series machinery. ``self._label_names`` is the declared
+    label-name tuple (empty = unlabeled); ``self._labels`` is this series'
+    own rendered ``k="v"`` pair string (children only)."""
+
+    def _init_labels(self, labels) -> None:
+        self._label_names = tuple(labels)
+        self._labels = ""
+        self._children: Dict[tuple, "_LabeledMixin"] = {}
+
+    def labels(self, **kv):
+        """The child series for these label values (create on first use).
+        Raises ``KeyError`` on a label name that was not declared."""
+        try:
+            key = tuple(str(kv[n]) for n in self._label_names)
+        except KeyError:
+            raise KeyError(f"{self.name}: labels() requires exactly "
+                           f"{self._label_names}, got {tuple(kv)}") from None
+        if len(kv) != len(self._label_names):
+            raise KeyError(f"{self.name}: labels() requires exactly "
+                           f"{self._label_names}, got {tuple(kv)}")
+        child = self._children.get(key)
+        if child is None:
+            with _LOCK:
+                child = self._children.get(key)
+                if child is None:
+                    child = self._new_child()
+                    child._labels = ",".join(
+                        f'{n}="{_escape_label(v)}"'
+                        for n, v in zip(self._label_names, key))
+                    self._children[key] = child
+        return child
+
+    def _sorted_children(self):
+        return [self._children[k] for k in sorted(self._children)]
+
+
+class Counter(_LabeledMixin):
     """Monotonic counter (exposed as prometheus counter)."""
 
-    def __init__(self, name: str, help_: str):
+    def __init__(self, name: str, help_: str, labels=()):
         self.name = name
         self.help = help_
         self.value = 0
+        self._init_labels(labels)
         _REGISTRY[name] = self
 
+    def _new_child(self) -> "Counter":
+        child = Counter.__new__(Counter)
+        child.name, child.help, child.value = self.name, self.help, 0
+        child._init_labels(())
+        return child
+
     def inc(self, n: int = 1) -> None:
-        self.value += n
+        with _LOCK:
+            self.value += n
 
     def render(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} counter\n"
-                f"{self.name} {self.value}\n")
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        with _LOCK:
+            total = self.value
+            for child in self._sorted_children():
+                total += child.value
+                out.append(f"{self.name}{{{child._labels}}} {child.value}")
+            out.append(f"{self.name} {total}")
+        return "\n".join(out) + "\n"
 
 
-class Gauge:
+class Gauge(_LabeledMixin):
     """Settable gauge."""
 
-    def __init__(self, name: str, help_: str):
+    def __init__(self, name: str, help_: str, labels=()):
         self.name = name
         self.help = help_
         self.value = 0.0
+        self._init_labels(labels)
         _REGISTRY[name] = self
 
+    def _new_child(self) -> "Gauge":
+        child = Gauge.__new__(Gauge)
+        child.name, child.help, child.value = self.name, self.help, 0.0
+        child._init_labels(())
+        return child
+
     def set(self, v: float) -> None:
-        self.value = v
+        with _LOCK:
+            self.value = v
 
     def inc(self, n: float = 1) -> None:
-        self.value += n
+        with _LOCK:
+            self.value += n
 
     def dec(self, n: float = 1) -> None:
-        self.value -= n
+        with _LOCK:
+            self.value -= n
 
     def render(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} gauge\n"
-                f"{self.name} {self.value}\n")
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        with _LOCK:
+            for child in self._sorted_children():
+                out.append(f"{self.name}{{{child._labels}}} {child.value}")
+            if not self._label_names:
+                out.append(f"{self.name} {self.value}")
+            elif not self._children:
+                # labeled gauge with no series yet: render nothing (a bare
+                # 0 under set-semantics would be a lie)
+                pass
+        return "\n".join(out) + "\n"
 
 
-class Histogram:
+class Histogram(_LabeledMixin):
     """Fixed-bucket histogram (seconds)."""
 
     DEFAULT_BUCKETS = (1e-5, 1e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
 
-    def __init__(self, name: str, help_: str, buckets=DEFAULT_BUCKETS):
+    def __init__(self, name: str, help_: str, buckets=DEFAULT_BUCKETS,
+                 labels=()):
         self.name = name
         self.help = help_
         self.buckets = tuple(buckets)
         self.counts = [0] * (len(self.buckets) + 1)
         self.sum = 0.0
         self.total = 0
+        self._init_labels(labels)
         _REGISTRY[name] = self
 
-    def observe(self, v: float) -> None:
-        self.sum += v
-        self.total += 1
-        for i, b in enumerate(self.buckets):
-            if v <= b:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+    def _new_child(self) -> "Histogram":
+        child = Histogram.__new__(Histogram)
+        child.name, child.help = self.name, self.help
+        child.buckets = self.buckets
+        child.counts = [0] * (len(self.buckets) + 1)
+        child.sum = 0.0
+        child.total = 0
+        child._init_labels(())
+        return child
 
-    def render(self) -> str:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+    def observe(self, v: float) -> None:
+        # The whole update is one critical section: sum/total/bucket are a
+        # multi-step read-modify-write, and off-loop observers (native-code
+        # callers, bench threads) would otherwise lose samples against the
+        # event loop's updates.
+        with _LOCK:
+            self.sum += v
+            self.total += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def _render_series(self, out: List[str], labels: str) -> None:
+        sep = f"{labels}," if labels else ""
         cum = 0
         for b, c in zip(self.buckets, self.counts):
             cum += c
-            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {self.total}')
-        out.append(f"{self.name}_sum {self.sum}")
-        out.append(f"{self.name}_count {self.total}")
+            out.append(f'{self.name}_bucket{{{sep}le="{b}"}} {cum}')
+        out.append(f'{self.name}_bucket{{{sep}le="+Inf"}} {self.total}')
+        tail = f"{{{labels}}}" if labels else ""
+        out.append(f"{self.name}_sum{tail} {self.sum}")
+        out.append(f"{self.name}_count{tail} {self.total}")
+
+    def render(self) -> str:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with _LOCK:
+            for child in self._sorted_children():
+                child._render_series(out, child._labels)
+            if not self._label_names:
+                self._render_series(out, "")
         return "\n".join(out) + "\n"
 
 
@@ -100,9 +219,13 @@ _REGISTRY: Dict[str, object] = {}
 _BACKGROUND_TASKS: List[asyncio.Task] = []  # keep refs so GC can't kill them
 
 # Core connection metrics (parity connection/metrics.rs:13-28, incremented
-# by the transport layer at frame write/read).
-BYTES_SENT = Counter("cdn_bytes_sent", "Total bytes written to peers")
-BYTES_RECV = Counter("cdn_bytes_received", "Total bytes read from peers")
+# by the transport layer at frame write/read). Labeled per transport — the
+# connection caches its child at construction, so the hot path still pays
+# one plain ``inc`` per flush.
+BYTES_SENT = Counter("cdn_bytes_sent", "Total bytes written to peers",
+                     labels=("transport",))
+BYTES_RECV = Counter("cdn_bytes_received", "Total bytes read from peers",
+                     labels=("transport",))
 LATENCY = Histogram("cdn_message_latency_seconds",
                     "Permit-allocation lifetime: receive -> last fan-out send")
 RUNNING_LATENCY = Gauge("cdn_running_latency_seconds",
@@ -115,25 +238,55 @@ def observe_message_latency(seconds: float) -> None:
 
 # Cut-through routing plane (broker/tasks/cutthrough.py): one native plan
 # call routes a whole FrameChunk without per-frame Python. The histogram
-# buckets are FRAME COUNTS per plan call, not seconds.
+# buckets are FRAME COUNTS per plan call, not seconds. The three per-path
+# frame counters are one labeled family; the module attributes below are
+# the cached children, so call sites stay `ROUTE_*_FRAMES.inc(n)`.
 ROUTE_BATCH_SIZE = Histogram(
     "cdn_route_batch_size_frames",
     "Frames covered by one cut-through route-plan call",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096))
-ROUTE_CUTTHROUGH_FRAMES = Counter(
-    "cdn_route_batch_cutthrough_frames",
-    "Frames routed by the native cut-through plan (no per-frame Python)")
-ROUTE_RESIDUAL_FRAMES = Counter(
-    "cdn_route_batch_residual_frames",
-    "Frames the cut-through plane handed to the scalar path "
-    "(control frames, malformed frames, depth-1 singles)")
-ROUTE_SCALAR_FRAMES = Counter(
-    "cdn_route_batch_scalar_frames",
-    "Frames routed entirely by the scalar receive loops "
-    "(cut-through off or ineligible)")
+ROUTE_FRAMES = Counter(
+    "cdn_route_batch_frames",
+    "Frames routed, by path: cutthrough = native plan (no per-frame "
+    "Python), residual = handed to the scalar path by the plan (control/"
+    "traced/malformed frames, depth-1 singles), scalar = routed entirely "
+    "by the scalar receive loops",
+    labels=("path",))
+ROUTE_CUTTHROUGH_FRAMES = ROUTE_FRAMES.labels(path="cutthrough")
+ROUTE_RESIDUAL_FRAMES = ROUTE_FRAMES.labels(path="residual")
+ROUTE_SCALAR_FRAMES = ROUTE_FRAMES.labels(path="scalar")
 ROUTE_TABLE_REBUILDS = Counter(
     "cdn_route_table_rebuilds",
     "Cut-through snapshot rebuilds (routing state changed)")
+
+# Egress fan-out accounting by peer type (EgressBatch.flush / the
+# cut-through _send_plan increment batch-wise).
+EGRESS_FRAMES = Counter(
+    "cdn_egress_frames",
+    "Frames handed to connection writers, by destination peer type",
+    labels=("peer",))
+EGRESS_FRAMES_USER = EGRESS_FRAMES.labels(peer="user")
+EGRESS_FRAMES_BROKER = EGRESS_FRAMES.labels(peer="broker")
+
+# Writer-queue depth across live connections (refreshed at render by a
+# pre-render hook over the transport layer's connection registry) and
+# event-loop lag (sampled by a supervised background task).
+WRITER_QUEUE_DEPTH = Gauge(
+    "cdn_writer_queue_depth",
+    "Entries waiting in connection send queues (stat=sum|max across "
+    "live connections)",
+    labels=("stat",))
+EVENT_LOOP_LAG = Gauge(
+    "cdn_event_loop_lag_seconds",
+    "How late the event loop ran a sleep(0.25) wakeup (scheduling lag)")
+
+# Global memory-pool occupancy (refreshed at render from the limiter's
+# live-pool registry).
+POOL_BYTES = Gauge(
+    "cdn_pool_bytes",
+    "Global byte-pool permit accounting across live pools "
+    "(state=in_use|capacity)",
+    labels=("state",))
 
 
 # Callables run before every render: components whose counters move on
@@ -142,19 +295,81 @@ ROUTE_TABLE_REBUILDS = Counter(
 PRE_RENDER_HOOKS: list = []
 
 # BLS per-public-key Miller line-table cache (native/bls_bn254.cpp): the
-# auth hot path's amortization state. Gauges (not counters) because the
-# native library owns the monotonic values and a cache clear legitimately
-# zeroes them.
-BLS_PK_CACHE_HITS = Gauge("cdn_bls_pk_cache_hits",
-                          "BLS verify line-table cache hits")
-BLS_PK_CACHE_MISSES = Gauge("cdn_bls_pk_cache_misses",
-                            "BLS verify line-table cache misses")
-BLS_PK_CACHE_EVICTIONS = Gauge("cdn_bls_pk_cache_evictions",
-                               "BLS verify line-table LRU evictions")
-BLS_PK_CACHE_ENTRIES = Gauge("cdn_bls_pk_cache_entries",
-                             "BLS verify line tables currently cached")
-BLS_PK_CACHE_BYTES = Gauge("cdn_bls_pk_cache_bytes",
-                           "Resident bytes of cached BLS line tables")
+# auth hot path's amortization state. One labeled gauge family (not
+# counters, because the native library owns the monotonic values and a
+# cache clear legitimately zeroes them); module attributes are the cached
+# children so existing call sites keep working.
+BLS_PK_CACHE = Gauge("cdn_bls_pk_cache",
+                     "BLS verify line-table cache state "
+                     "(stat=hits|misses|evictions|entries|bytes)",
+                     labels=("stat",))
+BLS_PK_CACHE_HITS = BLS_PK_CACHE.labels(stat="hits")
+BLS_PK_CACHE_MISSES = BLS_PK_CACHE.labels(stat="misses")
+BLS_PK_CACHE_EVICTIONS = BLS_PK_CACHE.labels(stat="evictions")
+BLS_PK_CACHE_ENTRIES = BLS_PK_CACHE.labels(stat="entries")
+BLS_PK_CACHE_BYTES = BLS_PK_CACHE.labels(stat="bytes")
+
+# Message-lifecycle tracing (proto/trace.py): per-hop latency from the
+# traced message's origin. Defined here (not in trace.py) so every
+# /metrics endpoint exposes the family even before the first span.
+TRACE_HOP_LATENCY = Histogram(
+    "cdn_trace_hop_seconds",
+    "Time from a traced message's origin to each lifecycle hop "
+    "(hop=publish|auth|ingress|plan|egress|delivery)",
+    labels=("hop",))
+
+# Build/runtime identity: one constant-1 series whose labels carry the
+# package version, jax version, and the ACTUAL backend/device kind —
+# so "ALIVE but device_kind=cpu" (TPU_PROBES r5/r6) is visible on every
+# scrape instead of buried in a probes file.
+BUILD_INFO = Gauge("cdn_build_info",
+                   "Build/runtime identity (value is always 1)",
+                   labels=("version", "jax", "backend", "device_kind"))
+
+
+_build_info_last: tuple = ()
+
+
+def _refresh_build_info() -> None:
+    """(Re)probe cdn_build_info at every render — the backend can
+    initialize AFTER the first scrape (a broker attaches its device plane
+    lazily), and a frozen 'uninitialized' label would defeat the point.
+    The stale series drops to 0 and the current one reads 1. Never
+    *initializes* jax: a broker that never touched an accelerator must
+    not pay a multi-second backend probe inside its /metrics handler —
+    unimported jax reports backend=unloaded, imported-but-uninitialized
+    reports uninitialized (jax.devices() on an already-initialized
+    backend is a cached lookup)."""
+    global _build_info_last
+    import pushcdn_tpu
+    jax_mod = sys.modules.get("jax")
+    jax_v = getattr(jax_mod, "__version__", "absent") if jax_mod else "absent"
+    backend = "unloaded"
+    device_kind = "unknown"
+    if jax_mod is not None:
+        try:
+            # peek, never provoke: only report devices when a backend has
+            # already been initialized by the process' own work
+            backends = getattr(
+                sys.modules.get("jax._src.xla_bridge"), "_backends", None)
+            if backends:
+                dev = jax_mod.devices()[0]
+                backend = dev.platform
+                device_kind = dev.device_kind
+            else:
+                backend = "uninitialized"
+        except Exception:
+            backend = "error"
+    current = (pushcdn_tpu.__version__, jax_v, backend, device_kind)
+    if current == _build_info_last:
+        return
+    if _build_info_last:
+        BUILD_INFO.labels(version=_build_info_last[0], jax=_build_info_last[1],
+                          backend=_build_info_last[2],
+                          device_kind=_build_info_last[3]).set(0)
+    BUILD_INFO.labels(version=current[0], jax=current[1], backend=current[2],
+                      device_kind=current[3]).set(1)
+    _build_info_last = current
 
 
 def _refresh_bls_pk_cache() -> None:
@@ -184,6 +399,42 @@ def register_bls_pk_cache_metrics() -> None:
         PRE_RENDER_HOOKS.append(_refresh_bls_pk_cache)
 
 
+def _refresh_writer_queues() -> None:
+    """Sum/max of send-queue depths across live connections (the transport
+    layer keeps a weak registry). Lazy module lookup: a process that never
+    created a connection reports zeros without importing the transport."""
+    base = sys.modules.get("pushcdn_tpu.proto.transport.base")
+    total = depth_max = 0
+    if base is not None:
+        for conn in list(base.LIVE_CONNECTIONS):
+            try:
+                d = conn._send_q.qsize()
+            except Exception:
+                continue
+            total += d
+            if d > depth_max:
+                depth_max = d
+    WRITER_QUEUE_DEPTH.labels(stat="sum").set(total)
+    WRITER_QUEUE_DEPTH.labels(stat="max").set(depth_max)
+
+
+def _refresh_pools() -> None:
+    """Global byte-pool occupancy across live pools (limiter registry)."""
+    limiter_mod = sys.modules.get("pushcdn_tpu.proto.limiter")
+    in_use = capacity = 0
+    if limiter_mod is not None:
+        for pool in list(limiter_mod.LIVE_POOLS):
+            capacity += pool.capacity
+            in_use += pool.capacity - pool.available
+    POOL_BYTES.labels(state="in_use").set(in_use)
+    POOL_BYTES.labels(state="capacity").set(capacity)
+
+
+PRE_RENDER_HOOKS.append(_refresh_build_info)
+PRE_RENDER_HOOKS.append(_refresh_writer_queues)
+PRE_RENDER_HOOKS.append(_refresh_pools)
+
+
 _hook_failures: set = set()
 
 
@@ -199,7 +450,7 @@ def render_all() -> str:
                 logging.getLogger("pushcdn.metrics").exception(
                     "metrics pre-render hook %r failed; its gauges are "
                     "stale from here on", hook)
-    return "".join(m.render() for m in _REGISTRY.values())
+    return "".join(m.render() for m in list(_REGISTRY.values()))
 
 
 def render_tasks() -> str:
@@ -222,6 +473,34 @@ def render_tasks() -> str:
     return f"{len(lines)} tasks\n" + "\n".join(lines) + "\n"
 
 
+def supervised(factory, name: str, restart_delay_s: float = 1.0):
+    """Run ``await factory()`` forever, logging + restarting on exception
+    instead of letting the task die silently for the rest of the process
+    lifetime (the pre-ISSUE-4 fate of ``_running_latency_calculator``).
+    Each death is recorded in the process flight recorder so the trail
+    shows up in ``/debug/flightrec`` and the diagnostics log."""
+    from pushcdn_tpu.proto import flightrec
+
+    async def _runner():
+        rec = flightrec.task_recorder()
+        while True:
+            try:
+                await factory()
+                rec.record("task-exited", name)
+                logging.getLogger("pushcdn.metrics").warning(
+                    "supervised task %r returned; restarting", name)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                rec.record("task-died", f"{name}: {exc!r}", abnormal=True)
+                logging.getLogger("pushcdn.metrics").exception(
+                    "supervised task %r died; restarting in %.1fs",
+                    name, restart_delay_s)
+            await asyncio.sleep(restart_delay_s)
+
+    return _runner()
+
+
 async def _running_latency_calculator(interval_s: float = 30.0) -> None:
     """Recompute RUNNING_LATENCY from histogram deltas every ``interval_s``
     (parity metrics.rs:43-78)."""
@@ -233,30 +512,70 @@ async def _running_latency_calculator(interval_s: float = 30.0) -> None:
         prev_sum, prev_total = LATENCY.sum, LATENCY.total
 
 
-async def serve_metrics(bind_endpoint: str) -> asyncio.AbstractServer:
-    """Serve ``GET /metrics`` as Prometheus text (parity metrics.rs:18-39).
+_loop_lag_peak = 0.0
 
-    Returns the server; also spawns the running-latency calculator.
+
+def _refresh_loop_lag() -> None:
+    """Publish the PEAK lag since the last scrape, then reset. A plain
+    last-sample gauge would be overwritten by the next on-time wakeup
+    ~interval later, hiding every stall shorter than the scrape interval
+    — exactly the incidents the metric exists to surface."""
+    global _loop_lag_peak
+    EVENT_LOOP_LAG.set(_loop_lag_peak)
+    _loop_lag_peak = 0.0
+
+
+PRE_RENDER_HOOKS.append(_refresh_loop_lag)
+
+
+async def _loop_lag_sampler(interval_s: float = 0.25) -> None:
+    """Sample event-loop scheduling lag: how late a sleep() wakeup ran.
+    A loop hogged by a long synchronous section (native call, giant
+    decode) shows up here before it shows up as user-visible latency.
+    Samples accumulate as a max; the pre-render hook publishes-and-resets
+    per scrape."""
+    global _loop_lag_peak
+    loop = asyncio.get_running_loop()
+    while True:
+        t0 = loop.time()
+        await asyncio.sleep(interval_s)
+        lag = loop.time() - t0 - interval_s
+        if lag > _loop_lag_peak:
+            _loop_lag_peak = lag
+
+
+async def serve_metrics(bind_endpoint: str) -> asyncio.AbstractServer:
+    """Serve ``GET /metrics`` as Prometheus text (parity metrics.rs:18-39),
+    ``GET /tasks`` (asyncio task dump) and ``GET /debug/flightrec`` (every
+    live flight recorder's trail).
+
+    Returns the server; also spawns the supervised background samplers
+    (running-latency calculator, event-loop-lag sampler).
     """
+    from pushcdn_tpu.proto import flightrec
     from pushcdn_tpu.proto.error import parse_endpoint
     host, port = parse_endpoint(bind_endpoint)
+
+    def _plain(body: bytes, content_type: bytes = b"text/plain") -> bytes:
+        return (b"HTTP/1.1 200 OK\r\nContent-Type: " + content_type
+                + f"\r\nContent-Length: {len(body)}\r\n\r\n".encode() + body)
 
     async def handler(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
             request = await reader.readline()
             while (await reader.readline()) not in (b"\r\n", b"\n", b""):
                 pass
-            if b"/metrics" in request:
-                body = render_all().encode()
-                writer.write(b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; version=0.0.4\r\n"
-                             + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+            if b"/debug/flightrec" in request:
+                writer.write(_plain(flightrec.render_all().encode()))
+            elif b"/metrics" in request:
+                writer.write(_plain(
+                    render_all().encode(),
+                    b"text/plain; version=0.0.4"))
             elif b"/tasks" in request:
                 # async-runtime introspection (the reference wires
                 # tokio-console behind tokio_unstable; here a plain dump of
                 # every live asyncio task: name, state, current frame)
-                body = render_tasks().encode()
-                writer.write(b"HTTP/1.1 200 OK\r\nContent-Type: text/plain\r\n"
-                             + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+                writer.write(_plain(render_tasks().encode()))
             else:
                 writer.write(b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n\r\n")
             await writer.drain()
@@ -269,6 +588,11 @@ async def serve_metrics(bind_endpoint: str) -> asyncio.AbstractServer:
                 pass
 
     server = await asyncio.start_server(handler, host, port)
-    if not _BACKGROUND_TASKS:  # exactly one calculator per process
-        _BACKGROUND_TASKS.append(asyncio.create_task(_running_latency_calculator()))
+    if not _BACKGROUND_TASKS:  # exactly one sampler set per process
+        _BACKGROUND_TASKS.append(asyncio.create_task(
+            supervised(_running_latency_calculator, "running-latency"),
+            name="metrics-running-latency"))
+        _BACKGROUND_TASKS.append(asyncio.create_task(
+            supervised(_loop_lag_sampler, "loop-lag"),
+            name="metrics-loop-lag"))
     return server

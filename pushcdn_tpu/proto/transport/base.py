@@ -40,7 +40,12 @@ from pushcdn_tpu.proto.message import (
     materialize,
     serialize,
 )
+from pushcdn_tpu.proto import flightrec
 from pushcdn_tpu.proto import metrics as metrics_mod
+
+# Live connections (weak), for the metrics writer-queue-depth pre-render
+# hook and /debug introspection.
+LIVE_CONNECTIONS: "weakref.WeakSet[Connection]" = weakref.WeakSet()
 
 # Parity: 5 s read/write timeouts (protocols/mod.rs:336, :368, :379) and a
 # 5 s connect timeout (tcp.rs).
@@ -274,6 +279,18 @@ class Connection:
         self._stream = stream
         self._limiter = limiter
         self.label = label
+        # per-transport byte accounting: the label's prefix is the
+        # transport name ("tcp:host:port" → "tcp"); the labeled children
+        # are cached here so the hot path pays one plain inc per flush
+        transport = label.split(":", 1)[0] or "?"
+        self._m_sent = metrics_mod.BYTES_SENT.labels(transport=transport)
+        self._m_recv = metrics_mod.BYTES_RECV.labels(transport=transport)
+        # flight recorder: the last ~64 structured events on this
+        # connection, dumped to the diagnostics log on abnormal death and
+        # readable at /debug/flightrec
+        self.flightrec = flightrec.FlightRecorder(label)
+        self.flightrec.record("connect")
+        LIVE_CONNECTIONS.add(self)
         qsize = limiter.queue_size()
         self._send_q: asyncio.Queue = asyncio.Queue(maxsize=qsize)
         self._recv_q: asyncio.Queue = asyncio.Queue(maxsize=qsize)
@@ -351,14 +368,14 @@ class Connection:
         bytes that actually flushed."""
         async with asyncio.timeout(WRITE_TIMEOUT_S):
             await self._stream.write(buf)
-        metrics_mod.BYTES_SENT.inc(len(buf))
+        self._m_sent.inc(len(buf))
 
     async def _flush_v(self, bufs) -> None:
         """Vectored twin of :meth:`_flush`: one timeout window, one gather
         handoff (``writev``) for a run of buffers."""
         async with asyncio.timeout(WRITE_TIMEOUT_S):
             await self._stream.writev(bufs)
-        metrics_mod.BYTES_SENT.inc(sum(len(b) for b in bufs))
+        self._m_sent.inc(sum(len(b) for b in bufs))
 
     async def _flush_chunked(self, data) -> None:
         """Flush an already-framed stream (PreEncoded) in bounded chunks so
@@ -698,7 +715,7 @@ class Connection:
                                     and consumed <= pool.capacity:
                                 chunk_permit = pool.try_allocate(consumed)
                             if pool is None or chunk_permit is not None:
-                                metrics_mod.BYTES_RECV.inc(consumed)
+                                self._m_recv.inc(consumed)
                                 await self._put_recv(FrameChunk(
                                     chunk, offs, lens, chunk_permit))
                                 continue
@@ -719,9 +736,10 @@ class Connection:
                         if pool is not None:
                             permit = pool.try_allocate(length)
                             if permit is None:
+                                self.flightrec.record("limiter-wait", length)
                                 permit = await pool.allocate(length)
                         del buf[:]
-                        metrics_mod.BYTES_RECV.inc(blen)
+                        self._m_recv.inc(blen)
                         await self._put_recv(Bytes(payload, permit))
                         continue
 
@@ -759,7 +777,7 @@ class Connection:
                         # what bounded small-frame receive throughput).
                         chunk = FrameChunk(bytes(memoryview(buf)[:consumed]),
                                            offs, lens, chunk_permit)
-                        metrics_mod.BYTES_RECV.inc(consumed)
+                        self._m_recv.inc(consumed)
                         del buf[:consumed]
                         await self._put_recv(chunk)
                     else:
@@ -775,6 +793,8 @@ class Connection:
                                     payload = bytes(mv[o:o + ln])
                                     permit = pool.try_allocate(ln)
                                     if permit is None:
+                                        self.flightrec.record(
+                                            "limiter-wait", ln)
                                         if batch:
                                             # hand ownership over BEFORE
                                             # the await: a cancelled
@@ -791,7 +811,7 @@ class Connection:
                             for b in batch:
                                 b.release()
                             raise
-                        metrics_mod.BYTES_RECV.inc(consumed)
+                        self._m_recv.inc(consumed)
                         if batch:
                             await self._put_recv(
                                 batch[0] if len(batch) == 1 else batch)
@@ -819,6 +839,7 @@ class Connection:
                     if pool is not None:
                         permit = pool.try_allocate(length)
                         if permit is None:
+                            self.flightrec.record("limiter-wait", length)
                             permit = await pool.allocate(length)
                     try:
                         out = bytearray(length)
@@ -839,7 +860,7 @@ class Connection:
                         if permit is not None:
                             permit.release()
                         raise
-                    metrics_mod.BYTES_RECV.inc(length + 4)
+                    self._m_recv.inc(length + 4)
                     await self._put_recv(Bytes(out, permit))
         except asyncio.CancelledError:
             raise
@@ -854,6 +875,14 @@ class Connection:
         if self._error is None:
             self._error = err
         self._closed = True
+        # flight recorder: a plain peer FIN is a normal lifecycle event; an
+        # I/O failure, oversized frame, or mid-write cancel arms the
+        # recorder so the trail hits the diagnostics log at teardown (and
+        # right here for un-owned connections nobody will tear down)
+        abnormal = err.message != "peer closed"
+        self.flightrec.record("error", err.message, abnormal=abnormal)
+        if abnormal:
+            self.flightrec.maybe_dump(err.message)
         self._stream.abort()
         # Resolve blocked senders, but KEEP the receive side: frames that
         # arrived before the failure are still deliverable (TCP delivers
@@ -1000,7 +1029,11 @@ class Connection:
         failed send). Used by the device-plane egress so one backpressured
         peer can't stall the pump."""
         self._check()
-        self._send_q.put_nowait((raw, None))
+        try:
+            self._send_q.put_nowait((raw, None))
+        except asyncio.QueueFull:
+            self.flightrec.record("backpressure", "send queue full")
+            raise
         self._ensure_writer()
         if self._error is not None:
             raise self._error
@@ -1055,7 +1088,11 @@ class Connection:
         pass the buffer's holder (e.g. the ``EgressStreams``) as ``owner``
         so a pooled buffer cannot be recycled under the pending write."""
         self._check()
-        self._send_q.put_nowait((PreEncoded(data, owner), None))
+        try:
+            self._send_q.put_nowait((PreEncoded(data, owner), None))
+        except asyncio.QueueFull:
+            self.flightrec.record("backpressure", "send queue full")
+            raise
         self._ensure_writer()
         if self._error is not None:
             raise self._error
@@ -1219,6 +1256,7 @@ class Connection:
         if self._error is not None:
             raise self._error
         self._closed = True
+        self.flightrec.record("close", "soft")
         if self._writer_task is None:
             # nothing was ever queued: flush is trivially done — close the
             # write side directly (under the mutex so an in-flight inline
@@ -1244,6 +1282,7 @@ class Connection:
     def close(self) -> None:
         """Tear down immediately (abort both tasks, return queued permits)."""
         self._closed = True
+        self.flightrec.record("close", "abort")
         if self._writer_task is not None:
             self._writer_task.cancel()
         self._reader_task.cancel()

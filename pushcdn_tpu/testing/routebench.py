@@ -19,15 +19,21 @@ from typing import Optional
 
 async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
                        trials: int = 3, payload: int = 512,
-                       batch: int = 64) -> Optional[dict]:
+                       batch: int = 64,
+                       trace_every: int = 0) -> Optional[dict]:
     """Measure broker forwarding msgs/s with the routing plane forced to
     ``impl`` (``auto``/``native``/``python``). Returns ``None`` when
     ``impl == "native"`` but the kernel is unavailable (callers emit a
     skipped row — never a mislabeled A/B), else a dict with the median,
-    all trials, and the delivered rate."""
+    all trials, and the delivered rate.
+
+    ``trace_every > 0`` stamps every Nth sent frame with a lifecycle-trace
+    context (proto.trace wire flag), exactly what a client publishing at
+    ``PUSHCDN_TRACE_SAMPLE=N`` produces — the trace-overhead A/B row."""
     from pushcdn_tpu.broker.tasks import cutthrough
     from pushcdn_tpu.broker.test_harness import TestDefinition
     from pushcdn_tpu.native import routeplan
+    from pushcdn_tpu.proto import trace as trace_lib
     from pushcdn_tpu.proto.message import Broadcast, serialize
     from pushcdn_tpu.proto.transport.base import FrameChunk
     from pushcdn_tpu.proto.transport.memory import Memory
@@ -46,6 +52,8 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
             connected_users=[[]] + [[0]] * receivers).run()
         try:
             frame = serialize(Broadcast([0], os.urandom(payload)))
+            traced_frame = trace_lib.stamp_frame(
+                frame, trace_lib.new_trace()) if trace_every else None
             sender = run.user(0).remote
             msgs = max(batch, (msgs // batch) * batch)
 
@@ -59,13 +67,25 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
                             item.release()
 
             rates = []
+            sent = 0
             for _ in range(trials):
                 t0 = time.perf_counter()
                 drains = [asyncio.create_task(
                     drain(run.user(1 + r).remote, msgs))
                     for r in range(receivers)]
                 for _ in range(msgs // batch):
-                    await sender.send_raw_many([frame] * batch)
+                    if trace_every:
+                        # deterministic 1-in-N mix: the exact wire a
+                        # sampled publisher produces
+                        frames = []
+                        for _i in range(batch):
+                            sent += 1
+                            frames.append(traced_frame
+                                          if sent % trace_every == 0
+                                          else frame)
+                        await sender.send_raw_many(frames)
+                    else:
+                        await sender.send_raw_many([frame] * batch)
                     await asyncio.sleep(0)
                 await asyncio.gather(*drains)
                 rates.append(msgs / (time.perf_counter() - t0))

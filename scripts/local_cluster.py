@@ -25,10 +25,45 @@ sys.path.insert(0, REPO)
 from pushcdn_tpu.bin.common import spawn_binary  # noqa: E402
 
 
-def spawn(name: str, *args: str) -> subprocess.Popen:
-    proc = spawn_binary(name, *args)
+def spawn(name: str, *args: str, env_extra=None) -> subprocess.Popen:
+    proc = spawn_binary(name, *args, env_extra=env_extra)
     print(f"[cluster] {name} up (pid {proc.pid})")
     return proc
+
+
+def check_trace_chain(trace_dir: str, wait_s: float = 5.0) -> bool:
+    """Assemble the per-process JSONL span logs and verify at least one
+    trace id produced the COMPLETE lifecycle chain: auth (marshal) +
+    publish → ingress → plan → egress (broker) → delivery (client).
+    Retries briefly: the broker's egress span lands microseconds after
+    the client prints its echo, and we read the files right then."""
+    import glob
+    import json as json_mod
+    need = {"auth", "publish", "ingress", "plan", "egress", "delivery"}
+    deadline = time.time() + wait_s
+    hops_by_id: dict = {}
+    while True:
+        hops_by_id = {}
+        for path in glob.glob(os.path.join(trace_dir, "*.jsonl")):
+            with open(path) as fh:
+                for line in fh:
+                    try:
+                        rec = json_mod.loads(line)
+                    except ValueError:
+                        continue
+                    hops_by_id.setdefault(rec["trace_id"],
+                                          set()).add(rec["hop"])
+        for tid, hops in hops_by_id.items():
+            if need <= hops:
+                print(f"[cluster] trace chain complete: id={tid:x} "
+                      f"hops={sorted(hops)}")
+                return True
+        if time.time() >= deadline:
+            break
+        time.sleep(0.2)
+    print(f"[cluster] FAIL: no complete trace chain "
+          f"(saw {[(hex(t), sorted(h)) for t, h in hops_by_id.items()]})")
+    return False
 
 
 def main() -> int:
@@ -40,7 +75,21 @@ def main() -> int:
     ap.add_argument("--device-plane", action="store_true",
                     help="brokers route eligible traffic on the attached "
                          "device (single-shard planes)")
+    ap.add_argument("--trace-log", metavar="DIR", default=None,
+                    help="write per-process lifecycle-trace span JSONL "
+                         "under DIR and verify one complete span chain "
+                         "(publish -> auth -> ingress -> plan -> egress "
+                         "-> delivery)")
     args = ap.parse_args()
+
+    if args.trace_log:
+        os.makedirs(args.trace_log, exist_ok=True)
+
+    def trace_env(name: str):
+        if not args.trace_log:
+            return None
+        return {"PUSHCDN_TRACE_LOG":
+                os.path.join(args.trace_log, f"{name}.jsonl")}
 
     db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-cluster-"), "cdn.sqlite")
     bp = args.base_port
@@ -65,21 +114,21 @@ def main() -> int:
                 "--user-transport", "tcp",   # plain tcp for the local demo
                 "--metrics-bind-endpoint", f"127.0.0.1:{bp + 100 + i}",
                 *(["--device-plane"] if args.device_plane else []),
-            )))
+                env_extra=trace_env(f"broker{i}"))))
         time.sleep(1.5)  # brokers register + mesh up
         procs.append(("marshal", spawn(
             "marshal",
             "--discovery-endpoint", db,
             "--bind-endpoint", f"127.0.0.1:{bp + 50}",
             "--user-transport", "tcp",
-        )))
+            env_extra=trace_env("marshal"))))
         time.sleep(1.0)
         procs.append(("client", spawn(
             "client",
             "--marshal-endpoint", f"127.0.0.1:{bp + 50}",
             "--transport", "tcp",
             "--interval", "1.0", "--key-seed", "7",
-        )))
+            env_extra=trace_env("client"))))
 
         deadline = time.time() + args.duration
         echoed = False
@@ -98,6 +147,8 @@ def main() -> int:
                     break
         if not echoed:
             print("[cluster] FAIL: client never echoed")
+            return 1
+        if args.trace_log and not check_trace_chain(args.trace_log):
             return 1
         print("[cluster] OK: end-to-end echo through real processes")
         return 0

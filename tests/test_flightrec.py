@@ -1,0 +1,88 @@
+"""Per-connection flight recorder (ISSUE 4): ring semantics, abnormal-
+disconnect dumps into the diagnostics log, and the /debug/flightrec
+endpoint."""
+
+import asyncio
+import logging
+
+from pushcdn_tpu.proto import flightrec
+
+
+def test_ring_is_bounded_and_ordered():
+    rec = flightrec.FlightRecorder("unit", capacity=4)
+    for i in range(10):
+        rec.record("ev", i)
+    assert len(rec) == 4
+    trail = rec.trail()
+    assert "ev  6" in trail and "ev  9" in trail and "ev  5" not in trail
+    assert "flight recorder [unit]" in trail
+
+
+def test_abnormal_arms_and_maybe_dump_disarms(caplog):
+    rec = flightrec.FlightRecorder("unit-2")
+    rec.record("connect")
+    assert not rec.maybe_dump("clean close")  # unarmed: silent
+    rec.record("error", "boom", abnormal=True)
+    with caplog.at_level(logging.WARNING, logger="pushcdn.flightrec"):
+        assert rec.maybe_dump("io error")
+        assert not rec.maybe_dump("second teardown path")  # disarmed
+    assert "abnormal disconnect (io error)" in caplog.text
+    assert "boom" in caplog.text and "connect" in caplog.text
+
+
+def test_render_all_lists_live_recorders():
+    rec = flightrec.FlightRecorder("render-me")
+    rec.record("hello")
+    body = flightrec.render_all()
+    assert "flight recorder [render-me]" in body
+    assert "hello" in body
+
+
+async def test_malformed_frame_dumps_trail_with_trigger(caplog):
+    """The chaos-tier contract: a user feeding the broker garbage is
+    disconnected AND the broker logs that connection's flight-recorder
+    trail containing the triggering event."""
+    from pushcdn_tpu.broker.test_harness import TestDefinition
+
+    run = await TestDefinition(connected_users=[[0]]).run()
+    try:
+        with caplog.at_level(logging.WARNING, logger="pushcdn.flightrec"):
+            try:
+                await run.user(0).remote.send_raw(b"\xfegarbage", flush=True)
+            except Exception:
+                pass  # broker may kill the link before the flush settles
+            async with asyncio.timeout(5):
+                while run.broker.connections.num_users:
+                    await asyncio.sleep(0.02)
+            await asyncio.sleep(0.05)
+        assert "abnormal disconnect" in caplog.text
+        assert "malformed-frame" in caplog.text
+        assert "connect" in caplog.text  # the trail shows the life before
+    finally:
+        await run.shutdown()
+
+
+async def test_connection_poison_records_and_dumps(caplog):
+    """An I/O failure (not a clean FIN) arms the recorder and the poison
+    path dumps immediately (nobody may ever tear this handle down)."""
+    from pushcdn_tpu.proto.transport.memory import Memory
+
+    listener = await Memory.bind("flightrec-test")
+    try:
+        accept_task = asyncio.create_task(listener.accept())
+        conn = await Memory.connect("flightrec-test")
+        server_side = await (await accept_task).finalize()
+        with caplog.at_level(logging.WARNING, logger="pushcdn.flightrec"):
+            # oversized announced frame: the reader poisons with
+            # EXCEEDED_SIZE, which is NOT a clean peer-close
+            from pushcdn_tpu.proto import MAX_MESSAGE_SIZE
+            bogus = (MAX_MESSAGE_SIZE + 1).to_bytes(4, "big")
+            await conn._stream.write(bogus)
+            async with asyncio.timeout(5):
+                while server_side._error is None:
+                    await asyncio.sleep(0.01)
+        assert "abnormal disconnect" in caplog.text
+        conn.close()
+        server_side.close()
+    finally:
+        await listener.close()

@@ -1,0 +1,40 @@
+"""CI lint gate (ISSUE 4 satellite): run ``ruff check`` over the package,
+tests, benches and scripts with the repo's ruff.toml baseline, so new
+instrumentation code lands lint-clean.
+
+The container image may not ship ruff (it is not pip-installable here);
+in that case the test SKIPS with an explicit reason rather than
+vacuously passing — the gate engages wherever ruff exists.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ruff_cmd():
+    exe = shutil.which("ruff")
+    if exe:
+        return [exe]
+    try:
+        import ruff  # noqa: F401
+        return [sys.executable, "-m", "ruff"]
+    except ImportError:
+        return None
+
+
+def test_ruff_check_clean():
+    cmd = _ruff_cmd()
+    if cmd is None:
+        pytest.skip("ruff not installed in this image; lint gate inactive")
+    proc = subprocess.run(
+        [*cmd, "check", "pushcdn_tpu", "tests", "benches", "scripts",
+         "bench.py"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, \
+        f"ruff check found issues:\n{proc.stdout}\n{proc.stderr}"

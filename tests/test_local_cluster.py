@@ -35,15 +35,21 @@ def _loopback_available() -> bool:
                     reason="PUSHCDN_SKIP_CLUSTER_TEST=1")
 @pytest.mark.skipif(not _loopback_available(),
                     reason="no loopback TCP in this sandbox")
-def test_local_cluster_end_to_end_echo_and_clean_shutdown():
+def test_local_cluster_end_to_end_echo_and_clean_shutdown(tmp_path):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")  # never touch an accelerator
+    trace_dir = str(tmp_path / "spans")
     proc = subprocess.run(
-        [sys.executable, SCRIPT, "--duration", "10", "--base-port", "0"],
+        [sys.executable, SCRIPT, "--duration", "10", "--base-port", "0",
+         "--trace-log", trace_dir],
         env=env, capture_output=True, text=True, timeout=120)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, f"local_cluster failed:\n{out[-4000:]}"
     assert "OK: end-to-end echo through real processes" in out, out[-4000:]
+    # ISSUE 4: one complete lifecycle span chain (auth + publish ->
+    # ingress -> plan -> egress -> delivery on ONE trace id) assembled
+    # from the per-process JSONL span logs
+    assert "trace chain complete" in out, out[-4000:]
     # clean shutdown: the runner SIGINTs every component and exits 0 —
     # a component that survives SIGINT is killed and would have left
     # "FAIL" markers; assert none

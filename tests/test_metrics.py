@@ -1,7 +1,11 @@
 """Metrics endpoint tests: /metrics prometheus text, /tasks introspection
-(parity metrics.rs:18-78 + the tokio-console aux subsystem)."""
+(parity metrics.rs:18-78 + the tokio-console aux subsystem), plus the
+ISSUE 4 registry upgrade: labels, mutator thread-safety under scrapes
+racing live updates, build info, the new gauges, /debug/flightrec, and
+the supervised-task helper."""
 
 import asyncio
+import threading
 
 from pushcdn_tpu.proto import metrics as metrics_mod
 
@@ -60,3 +64,217 @@ async def test_unknown_path_404():
     finally:
         server.close()
         await server.wait_closed()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 4: labeled registry
+# ---------------------------------------------------------------------------
+
+def test_labeled_counter_children_and_total_line():
+    c = metrics_mod.Counter("cdn_test_labeled_counter", "t", labels=("k",))
+    c.labels(k="a").inc(3)
+    c.labels(k="b").inc(4)
+    c.inc(1)  # direct parent inc stays legal (unlabeled series)
+    body = c.render()
+    assert 'cdn_test_labeled_counter{k="a"} 3' in body
+    assert 'cdn_test_labeled_counter{k="b"} 4' in body
+    assert "cdn_test_labeled_counter 8" in body  # bare total = own + sum
+    # children are cached: same object on re-lookup (hot paths hold them)
+    assert c.labels(k="a") is c.labels(k="a")
+    metrics_mod._REGISTRY.pop("cdn_test_labeled_counter")
+
+
+def test_labeled_histogram_renders_per_series_buckets():
+    h = metrics_mod.Histogram("cdn_test_labeled_hist", "t",
+                              buckets=(0.1, 1.0), labels=("hop",))
+    h.labels(hop="x").observe(0.05)
+    h.labels(hop="x").observe(0.5)
+    body = h.render()
+    assert 'cdn_test_labeled_hist_bucket{hop="x",le="0.1"} 1' in body
+    assert 'cdn_test_labeled_hist_bucket{hop="x",le="+Inf"} 2' in body
+    assert 'cdn_test_labeled_hist_count{hop="x"} 2' in body
+    metrics_mod._REGISTRY.pop("cdn_test_labeled_hist")
+
+
+def test_labels_require_declared_names():
+    import pytest
+    g = metrics_mod.Gauge("cdn_test_label_names", "t", labels=("a",))
+    with pytest.raises(KeyError):
+        g.labels(b="x")
+    with pytest.raises(KeyError):
+        g.labels(a="x", b="y")
+    metrics_mod._REGISTRY.pop("cdn_test_label_names")
+
+
+def test_label_values_are_escaped():
+    g = metrics_mod.Gauge("cdn_test_label_escape", "t", labels=("v",))
+    g.labels(v='say "hi"\nthere').set(1)
+    body = g.render()
+    assert '\\"hi\\"' in body and "\\n" in body
+    metrics_mod._REGISTRY.pop("cdn_test_label_escape")
+
+
+def test_histogram_observe_is_thread_safe():
+    """The satellite fix: off-loop observers (native callers, bench
+    threads) must not lose samples in the sum/bucket read-modify-write."""
+    h = metrics_mod.Histogram("cdn_test_threaded_hist", "t",
+                              buckets=(0.5,))
+    N, T = 5_000, 4
+
+    def pound():
+        for _ in range(N):
+            h.observe(0.25)
+
+    threads = [threading.Thread(target=pound) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.total == N * T
+    assert h.counts[0] == N * T
+    assert abs(h.sum - 0.25 * N * T) < 1e-6
+    metrics_mod._REGISTRY.pop("cdn_test_threaded_hist")
+
+
+async def test_concurrent_scrapes_racing_live_updates():
+    """Many concurrent /metrics scrapes while counters and histograms are
+    updated from the loop AND from a thread: every scrape parses, and
+    every histogram snapshot is internally consistent (cumulative buckets
+    never exceed the +Inf count)."""
+    server = await metrics_mod.serve_metrics("127.0.0.1:0")
+    port = server.sockets[0].getsockname()[1]
+    stop = threading.Event()
+
+    def pound():
+        while not stop.is_set():
+            metrics_mod.LATENCY.observe(0.001)
+            metrics_mod.BYTES_SENT.labels(transport="test").inc(7)
+
+    thread = threading.Thread(target=pound)
+    thread.start()
+    try:
+        async def hammer():
+            for _ in range(5):
+                metrics_mod.LATENCY.observe(0.01)
+                status, body = await _get(port, "/metrics")
+                assert status == 200
+                # internal consistency of the racing histogram snapshot
+                lines = [ln for ln in body.splitlines()
+                         if ln.startswith("cdn_message_latency_seconds")]
+                inf = [ln for ln in lines if 'le="+Inf"' in ln]
+                count = [ln for ln in lines
+                         if ln.startswith("cdn_message_latency_seconds_count")]
+                assert inf and count
+                assert float(inf[0].rsplit(" ", 1)[1]) == \
+                    float(count[0].rsplit(" ", 1)[1])
+                cums = [float(ln.rsplit(" ", 1)[1]) for ln in lines
+                        if "_bucket" in ln]
+                assert cums == sorted(cums)  # cumulative: non-decreasing
+
+        await asyncio.gather(*[hammer() for _ in range(8)])
+    finally:
+        stop.set()
+        thread.join()
+        server.close()
+        await server.wait_closed()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 4: new observability surfaces
+# ---------------------------------------------------------------------------
+
+async def test_scrape_exposes_build_info_and_new_gauges():
+    server = await metrics_mod.serve_metrics("127.0.0.1:0")
+    port = server.sockets[0].getsockname()[1]
+    try:
+        status, body = await _get(port, "/metrics")
+        assert status == 200
+        assert "cdn_build_info{" in body
+        assert 'version="' in body and "device_kind=" in body
+        assert 'cdn_writer_queue_depth{stat="sum"}' in body
+        assert 'cdn_writer_queue_depth{stat="max"}' in body
+        assert "cdn_event_loop_lag_seconds" in body
+        assert 'cdn_pool_bytes{state="in_use"}' in body
+        assert "cdn_trace_hop_seconds" in body
+        assert 'cdn_route_batch_frames{path="cutthrough"}' in body
+        assert 'cdn_bls_pk_cache{stat="hits"}' in body
+        assert 'cdn_egress_frames{peer="user"}' in body
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_writer_queue_gauge_tracks_live_connections():
+    from pushcdn_tpu.proto.transport.memory import (
+        gen_testing_connection_pair,
+    )
+    a, b = await gen_testing_connection_pair()
+    try:
+        metrics_mod._refresh_writer_queues()
+        base = metrics_mod.WRITER_QUEUE_DEPTH.labels(stat="sum").value
+        # park frames in the send queue by never letting the writer run
+        # (enqueue without awaiting the loop)
+        for _ in range(5):
+            a._send_q.put_nowait((b"x", None))
+        metrics_mod._refresh_writer_queues()
+        assert metrics_mod.WRITER_QUEUE_DEPTH.labels(
+            stat="sum").value >= base + 5
+        assert metrics_mod.WRITER_QUEUE_DEPTH.labels(stat="max").value >= 5
+        while not a._send_q.empty():
+            a._send_q.get_nowait()
+    finally:
+        a.close()
+        b.close()
+
+
+async def test_debug_flightrec_endpoint():
+    from pushcdn_tpu.proto import flightrec
+    rec = flightrec.FlightRecorder("endpoint-test-rec")
+    rec.record("unit-event", "detail-42")
+    server = await metrics_mod.serve_metrics("127.0.0.1:0")
+    port = server.sockets[0].getsockname()[1]
+    try:
+        status, body = await _get(port, "/debug/flightrec")
+        assert status == 200
+        assert "endpoint-test-rec" in body
+        assert "unit-event" in body and "detail-42" in body
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_supervised_task_restarts_after_exception():
+    runs = []
+
+    async def flaky():
+        runs.append(1)
+        if len(runs) < 3:
+            raise RuntimeError("boom")
+        await asyncio.sleep(30)  # healthy: park
+
+    task = asyncio.create_task(
+        metrics_mod.supervised(flaky, "flaky-test", restart_delay_s=0.01))
+    try:
+        async with asyncio.timeout(5):
+            while len(runs) < 3:
+                await asyncio.sleep(0.01)
+    finally:
+        task.cancel()
+    assert len(runs) >= 3  # died twice, restarted each time
+
+
+async def test_loop_lag_sampler_reports_stall():
+    import time
+    task = asyncio.create_task(metrics_mod._loop_lag_sampler(0.1))
+    try:
+        await asyncio.sleep(0.15)  # sampler running, mid-interval
+        time.sleep(0.3)            # hog the loop synchronously
+        # let several on-time wakeups land AFTER the stall: the peak must
+        # survive them until a scrape publishes-and-resets it
+        await asyncio.sleep(0.25)
+        metrics_mod._refresh_loop_lag()  # what a /metrics render runs
+        assert metrics_mod.EVENT_LOOP_LAG.value >= 0.05
+        metrics_mod._refresh_loop_lag()  # next scrape: peak was reset
+        assert metrics_mod.EVENT_LOOP_LAG.value < 0.05
+    finally:
+        task.cancel()

@@ -216,6 +216,50 @@ async def test_subscribe_then_broadcast_same_chunk():
             serialize(Broadcast((1,), b"after-unsub"))], (impl, deliveries)
 
 
+async def test_traced_frame_mid_chunk_equivalence():
+    """ISSUE 4 trace propagation: a traced Broadcast mid-chunk stops the
+    plan on the kind-tag flag bit and takes the instrumented scalar path,
+    while the rest of the chunk stays batched. Both implementations must
+    produce identical per-peer delivery sequences (the traced wire frame
+    forwarded VERBATIM), the broker must emit the ingress/plan/egress
+    span chain, and the native run must still cut through the untraced
+    neighbors."""
+    from pushcdn_tpu.proto import metrics as metrics_mod
+    from pushcdn_tpu.proto import trace as trace_lib
+
+    tr = trace_lib.new_trace()
+    traced = trace_lib.stamp_frame(
+        serialize(Broadcast([0], b"traced-payload")), tr)
+    frames = ([serialize(Broadcast([0], b"pre-%d" % i)) for i in range(6)]
+              + [traced]
+              + [serialize(Broadcast([0], b"post-%d" % i))
+                 for i in range(6)])
+
+    results = {}
+    for impl in ("native", "python"):
+        cut0 = metrics_mod.ROUTE_CUTTHROUGH_FRAMES.value
+        res0 = metrics_mod.ROUTE_RESIDUAL_FRAMES.value
+        trace_lib.recent.clear()
+        deliveries, alive, balanced = await _run_mix(
+            impl, frames, as_user=True, chunked=True)
+        assert alive and balanced, impl
+        hops = {h for h, tid, *_ in trace_lib.recent if tid == tr[0]}
+        assert {"ingress", "plan", "egress"} <= hops, (impl, hops)
+        results[impl] = deliveries
+        if impl == "native":
+            # the 12 untraced neighbors cut through; exactly the traced
+            # frame went residual
+            assert metrics_mod.ROUTE_CUTTHROUGH_FRAMES.value - cut0 >= 12
+            assert metrics_mod.ROUTE_RESIDUAL_FRAMES.value - res0 == 1
+    assert results["native"] == results["python"]
+    # topic-0 subscribers received the traced frame VERBATIM (flag +
+    # trace block intact), in arrival order
+    for peer in ("user-1", "user-2"):
+        got = results["native"][peer]
+        assert got[6] == traced, peer
+        assert len(got) == 13
+
+
 async def test_depth1_singles_equivalence():
     """Flushed singles ride the depth-1 Bytes path through the cut-through
     drain; decisions must still match the scalar loops."""

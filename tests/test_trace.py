@@ -1,0 +1,168 @@
+"""Message-lifecycle tracing (ISSUE 4): wire codec roundtrips, sampler
+determinism, span emission, and the zero-cost contract for untraced
+frames."""
+
+import asyncio
+
+from pushcdn_tpu.proto import trace as trace_mod
+from pushcdn_tpu.proto.error import Error
+from pushcdn_tpu.proto.message import (
+    Broadcast,
+    Direct,
+    TracedBroadcast,
+    TracedDirect,
+    decode_frames,
+    deserialize,
+    deserialize_owned,
+    materialize,
+    serialize,
+    with_trace,
+)
+
+
+def test_traced_codec_roundtrip_broadcast():
+    tr = (0xDEADBEEF12345678, 1_700_000_000_000_000_000)
+    msg = TracedBroadcast([3, 7], b"payload", tr)
+    frame = serialize(msg)
+    # flagged kind byte + 16-byte block, otherwise the ordinary layout
+    assert frame[0] == 0x85
+    assert len(frame) == len(serialize(Broadcast([3, 7], b"payload"))) + 16
+    out = deserialize(frame)
+    assert type(out) is TracedBroadcast
+    assert isinstance(out, Broadcast)  # routing treats it as a Broadcast
+    assert out.trace == tr
+    assert out.topics == (3, 7) and bytes(out.message) == b"payload"
+    owned = deserialize_owned(frame)
+    assert owned.trace == tr and type(owned.message) is bytes
+
+
+def test_traced_codec_roundtrip_direct():
+    tr = (42, 99)
+    frame = serialize(TracedDirect(b"rcpt", b"hello", tr))
+    assert frame[0] == 0x84
+    out = deserialize_owned(frame)
+    assert type(out) is TracedDirect and isinstance(out, Direct)
+    assert out.trace == tr and out.recipient == b"rcpt"
+    assert bytes(out.message) == b"hello"
+
+
+def test_untraced_frames_are_byte_identical_and_pay_nothing():
+    for msg in (Broadcast([1], b"x"), Direct(b"r", b"y")):
+        frame = serialize(msg)
+        assert not frame[0] & 0x80
+        out = deserialize(frame)
+        assert out.trace is None  # class attribute: no per-instance cost
+        assert type(out) in (Broadcast, Direct)
+
+
+def test_materialize_preserves_trace():
+    tr = (7, 8)
+    frame = serialize(TracedBroadcast([1], b"z", tr))
+    view_msg = deserialize(memoryview(frame))
+    assert isinstance(view_msg.message, memoryview)
+    owned = materialize(view_msg)
+    assert owned.trace == tr and type(owned.message) is bytes
+
+
+def test_decode_frames_handles_traced_mid_batch():
+    tr = (11, 22)
+    frames = [serialize(Broadcast([0], b"a")),
+              serialize(TracedBroadcast([0], b"b", tr)),
+              serialize(Direct(b"r", b"c"))]
+    buf = bytearray()
+    offs, lens = [], []
+    for f in frames:
+        offs.append(len(buf) + 4)
+        lens.append(len(f))
+        buf += len(f).to_bytes(4, "big") + f
+    out = decode_frames(bytes(buf), offs, lens)
+    assert [m.trace for m in out] == [None, tr, None]
+    assert bytes(out[1].message) == b"b"
+
+
+def test_truncated_trace_block_is_deserialize_error():
+    import pytest
+    frame = serialize(TracedBroadcast([0], b"p", (1, 2)))
+    with pytest.raises(Error):
+        deserialize(frame[:10])  # cut inside the trace block
+
+
+def test_with_trace_only_wraps_hot_kinds():
+    from pushcdn_tpu.proto.message import Subscribe
+    tr = (1, 2)
+    assert with_trace(Broadcast([0], b"x"), tr).trace == tr
+    assert with_trace(Direct(b"r", b"x"), tr).trace == tr
+    sub = Subscribe([0])
+    assert with_trace(sub, tr) is sub
+
+
+def test_stamp_strip_frame_roundtrip():
+    frame = serialize(Broadcast([5], b"q"))
+    tr = (123456, 789)
+    stamped = trace_mod.stamp_frame(frame, tr)
+    assert stamped[0] == frame[0] | 0x80
+    plain, got = trace_mod.strip_frame(stamped)
+    assert plain == frame and got == tr
+    plain2, got2 = trace_mod.strip_frame(frame)
+    assert plain2 == frame and got2 is None
+
+
+def test_sampler_is_deterministic_one_in_n():
+    s = trace_mod.Sampler(every=8)
+    picks = [s.next_trace() is not None for _ in range(32)]
+    assert sum(picks) == 4
+    assert [i for i, p in enumerate(picks) if p] == [7, 15, 23, 31]
+
+
+def test_sampler_pending_forces_first_publish():
+    s = trace_mod.Sampler(every=1_000_000)
+    s.pending = 0xABC
+    tr = s.next_trace()
+    assert tr is not None and tr[0] == 0xABC
+    assert s.next_trace() is None  # back to ordinary sampling
+
+
+def test_sampler_disabled_never_traces():
+    s = trace_mod.Sampler(every=0)
+    assert all(s.next_trace() is None for _ in range(10))
+
+
+def test_emit_observes_hop_histogram_and_recent():
+    import time
+    before = trace_mod._HOP_CHILDREN["ingress"].total
+    tr = (trace_mod._next_id(), time.time_ns() - 5_000_000)  # 5 ms ago
+    trace_mod.emit("ingress", tr, "unit-test")
+    child = trace_mod._HOP_CHILDREN["ingress"]
+    assert child.total == before + 1
+    hop, tid, origin, now, detail = trace_mod.recent[-1]
+    assert hop == "ingress" and tid == tr[0] and detail == "unit-test"
+    assert now >= origin
+
+
+async def test_traced_publish_spans_through_in_process_broker():
+    """A traced Broadcast through a real (in-process, Memory-transport)
+    broker emits ingress/plan/egress spans and forwards the traced wire
+    frame VERBATIM to subscribers."""
+    from pushcdn_tpu.broker.test_harness import TestDefinition
+    from pushcdn_tpu.proto.transport.base import FrameChunk
+
+    run = await TestDefinition(connected_users=[[], [0]]).run()
+    try:
+        tr = trace_mod.new_trace()
+        traced = trace_mod.stamp_frame(serialize(Broadcast([0], b"tp")), tr)
+        trace_mod.recent.clear()
+        await run.user(0).remote.send_raw(traced, flush=True)
+        got = []
+        async with asyncio.timeout(5):
+            while not got:
+                for item in await run.user(1).remote.recv_frames():
+                    if type(item) is FrameChunk:
+                        got.extend(bytes(v) for v in item.views())
+                    else:
+                        got.append(bytes(item.data))
+                    item.release()
+        assert got == [traced]  # flag + block intact on the wire
+        hops = {h for h, tid, *_ in trace_mod.recent if tid == tr[0]}
+        assert {"ingress", "plan", "egress"} <= hops
+    finally:
+        await run.shutdown()
