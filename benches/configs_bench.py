@@ -472,8 +472,26 @@ async def amain(quick: bool):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out-json", default=None, metavar="PATH",
+                    help="merge this run's rows into a machine-readable "
+                         "bench file (e.g. BENCH_r09.json); shares the "
+                         "file with benches/route_bench.py --out-json")
     args = ap.parse_args()
     asyncio.run(amain(args.quick))
+    if args.out_json:
+        # one section key per producer; route_bench's section (and any
+        # other) is preserved — the bench trajectory file stops being
+        # hand-curated
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from route_bench import write_bench_json
+        headline = {}
+        for row in RESULTS:
+            if row["bench"] == "configs1/route_cutthrough" \
+                    and row.get("unit") == "msgs/s":
+                headline["route_cutthrough_msgs_s"] = row["value"]
+            if row["bench"] == "configs1/auth_handshake_warm":
+                headline["auth_handshake_warm_ms"] = row["value"]
+        write_bench_json(args.out_json, "configs_bench", headline, RESULTS)
 
 
 if __name__ == "__main__":

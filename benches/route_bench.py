@@ -202,6 +202,91 @@ async def bench_plan(impls, n_users: int, n_frames: int, trials: int) -> dict:
 # the other 1023 stay on the batch plan.
 # ---------------------------------------------------------------------------
 
+async def bench_profiler_overhead(impl: str, receivers: int, msgs: int,
+                                  trials: int, sample: int = 1024,
+                                  rounds: int = 3) -> dict:
+    """ISSUE 5 budget row: what does turning on THIS PR's additions cost?
+
+    Baseline (``plane=off``): the PR-4 shipped state — tracing at the
+    default 1/1024 sample, receivers emitting delivery spans (a real
+    client decodes every frame anyway; the span emit is the marginal
+    cost) which feed the new ``cdn_e2e_latency_seconds`` histogram.
+    Measurement (``plane=on``): the same, plus the task-sampling profiler
+    ticking at its default interval. The delta — the profiler + the e2e
+    histogram's per-traced-delivery observe — must stay ≤2%.
+
+    A/B rounds are INTERLEAVED (off/on alternating) because a shared
+    deployment core drifts over a multi-second bench: back-to-back
+    blocks would attribute the drift to whichever side ran last.
+    Also runs a denser-sampled pass (1/64) purely to populate the e2e
+    latency percentiles for BENCH_r09.json."""
+    from pushcdn_tpu.proto import metrics as metrics_mod
+    from pushcdn_tpu.testing.routebench import forward_rate
+    out: dict = {}
+    offs: list = []
+    ons: list = []
+    skipped = False
+    for r in range(rounds):
+        for plane in (("off", "on") if r % 2 == 0 else ("on", "off")):
+            profiler = None
+            if plane == "on":
+                # explicit shipped-default interval: the A/B must profile
+                # even when the operator env disabled the profiler
+                profiler = asyncio.create_task(
+                    metrics_mod._task_profiler(0.25))
+            try:
+                res = await forward_rate(impl, receivers=receivers,
+                                         msgs=msgs, trials=trials,
+                                         trace_every=sample,
+                                         deliver_spans=True)
+            finally:
+                if profiler is not None:
+                    profiler.cancel()
+            if res is None:
+                skipped = True
+                break
+            (ons if plane == "on" else offs).append(res["median"])
+            gc.collect()
+        if skipped:
+            break
+    if skipped or not offs or not ons:
+        emit("route/profiler_overhead", 0, "skipped", impl=impl,
+             reason="native route-plan kernel unavailable")
+        return out
+    off_med = statistics.median(offs)
+    on_med = statistics.median(ons)
+    emit("route/profiler_overhead", off_med, "msgs/s", impl=impl,
+         plane="off", sample=sample, receivers=receivers, msgs=msgs,
+         trials=[round(r, 1) for r in offs])
+    emit("route/profiler_overhead", on_med, "msgs/s", impl=impl,
+         plane="on", sample=sample, receivers=receivers, msgs=msgs,
+         trials=[round(r, 1) for r in ons])
+    if off_med:
+        ratio = on_med / off_med
+        # the headline ``value`` rounds to 0.1 — useless against a 2%
+        # budget, so the precise delta rides the pct field
+        emit("route/profiler_overhead", ratio, "x", impl=impl,
+             tier="on-vs-off", pct=round((ratio - 1) * 100, 2))
+        out["profiler_overhead_ratio"] = round(ratio, 4)
+        out["profiler_overhead_pct"] = round((ratio - 1) * 100, 2)
+        out["headline_msgs_s"] = round(on_med, 1)
+    # e2e percentile source: denser sampling (stats row, not a rate row)
+    e2e = await forward_rate(impl, receivers=receivers,
+                             msgs=max(msgs // 2, 1000), trials=1,
+                             trace_every=64, deliver_spans=True)
+    lats = sorted((e2e or {}).get("e2e_lat_s") or [])
+    if lats:
+        def pct(q):
+            return lats[min(int(q * len(lats)), len(lats) - 1)]
+        out["e2e_p50_ms"] = round(pct(0.50) * 1e3, 3)
+        out["e2e_p99_ms"] = round(pct(0.99) * 1e3, 3)
+        emit("route/e2e_latency", out["e2e_p50_ms"], "ms", impl=impl,
+             tier="p50", samples=len(lats))
+        emit("route/e2e_latency", out["e2e_p99_ms"], "ms", impl=impl,
+             tier="p99", samples=len(lats))
+    return out
+
+
 async def bench_trace_overhead(impl: str, receivers: int, msgs: int,
                                trials: int, sample: int = 1024) -> None:
     from pushcdn_tpu.testing.routebench import forward_rate
@@ -248,7 +333,8 @@ async def bench_forward(impl: str, receivers: int, msgs: int,
     return res["median"]
 
 
-async def amain(quick: bool, impl_arg: str) -> None:
+async def amain(quick: bool, impl_arg: str,
+                out_json: Optional[str] = None) -> None:
     from pushcdn_tpu.bin.common import tune_gc
     tune_gc()
     impls = ("native", "python") if impl_arg == "auto" else (impl_arg,)
@@ -281,6 +367,36 @@ async def amain(quick: bool, impl_arg: str) -> None:
         trace_impl, receivers=8, msgs=2_000 if quick else 10_000,
         trials=2 if quick else 3)
 
+    # ISSUE 5: whole-observability-plane overhead (profiler + tracing +
+    # e2e histogram) under the same ≤2% budget, plus e2e percentiles
+    stats = await bench_profiler_overhead(
+        trace_impl, receivers=8, msgs=2_000 if quick else 10_000,
+        trials=2 if quick else 3)
+
+    if out_json:
+        write_bench_json(out_json, "route_bench", stats, RESULTS)
+
+
+def write_bench_json(path: str, section: str, headline: dict,
+                     rows: list) -> None:
+    """Merge this run's rows into a machine-readable bench trajectory
+    file (``BENCH_r09.json``) — the per-round artifacts stop being
+    hand-curated. Each producer owns one section key; a pre-existing
+    file's other sections are preserved."""
+    doc: dict = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            doc = {}
+    doc.setdefault("round", 9)
+    doc[section] = {"headline": headline, "rows": rows}
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+    print(f"wrote {path} [{section}]", file=sys.stderr)
+
 
 def main() -> None:
     ap = argparse.ArgumentParser()
@@ -289,8 +405,11 @@ def main() -> None:
                     default="auto",
                     help="which routing implementation(s) to bench; "
                          "'auto' runs the native-vs-python A/B")
+    ap.add_argument("--out-json", default=None, metavar="PATH",
+                    help="merge this run's rows + headline into a "
+                         "machine-readable bench file (e.g. BENCH_r09.json)")
     args = ap.parse_args()
-    asyncio.run(amain(args.quick, args.route_impl))
+    asyncio.run(amain(args.quick, args.route_impl, args.out_json))
 
 
 if __name__ == "__main__":

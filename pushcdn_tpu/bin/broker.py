@@ -12,7 +12,14 @@ import argparse
 import asyncio
 import os
 
-from pushcdn_tpu.bin.common import init_logging, tune_gc, keypair_from_seed, run_def_from_args
+from pushcdn_tpu.bin.common import (
+    drain_grace_s,
+    init_logging,
+    install_drain_signals,
+    keypair_from_seed,
+    run_def_from_args,
+    tune_gc,
+)
 from pushcdn_tpu.broker.broker import GIB, Broker, BrokerConfig
 
 
@@ -135,7 +142,29 @@ async def amain(args: argparse.Namespace) -> None:
                 f"(local shards: {group.local_shards}) — a non-local "
                 "attachment would silently blackhole traffic")
         group.attach(broker, shard)
-    await broker.run_until_failure()
+    # Graceful drain (ISSUE 5): SIGINT/SIGTERM flips /readyz to 503 FIRST,
+    # keeps serving in-flight traffic for PUSHCDN_DRAIN_GRACE_S, then
+    # stops — so a load balancer stops routing before the listeners close.
+    drain = asyncio.Event()
+    if not install_drain_signals(drain):
+        await broker.run_until_failure()
+        return
+    run_task = asyncio.create_task(broker.run_until_failure(),
+                                   name="broker-run")
+    drain_task = asyncio.create_task(drain.wait(), name="drain-wait")
+    try:
+        await asyncio.wait({run_task, drain_task},
+                           return_when=asyncio.FIRST_COMPLETED)
+        if drain.is_set():
+            broker.begin_drain("signal")
+            await asyncio.sleep(drain_grace_s())
+            run_task.cancel()
+            await asyncio.gather(run_task, return_exceptions=True)
+            await broker.stop()
+        else:
+            await run_task  # re-raise the core-task failure
+    finally:
+        drain_task.cancel()
 
 
 def main() -> None:

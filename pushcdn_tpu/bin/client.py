@@ -29,6 +29,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--interval", type=float, default=5.0)
     p.add_argument("--scheme", default="ed25519",
                    help="signature scheme: ed25519 | bls-bn254")
+    p.add_argument("--metrics-bind-endpoint", default=None,
+                   help="serve /metrics + /healthz + /readyz (readiness = "
+                        "live broker link)")
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -42,6 +45,18 @@ async def amain(args: argparse.Namespace) -> None:
         subscribed_topics=set(topics),
         scheme=scheme_by_name(args.scheme),
     ))
+    if args.metrics_bind_endpoint:
+        from pushcdn_tpu.proto import health as health_mod
+        from pushcdn_tpu.proto import metrics as metrics_mod
+
+        def _check_broker_link():
+            conn = client._connection
+            if conn is not None and not conn.is_closed:
+                return True, "broker link up"
+            return False, "no live broker connection"
+
+        health_mod.register_readiness("broker-link", _check_broker_link)
+        await metrics_mod.serve_metrics(args.metrics_bind_endpoint)
     await client.ensure_initialized()
     logger.info("connected; sending every %.1fs on topics %s",
                 args.interval, topics)

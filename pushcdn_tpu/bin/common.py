@@ -4,9 +4,11 @@ transport/scheme lookup by name, seeded keys."""
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import os
+import signal as signal_mod
 import sys
 from typing import Optional, Type
 
@@ -64,6 +66,36 @@ def init_logging(verbosity: int = 0) -> None:
         handler.setFormatter(logging.Formatter(
             "%(asctime)s %(levelname)-5s %(name)s: %(message)s"))
     logging.basicConfig(level=level, handlers=[handler], force=True)
+
+
+def drain_grace_s() -> float:
+    """How long a binary keeps serving (with /readyz already 503) between
+    receiving SIGINT/SIGTERM and tearing its listeners down —
+    ``PUSHCDN_DRAIN_GRACE_S`` seconds, default 0 (immediate)."""
+    raw = os.environ.get("PUSHCDN_DRAIN_GRACE_S", "").strip()
+    if not raw:
+        return 0.0
+    try:
+        return max(float(raw), 0.0)
+    except ValueError:
+        return 0.0
+
+
+def install_drain_signals(event: asyncio.Event) -> bool:
+    """Route SIGINT/SIGTERM to ``event.set()`` instead of
+    KeyboardInterrupt, so the server binaries can drain gracefully:
+    readiness flips false first, listeners close after the grace window.
+    Returns False where signal handlers are unavailable (non-main thread,
+    Windows proactor) — callers keep the KeyboardInterrupt fallback."""
+    loop = asyncio.get_running_loop()
+    installed = False
+    for sig in (signal_mod.SIGINT, signal_mod.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, event.set)
+            installed = True
+        except (NotImplementedError, RuntimeError, ValueError):
+            pass
+    return installed
 
 
 def transport_by_name(name: str) -> Type[Protocol]:

@@ -6,7 +6,13 @@ from __future__ import annotations
 import argparse
 import asyncio
 
-from pushcdn_tpu.bin.common import init_logging, tune_gc, run_def_from_args
+from pushcdn_tpu.bin.common import (
+    drain_grace_s,
+    init_logging,
+    install_drain_signals,
+    run_def_from_args,
+    tune_gc,
+)
 from pushcdn_tpu.marshal import Marshal, MarshalConfig
 
 
@@ -41,7 +47,16 @@ async def amain(args: argparse.Namespace) -> None:
         global_memory_pool_size=args.global_memory_pool_size,
     ))
     await marshal.start()
-    await asyncio.Event().wait()  # serve forever
+    # Graceful drain (ISSUE 5): readiness flips false on SIGINT/SIGTERM,
+    # the listener stays up for the grace window, then a clean stop.
+    drain = asyncio.Event()
+    if not install_drain_signals(drain):
+        await asyncio.Event().wait()  # serve until KeyboardInterrupt
+        return
+    await drain.wait()
+    marshal.begin_drain("signal")
+    await asyncio.sleep(drain_grace_s())
+    await marshal.stop()
 
 
 def main() -> None:

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import socket
+import time
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Optional
 
@@ -21,6 +23,7 @@ from pushcdn_tpu.broker.tasks import heartbeat as heartbeat_task
 from pushcdn_tpu.broker.tasks import listeners as listener_tasks
 from pushcdn_tpu.broker.tasks import sync as sync_task
 from pushcdn_tpu.broker.tasks import whitelist as whitelist_task
+from pushcdn_tpu.proto import health as health_mod
 from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto.crypto.signature import KeyPair
 from pushcdn_tpu.proto.crypto.tls import Certificate, generate_cert_from_ca, load_ca
@@ -28,6 +31,7 @@ from pushcdn_tpu.proto.def_ import RunDef
 from pushcdn_tpu.proto.discovery.base import BrokerIdentifier
 from pushcdn_tpu.proto.error import Error, ErrorKind, bail
 from pushcdn_tpu.proto.limiter import Limiter
+from pushcdn_tpu.proto.util import mnemonic
 
 if TYPE_CHECKING:  # import only for annotations (runtime import would cycle)
     from pushcdn_tpu.broker.device_plane import DevicePlaneConfig
@@ -78,6 +82,9 @@ class BrokerConfig:
     whitelist_interval_s: float = 60.0
     membership_ttl_s: float = 60.0
     auth_timeout_s: float = 5.0
+    # /readyz discovery check: re-probe the store at most this often (the
+    # heartbeat's own successes/failures refresh the cache for free)
+    discovery_probe_ttl_s: float = 5.0
     # False = register in discovery but never dial host broker links
     # (deployments whose inter-broker plane is the device mesh only)
     form_mesh: bool = True
@@ -104,6 +111,14 @@ class Broker:
         self._metrics_server = None
         self.device_plane = None
         self.seen_dialing: set[str] = set()  # peers we're currently dialing
+        # readiness state (ISSUE 5): listeners-bound latch, cached
+        # discovery probe (refreshed by the heartbeat task and, past the
+        # TTL, by an active probe from the /readyz handler), and the peer
+        # count discovery last reported (the solo-vs-partitioned signal)
+        self.listeners_bound = False
+        self._discovery_probe: tuple = (False, "not probed yet")
+        self._discovery_probe_at: Optional[float] = None
+        self.last_peer_count: Optional[int] = None
 
     @classmethod
     async def new(cls, config: BrokerConfig) -> "Broker":
@@ -124,26 +139,175 @@ class Broker:
         self.limiter = Limiter(global_pool_bytes=c.global_memory_pool_size)
         self.connections = Connections(str(self.identity))
 
-        # public listener carries users, private carries peer brokers
-        # (lib.rs:190-212)
-        self.user_listener = await self.run_def.user_def.protocol.bind(
-            _substitute_local_ip(c.public_bind_endpoint),
-            certificate=self.certificate)
-        self.broker_listener = await self.run_def.broker_def.protocol.bind(
-            _substitute_local_ip(c.private_bind_endpoint),
-            certificate=self.certificate)
-
-        if c.device_plane is not None:
-            from pushcdn_tpu.broker.device_plane import DevicePlane
-            self.device_plane = DevicePlane(self, c.device_plane)
-            self.connections.observer = self.device_plane
-
+        # The observability endpoint comes up BEFORE the listeners bind:
+        # /readyz must be probe-able (and false) during startup, so an
+        # orchestrator never routes to a broker that can't accept yet.
         if c.metrics_bind_endpoint:
             self._metrics_server = await metrics_mod.serve_metrics(
                 c.metrics_bind_endpoint)
+            self.register_observability()
+            # CI/test hook: hold the listener binds open for a beat so an
+            # external prober can observe the not-ready-before-bind state
+            # (scripts/local_cluster.py uses this to prove the readiness
+            # lifecycle end to end)
+            delay = float(os.environ.get("PUSHCDN_BIND_DELAY_S", "") or 0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+
+        try:
+            # public listener carries users, private carries peer brokers
+            # (lib.rs:190-212)
+            self.user_listener = await self.run_def.user_def.protocol.bind(
+                _substitute_local_ip(c.public_bind_endpoint),
+                certificate=self.certificate)
+            self.broker_listener = await self.run_def.broker_def.protocol.bind(
+                _substitute_local_ip(c.private_bind_endpoint),
+                certificate=self.certificate)
+            self.listeners_bound = True
+
+            if c.device_plane is not None:
+                from pushcdn_tpu.broker.device_plane import DevicePlane
+                self.device_plane = DevicePlane(self, c.device_plane)
+                self.connections.observer = self.device_plane
+        except BaseException:
+            # a failed bootstrap (port in use) must not strand a live
+            # metrics server answering /readyz for a broker that never
+            # existed, nor leave its checks in the process registries
+            if self.user_listener is not None:
+                try:
+                    await self.user_listener.close()
+                except Exception:
+                    pass
+            if self._metrics_server is not None:
+                self._metrics_server.close()
+                await self._metrics_server.wait_closed()
+                self._metrics_server = None
+                self.unregister_observability()
+            raise
+
         logger.info("broker %s ready (users on %s, brokers on %s)",
                     self.identity, c.public_bind_endpoint, c.private_bind_endpoint)
         return self
+
+    # -- observability plane (ISSUE 5) --------------------------------------
+
+    def register_observability(self) -> None:
+        """Register this broker's readiness checks + /debug/topology on
+        the process-global health/metrics registries (one broker per
+        process owns the endpoint; in-process test brokers without a
+        metrics server never register)."""
+        health_mod.register_readiness("listeners", self._check_listeners)
+        health_mod.register_readiness("discovery", self._check_discovery)
+        health_mod.register_readiness("mesh", self._check_mesh)
+        metrics_mod.register_debug_route("/debug/topology",
+                                         self._topology_route)
+
+    def unregister_observability(self) -> None:
+        for name in ("listeners", "discovery", "mesh"):
+            health_mod.unregister(name)
+        metrics_mod.unregister_debug_route("/debug/topology")
+
+    def _check_listeners(self):
+        if not self.listeners_bound:
+            return False, "listeners not bound yet"
+        return True, "user + broker listeners bound"
+
+    def note_discovery_probe(self, ok: bool, detail: str) -> None:
+        """Cache a discovery-store contact outcome (the heartbeat task
+        reports its own successes/failures here, so steady-state /readyz
+        never pays an extra round-trip)."""
+        self._discovery_probe = (ok, detail)
+        self._discovery_probe_at = time.monotonic()
+
+    async def _check_discovery(self):
+        now = time.monotonic()
+        if (self._discovery_probe_at is not None
+                and now - self._discovery_probe_at
+                < self.config.discovery_probe_ttl_s):
+            return self._discovery_probe
+        # cache expired: active probe (bounded — a hung store must not
+        # wedge the /readyz handler)
+        try:
+            async with asyncio.timeout(2.0):
+                peers = await self.discovery.get_other_brokers()
+            self.last_peer_count = len(peers)
+            self.note_discovery_probe(True, f"ok ({len(peers)} peers)")
+        except Exception as exc:
+            self.note_discovery_probe(False, f"probe failed: {exc!r}")
+        return self._discovery_probe
+
+    def _check_mesh(self):
+        """Ready when the mesh has ≥1 live peer link, or being solo is
+        intentional: discovery reports no other brokers (we ARE the
+        deployment), or the inter-broker plane is the device mesh
+        (form_mesh=False)."""
+        n = self.connections.num_brokers
+        if n >= 1:
+            return True, f"{n} peer links"
+        if not self.config.form_mesh:
+            return True, "device-mesh inter-broker plane (no host links)"
+        if self.last_peer_count == 0:
+            return True, "intentionally solo (no other brokers registered)"
+        if self.last_peer_count is None:
+            return False, "no peer links and discovery not consulted yet"
+        return (False, f"0 peer links but discovery reports "
+                       f"{self.last_peer_count} other brokers")
+
+    def begin_drain(self, reason: str = "shutdown") -> None:
+        """Flip /readyz to 503 (and record the ready-flip flight-recorder
+        event) BEFORE any listener closes — the load balancer stops
+        routing here while in-flight traffic still drains."""
+        health_mod.set_draining(reason)
+
+    def _topology_route(self, params: dict) -> dict:
+        return self.topology_snapshot()
+
+    def topology_snapshot(self, max_users: int = 256) -> dict:
+        """The live mesh as one JSON-able dict (``GET /debug/topology``):
+        peer links with writer-queue backpressure, per-connection
+        subscribe counts, interest-table summary, and the cut-through
+        snapshot's age/churn state."""
+        conns = self.connections
+        peers = []
+        for ident, handle in conns.brokers.items():
+            depth, in_flight = handle.connection.queue_stats()
+            peers.append({
+                "id": ident,
+                "writer_queue_depth": depth,
+                "bytes_in_flight": in_flight,
+                "topics": len(conns.broker_topics.get_values_of_key(ident)),
+            })
+        users = []
+        for key, handle in conns.users.items():
+            if len(users) >= max_users:
+                break
+            depth, in_flight = handle.connection.queue_stats()
+            users.append({
+                "key": mnemonic(key),
+                "topics": len(conns.user_topics.get_values_of_key(key)),
+                "writer_queue_depth": depth,
+                "bytes_in_flight": in_flight,
+            })
+        topic_cardinality = {
+            str(t): len(conns.user_topics.get_keys_by_value(t))
+            for t in sorted(set(conns.user_topics.values()))}
+        state = getattr(self, "_route_state", None)
+        return {
+            "identity": str(self.identity),
+            "draining": health_mod.draining() is not None,
+            "interest_version": conns.interest_version,
+            "num_users": conns.num_users,
+            "num_brokers": conns.num_brokers,
+            "peers": sorted(peers, key=lambda p: p["id"]),
+            "users": users,
+            "users_truncated": max(conns.num_users - len(users), 0),
+            "interest": {
+                "topic_cardinality": topic_cardinality,
+                "broker_topics": len(set(conns.broker_topics.values())),
+                "direct_map_size": len(conns.direct_map),
+            },
+            "cutthrough": state.summary() if state is not None else None,
+        }
 
     # -- supervision --------------------------------------------------------
 
@@ -176,6 +340,11 @@ class Broker:
         bail(ErrorKind.CONNECTION, f"core task {task.get_name()!r} exited")
 
     async def stop(self) -> None:
+        # readiness flips false FIRST — before any listener closes — so a
+        # prober sees "draining" rather than a connection refusal (only
+        # the endpoint-owning broker touches the process-global latch)
+        if self._metrics_server is not None:
+            self.begin_drain("broker stop")
         self._stopped.set()
         if self.update_metrics in metrics_mod.PRE_RENDER_HOOKS:
             metrics_mod.PRE_RENDER_HOOKS.remove(self.update_metrics)
@@ -192,12 +361,17 @@ class Broker:
                     await listener.close()
                 except Exception:
                     pass
+        self.listeners_bound = False
         if self.discovery is not None:
             await self.discovery.close()
         if self._metrics_server is not None:
             self._metrics_server.close()
             await self._metrics_server.wait_closed()
             self._metrics_server = None
+            # leave the process-global registries clean for the next
+            # owner (in-process restarts, test isolation)
+            self.unregister_observability()
+            health_mod.clear_draining()
         broker_metrics.NUM_USERS_CONNECTED.set(0)
         broker_metrics.NUM_BROKERS_CONNECTED.set(0)
         logger.info("broker %s stopped", self.identity)

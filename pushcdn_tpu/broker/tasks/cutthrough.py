@@ -40,6 +40,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 from typing import TYPE_CHECKING, List, Optional
 
 import numpy as np
@@ -133,7 +134,8 @@ class RouteState:
     """
 
     __slots__ = ("broker", "planner", "version", "user_keys", "broker_ids",
-                 "usable", "_frames_since_rebuild", "_skip_rebuilds")
+                 "usable", "_frames_since_rebuild", "_skip_rebuilds",
+                 "built_at")
 
     def __init__(self, broker: "Broker", planner):
         self.broker = broker
@@ -146,6 +148,21 @@ class RouteState:
         # the churn backoff
         self._frames_since_rebuild = 1 << 30
         self._skip_rebuilds = 0
+        self.built_at: Optional[float] = None  # monotonic, last rebuild
+
+    def summary(self) -> dict:
+        """Operator-facing snapshot state for ``/debug/topology``."""
+        return {
+            "usable": self.usable,
+            "snapshot_version": self.version,
+            "interest_version": self.broker.connections.interest_version,
+            "snapshot_age_s": (round(time.monotonic() - self.built_at, 3)
+                               if self.built_at is not None else None),
+            "churn_guard_skips_left": self._skip_rebuilds,
+            "frames_since_rebuild": min(self._frames_since_rebuild, 1 << 30),
+            "snapshot_users": len(self.user_keys),
+            "snapshot_brokers": len(self.broker_ids),
+        }
 
     # -- snapshot ------------------------------------------------------------
 
@@ -193,6 +210,7 @@ class RouteState:
             self.version = conns.interest_version
             self.user_keys = users
             self.broker_ids = brokers
+            self.built_at = time.monotonic()
             metrics_mod.ROUTE_TABLE_REBUILDS.inc()
             if self._frames_since_rebuild < _REBUILD_MIN_FRAMES:
                 self._skip_rebuilds = _REBUILD_BACKOFF
@@ -457,8 +475,14 @@ class RouteState:
                     return await self._chunk_scalar_from(
                         sender_id, chunk, offs, lens, pos, is_user,
                         egress, interest_cache, conn)
+                t0 = time.perf_counter()
                 consumed, stop, peers, frames = planner.plan(
                     buf, offs, lens, pos, mode)
+                # one perf_counter pair + locked add per CHUNK-level plan
+                # call — the latency-attribution seam /metrics exposes as
+                # cdn_native_seconds{kernel="route_plan"}
+                metrics_mod.NATIVE_PLAN_SECONDS.inc(
+                    time.perf_counter() - t0)
                 if consumed:
                     metrics_mod.ROUTE_BATCH_SIZE.observe(consumed)
                     metrics_mod.ROUTE_CUTTHROUGH_FRAMES.inc(consumed)

@@ -40,8 +40,16 @@ async def _dial(broker: "Broker", peer) -> None:
 
 
 async def heartbeat_once(broker: "Broker") -> None:
-    await broker.discovery.perform_heartbeat(
-        broker.connections.num_users, broker.config.membership_ttl_s)
+    # every heartbeat IS a discovery-store probe: report the outcome to
+    # the readiness plane so /readyz's cached-TTL check stays fresh for
+    # free in steady state (ISSUE 5)
+    try:
+        await broker.discovery.perform_heartbeat(
+            broker.connections.num_users, broker.config.membership_ttl_s)
+    except Exception as exc:
+        broker.note_discovery_probe(False, f"heartbeat failed: {exc!r}")
+        raise
+    broker.note_discovery_probe(True, "heartbeat ok")
     if not broker.config.form_mesh:
         # device-mesh-only inter-broker plane: skip host dialing only while
         # the mesh plane actually covers ALL inter-broker traffic. Fail open
@@ -61,6 +69,7 @@ async def heartbeat_once(broker: "Broker") -> None:
                 logger.warning(                   # once, not every tick
                     "device plane %s; enabling host mesh dialing", state)
     peers = await broker.discovery.get_other_brokers()
+    broker.last_peer_count = len(peers)  # the /readyz solo-vs-partitioned signal
     me = str(broker.identity)
     candidates = [
         p for p in peers

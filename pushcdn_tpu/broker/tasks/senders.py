@@ -10,9 +10,11 @@ fan-out shares one payload buffer (Arc-clone parity, handler.rs hot path).
 from __future__ import annotations
 
 import logging
+import time
 from typing import TYPE_CHECKING, Iterable, List, Optional
 
 from pushcdn_tpu import native as native_mod
+from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto.limiter import Bytes
 from pushcdn_tpu.proto.util import mnemonic
 
@@ -49,7 +51,12 @@ def pre_encode_frames(raws) -> Optional[bytearray]:
         if total > _PRE_ENCODE_MAX_TOTAL:
             return None
         payloads.append(data)
-    return encoder.encode_detached(payloads)
+    t0 = time.perf_counter()
+    out = encoder.encode_detached(payloads)
+    # batch-level native-seam accounting: one perf_counter pair per
+    # fan-out batch (cdn_native_seconds{kernel="egress_encode"})
+    metrics_mod.NATIVE_EGRESS_SECONDS.inc(time.perf_counter() - t0)
+    return out
 
 
 async def try_send_to_user(broker: "Broker", public_key: bytes,

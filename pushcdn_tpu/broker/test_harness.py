@@ -63,6 +63,9 @@ class TestDefinition:
 
     connected_users: Sequence[Sequence[int]] = ()
     connected_brokers: Sequence[Tuple[Sequence[int], Sequence[bytes]]] = ()
+    # e.g. "127.0.0.1:0" to exercise the observability endpoint
+    # (/healthz, /readyz, /debug/topology) against an injected broker
+    metrics_bind_endpoint: Optional[str] = None
 
     async def run(self) -> "TestRun":
         uid = next(_UNIQUE)
@@ -76,6 +79,7 @@ class TestDefinition:
             public_bind_endpoint=f"test-pub-{uid}",
             private_advertise_endpoint=f"test-priv-{uid}",
             private_bind_endpoint=f"test-priv-{uid}",
+            metrics_bind_endpoint=self.metrics_bind_endpoint,
             # keep periodic tasks out of the way for determinism
             heartbeat_interval_s=3600, sync_interval_s=3600,
             whitelist_interval_s=3600,

@@ -190,13 +190,21 @@ class BlsBn254Scheme(SignatureScheme):
         identical to the uncached path for every input (asserted by the
         in-library self-test, including across LRU eviction); set
         ``PUSHCDN_BLS_PK_CACHE=0`` to disable."""
+        import time as _time
+
         from pushcdn_tpu.native import bls
+        from pushcdn_tpu.proto import metrics as metrics_mod
+        t0 = _time.perf_counter()
         try:
             return bls.verify_cached(bytes(public_key),
                                      _namespaced(namespace, message),
                                      bytes(signature))
         except (AssertionError, TypeError):
             return False
+        finally:
+            # handshake-level native-seam accounting: attributes auth CPU
+            # on /metrics (cdn_native_seconds{kernel="bls_verify"})
+            metrics_mod.NATIVE_BLS_SECONDS.inc(_time.perf_counter() - t0)
 
     @classmethod
     def verify_batch(cls, items) -> bool:
@@ -207,7 +215,11 @@ class BlsBn254Scheme(SignatureScheme):
         pk-side Miller loops replay cached line tables fused on one
         shared squaring chain (``bls.verify_batch_cached``)."""
         import os as _os
+        import time as _time
+
         from pushcdn_tpu.native import bls
+        from pushcdn_tpu.proto import metrics as metrics_mod
+        t0 = _time.perf_counter()
         try:
             return bls.verify_batch(
                 [(bytes(pk), _namespaced(ns, msg), bytes(sig))
@@ -215,6 +227,8 @@ class BlsBn254Scheme(SignatureScheme):
                 _os.urandom(32))
         except (AssertionError, TypeError, ValueError):
             return False
+        finally:
+            metrics_mod.NATIVE_BLS_SECONDS.inc(_time.perf_counter() - t0)
 
 
 DEFAULT_SCHEME = Ed25519Scheme

@@ -62,12 +62,21 @@ class FlightRecorder:
     def __len__(self) -> int:
         return len(self._events)
 
-    def trail(self) -> str:
-        """The formatted trail: one line per event, age-relative."""
+    def trail(self, max_events: Optional[int] = None) -> str:
+        """The formatted trail: one line per event, age-relative.
+        ``max_events`` keeps only the most recent N (the interesting end
+        of the ring)."""
         now = time.monotonic()
+        events = list(self._events)
+        dropped = 0
+        if max_events is not None and len(events) > max_events:
+            dropped = len(events) - max_events
+            events = events[-max_events:] if max_events > 0 else []
         lines = [f"flight recorder [{self.label}] "
-                 f"({len(self._events)} events)"]
-        for t, event, detail in self._events:
+                 f"({len(self._events)} events"
+                 + (f", showing last {len(events)}" if dropped else "")
+                 + ")"]
+        for t, event, detail in events:
             if isinstance(detail, str):
                 d = f"  {detail}" if detail else ""
             else:
@@ -96,13 +105,33 @@ class FlightRecorder:
         return True
 
 
-def render_all() -> str:
-    """Every live recorder's trail — the ``/debug/flightrec`` body."""
+DEFAULT_RENDER_LIMIT = 10_000
+
+
+def render_all(limit: Optional[int] = None) -> str:
+    """Every live recorder's trail — the ``/debug/flightrec`` body.
+
+    ``limit`` caps the TOTAL number of events rendered (default 10000,
+    overridable via the endpoint's ``?limit=`` query): a broker holding
+    tens of thousands of connections must not build an unbounded response
+    body inside its event loop."""
+    if limit is None:
+        limit = DEFAULT_RENDER_LIMIT
     recs = sorted(_LIVE, key=lambda r: r.label)
     if not recs:
         return "0 flight recorders\n"
-    out = [f"{len(recs)} flight recorders", ""]
-    out.extend(r.trail() for r in recs)
+    out = [f"{len(recs)} flight recorders (event limit {limit})", ""]
+    budget = max(limit, 0)
+    shown = 0
+    for r in recs:
+        if budget <= 0:
+            out.append(f"... truncated: {len(recs) - shown} more "
+                       f"recorders past the {limit}-event limit "
+                       "(raise ?limit=)")
+            break
+        out.append(r.trail(max_events=budget))
+        budget -= min(len(r), budget)
+        shown += 1
     return "\n".join(out) + "\n"
 
 

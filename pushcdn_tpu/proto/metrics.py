@@ -29,9 +29,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import re
 import sys
 import threading
-from typing import Dict, List
+import time
+from typing import Dict, List, Optional
 
 _LOCK = threading.Lock()
 
@@ -98,15 +101,21 @@ class Counter(_LabeledMixin):
         with _LOCK:
             self.value += n
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
+        # OpenMetrics mandates the _total suffix on counter SAMPLES (the
+        # family name in TYPE/HELP stays bare); a strict OM parser —
+        # Prometheus negotiates OM by default — rejects the whole scrape
+        # otherwise. Plain 0.0.4 scrapes keep the historical bare names.
+        suffix = "_total" if openmetrics else ""
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} counter"]
         with _LOCK:
             total = self.value
             for child in self._sorted_children():
                 total += child.value
-                out.append(f"{self.name}{{{child._labels}}} {child.value}")
-            out.append(f"{self.name} {total}")
+                out.append(f"{self.name}{suffix}{{{child._labels}}}"
+                           f" {child.value}")
+            out.append(f"{self.name}{suffix} {total}")
         return "\n".join(out) + "\n"
 
 
@@ -154,7 +163,14 @@ class Gauge(_LabeledMixin):
 
 
 class Histogram(_LabeledMixin):
-    """Fixed-bucket histogram (seconds)."""
+    """Fixed-bucket histogram (seconds).
+
+    Optional OpenMetrics exemplars: ``observe(v, exemplar={...})`` pins the
+    given label dict (e.g. ``{"trace_id": "ab12..."}``) to the bucket the
+    sample landed in; an OpenMetrics-negotiated scrape renders each
+    bucket's most recent exemplar as ``# {trace_id="..."} value ts`` so a
+    dashboard can jump from a latency bucket straight to the trace that
+    populated it."""
 
     DEFAULT_BUCKETS = (1e-5, 1e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
 
@@ -166,6 +182,7 @@ class Histogram(_LabeledMixin):
         self.counts = [0] * (len(self.buckets) + 1)
         self.sum = 0.0
         self.total = 0
+        self.exemplars: List[Optional[tuple]] = [None] * (len(self.buckets) + 1)
         self._init_labels(labels)
         _REGISTRY[name] = self
 
@@ -176,42 +193,60 @@ class Histogram(_LabeledMixin):
         child.counts = [0] * (len(self.buckets) + 1)
         child.sum = 0.0
         child.total = 0
+        child.exemplars = [None] * (len(self.buckets) + 1)
         child._init_labels(())
         return child
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[dict] = None) -> None:
         # The whole update is one critical section: sum/total/bucket are a
         # multi-step read-modify-write, and off-loop observers (native-code
         # callers, bench threads) would otherwise lose samples against the
         # event loop's updates.
+        if exemplar is not None:
+            exemplar = ("{" + ",".join(
+                f'{k}="{_escape_label(val)}"'
+                for k, val in exemplar.items()) + "}", v, time.time())
         with _LOCK:
             self.sum += v
             self.total += 1
             for i, b in enumerate(self.buckets):
                 if v <= b:
                     self.counts[i] += 1
+                    if exemplar is not None:
+                        self.exemplars[i] = exemplar
                     return
             self.counts[-1] += 1
+            if exemplar is not None:
+                self.exemplars[-1] = exemplar
 
-    def _render_series(self, out: List[str], labels: str) -> None:
+    def _render_series(self, out: List[str], labels: str,
+                       exemplars: bool = False) -> None:
         sep = f"{labels}," if labels else ""
         cum = 0
-        for b, c in zip(self.buckets, self.counts):
+        for i, (b, c) in enumerate(zip(self.buckets, self.counts)):
             cum += c
-            out.append(f'{self.name}_bucket{{{sep}le="{b}"}} {cum}')
-        out.append(f'{self.name}_bucket{{{sep}le="+Inf"}} {self.total}')
+            line = f'{self.name}_bucket{{{sep}le="{b}"}} {cum}'
+            ex = self.exemplars[i] if exemplars else None
+            if ex is not None:
+                line += f" # {ex[0]} {ex[1]} {ex[2]:.3f}"
+            out.append(line)
+        line = f'{self.name}_bucket{{{sep}le="+Inf"}} {self.total}'
+        ex = self.exemplars[-1] if exemplars else None
+        if ex is not None:
+            line += f" # {ex[0]} {ex[1]} {ex[2]:.3f}"
+        out.append(line)
         tail = f"{{{labels}}}" if labels else ""
         out.append(f"{self.name}_sum{tail} {self.sum}")
         out.append(f"{self.name}_count{tail} {self.total}")
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
         with _LOCK:
             for child in self._sorted_children():
-                child._render_series(out, child._labels)
+                child._render_series(out, child._labels, exemplars)
             if not self._label_names:
-                self._render_series(out, "")
+                self._render_series(out, "", exemplars)
         return "\n".join(out) + "\n"
 
 
@@ -317,6 +352,45 @@ TRACE_HOP_LATENCY = Histogram(
     "Time from a traced message's origin to each lifecycle hop "
     "(hop=publish|auth|ingress|plan|egress|delivery)",
     labels=("hop",))
+
+# End-to-end SLO histogram (ISSUE 5): recorded at DELIVERY from the traced
+# message's carried origin_ns — the publish→delivery latency an end user
+# experienced, with OpenMetrics exemplars pinning each bucket to the trace
+# id that last landed there (scrape with Accept: application/openmetrics-
+# text to see them; plain scrapes omit exemplars for strict 0.0.4 parsers).
+E2E_LATENCY = Histogram(
+    "cdn_e2e_latency_seconds",
+    "End-to-end publish->delivery latency of traced messages, recorded at "
+    "delivery from the carried origin timestamp (single-machine clocks; "
+    "cross-machine skew applies)",
+    buckets=(5e-4, 1e-3, 2.5e-3, 5e-3, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0))
+
+# Monotonic-clock accounting around the native seams we own: one
+# perf_counter pair per *batch-level* call (route plan per chunk, egress
+# encode per fan-out batch, BLS verify per handshake), so a scrape answers
+# "is the loop hot in planning, egress, or auth" without a debugger.
+NATIVE_SECONDS = Counter(
+    "cdn_native_seconds",
+    "Cumulative wall-clock seconds inside instrumented native seams "
+    "(kernel=route_plan|egress_encode|bls_verify)",
+    labels=("kernel",))
+NATIVE_PLAN_SECONDS = NATIVE_SECONDS.labels(kernel="route_plan")
+NATIVE_EGRESS_SECONDS = NATIVE_SECONDS.labels(kernel="egress_encode")
+NATIVE_BLS_SECONDS = NATIVE_SECONDS.labels(kernel="bls_verify")
+
+# Per-task sampling profiler (ISSUE 5): every tick the profiler walks
+# asyncio.all_tasks() and attributes one sample per live task to its task
+# FAMILY (the task name with trailing ids/counters stripped, so every
+# "user-receive" connection task lands in one series). samples x interval
+# ~= task-alive wall-clock seconds; comparing families across scrapes
+# shows where the loop's task population grows or leaks.
+TASK_SAMPLES = Counter(
+    "cdn_task_samples",
+    "Sampling profiler: one sample per live asyncio task per tick, "
+    "labeled by task family (samples x PUSHCDN_PROFILE_INTERVAL "
+    "~= task-alive seconds)",
+    labels=("task",))
 
 # Build/runtime identity: one constant-1 series whose labels carry the
 # package version, jax version, and the ACTUAL backend/device kind —
@@ -438,7 +512,7 @@ PRE_RENDER_HOOKS.append(_refresh_pools)
 _hook_failures: set = set()
 
 
-def render_all() -> str:
+def render_all(openmetrics: bool = False) -> str:
     for hook in list(PRE_RENDER_HOOKS):
         try:
             hook()
@@ -450,7 +524,17 @@ def render_all() -> str:
                 logging.getLogger("pushcdn.metrics").exception(
                     "metrics pre-render hook %r failed; its gauges are "
                     "stale from here on", hook)
-    return "".join(m.render() for m in list(_REGISTRY.values()))
+    parts = []
+    for m in list(_REGISTRY.values()):
+        if openmetrics and isinstance(m, Histogram):
+            parts.append(m.render(exemplars=True))
+        elif openmetrics and isinstance(m, Counter):
+            parts.append(m.render(openmetrics=True))
+        else:
+            parts.append(m.render())
+    if openmetrics:
+        parts.append("# EOF\n")
+    return "".join(parts)
 
 
 def render_tasks() -> str:
@@ -528,56 +612,269 @@ def _refresh_loop_lag() -> None:
 PRE_RENDER_HOOKS.append(_refresh_loop_lag)
 
 
+# most recent single sample, never reset by a scrape — what /healthz
+# reads (a loop so wedged the sampler can't run can't answer /healthz
+# either, so the probe's own timeout covers total stalls)
+_loop_lag_last = 0.0
+
+
 async def _loop_lag_sampler(interval_s: float = 0.25) -> None:
     """Sample event-loop scheduling lag: how late a sleep() wakeup ran.
     A loop hogged by a long synchronous section (native call, giant
     decode) shows up here before it shows up as user-visible latency.
     Samples accumulate as a max; the pre-render hook publishes-and-resets
     per scrape."""
-    global _loop_lag_peak
+    global _loop_lag_peak, _loop_lag_last
     loop = asyncio.get_running_loop()
     while True:
         t0 = loop.time()
         await asyncio.sleep(interval_s)
         lag = loop.time() - t0 - interval_s
+        _loop_lag_last = lag
         if lag > _loop_lag_peak:
             _loop_lag_peak = lag
 
 
-async def serve_metrics(bind_endpoint: str) -> asyncio.AbstractServer:
-    """Serve ``GET /metrics`` as Prometheus text (parity metrics.rs:18-39),
-    ``GET /tasks`` (asyncio task dump) and ``GET /debug/flightrec`` (every
-    live flight recorder's trail).
+# ---------------------------------------------------------------------------
+# per-task sampling profiler (ISSUE 5)
+# ---------------------------------------------------------------------------
 
-    Returns the server; also spawns the supervised background samplers
-    (running-latency calculator, event-loop-lag sampler).
+def profile_interval_s() -> float:
+    """Profiler tick from ``PUSHCDN_PROFILE_INTERVAL`` (seconds; default
+    0.25, ``0`` disables the sampler entirely)."""
+    raw = os.environ.get("PUSHCDN_PROFILE_INTERVAL", "").strip()
+    if not raw:
+        return 0.25
+    try:
+        return max(float(raw), 0.0)
+    except ValueError:
+        return 0.25
+
+
+# "user-receive-7f3a" / "Task-12" / "dial-0x7f.." → one family each;
+# iteratively strip trailing counters and hex-ish ids
+_FAMILY_STRIP = re.compile(r"[-_.:]?(?:0x)?[0-9a-fA-F]{4,}$|[-_.:]?\d+$")
+_MAX_TASK_FAMILIES = 64
+
+_family_children: Dict[str, Counter] = {}
+
+
+def _task_family(name: str) -> str:
+    while True:
+        stripped = _FAMILY_STRIP.sub("", name)
+        if stripped == name:
+            break
+        name = stripped
+    return name or "anonymous"
+
+
+def _family_child(family: str) -> Counter:
+    child = _family_children.get(family)
+    if child is None:
+        # bounded cardinality: past the cap, new families fold into
+        # "other" (a runaway label set would bloat every scrape forever)
+        if len(_family_children) >= _MAX_TASK_FAMILIES \
+                and family != "other":
+            return _family_child("other")
+        child = TASK_SAMPLES.labels(task=family)
+        _family_children[family] = child
+    return child
+
+
+async def _task_profiler(interval_s: Optional[float] = None) -> None:
+    """The sampling profiler task: each tick attributes one sample per
+    live asyncio task to its family. Cost per tick is one all_tasks()
+    snapshot + a dict count — at the default 0.25 s interval this is
+    noise even with thousands of connection tasks (A/B'd in
+    benches/route_bench.py under the 2% forwarding budget)."""
+    if interval_s is None:
+        interval_s = profile_interval_s()
+    if interval_s <= 0:
+        # disabled (PUSHCDN_PROFILE_INTERVAL=0): park instead of
+        # busy-looping on sleep(0) — direct spawners (benches) and a
+        # supervised() wrapper both stay quiet
+        await asyncio.Event().wait()
+        return
+    name_cache: Dict[str, str] = {}
+    while True:
+        await asyncio.sleep(interval_s)
+        counts: Dict[str, int] = {}
+        for task in asyncio.all_tasks():
+            if task.done():
+                continue
+            name = task.get_name()
+            # unnamed tasks ("Task-<n>") are the dominant population on a
+            # loaded broker and every name is unique — a cache keyed on
+            # the full name would thrash, and running the regex per task
+            # per tick is exactly the loop stall this profiler hunts
+            if name.startswith("Task-") and name[5:].isdigit():
+                family = "Task"
+            else:
+                family = name_cache.get(name)
+                if family is None:
+                    if len(name_cache) > 4 * _MAX_TASK_FAMILIES:
+                        name_cache.clear()  # renamed-task churn bound
+                    family = name_cache[name] = _task_family(name)
+            counts[family] = counts.get(family, 0) + 1
+        for family, n in counts.items():
+            _family_child(family).inc(n)
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint: parsed request line + route table (ISSUE 5)
+# ---------------------------------------------------------------------------
+
+# Extra debug routes registered by components (the broker's
+# /debug/topology). A provider is ``fn(params) -> dict`` (rendered as
+# JSON) or ``-> (status, content_type, body_str)``; it may be async.
+DEBUG_ROUTES: Dict[str, object] = {}
+
+
+def register_debug_route(path: str, provider) -> None:
+    DEBUG_ROUTES[path] = provider
+
+
+def unregister_debug_route(path: str) -> None:
+    DEBUG_ROUTES.pop(path, None)
+
+
+def _check_loop_lag():
+    """Built-in liveness: the most recent loop-lag sample under threshold
+    (``PUSHCDN_HEALTH_LAG_MAX`` seconds, default 2.0). A loop so wedged
+    the sampler can't run at all can't answer /healthz either — the
+    probe's own timeout covers that case."""
+    try:
+        limit = float(os.environ.get("PUSHCDN_HEALTH_LAG_MAX", "") or 2.0)
+    except ValueError:
+        limit = 2.0
+    lag = _loop_lag_last
+    return lag < limit, f"last loop-lag sample {lag * 1e3:.1f}ms (limit {limit:.1f}s)"
+
+
+def _check_samplers():
+    """Built-in liveness: the supervised background samplers are alive
+    (supervised() restarts them on death, so a done task here means the
+    supervisor itself died). Only THIS loop's tasks count — a leftover
+    set from a torn-down loop (in-process restarts, tests) is pruned by
+    the next serve_metrics, not a liveness failure."""
+    loop = asyncio.get_running_loop()
+    mine = [t for t in _BACKGROUND_TASKS if t.get_loop() is loop]
+    dead = [t.get_name() for t in mine if t.done()]
+    if dead:
+        return False, f"dead: {','.join(dead)}"
+    return True, f"{len(mine)} supervised samplers running"
+
+
+def _parse_qs(query: str) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    for part in query.split("&"):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        params[k] = v
+    return params
+
+
+async def serve_metrics(bind_endpoint: str) -> asyncio.AbstractServer:
+    """Serve the observability endpoints over a parsed, routed HTTP/1.1
+    GET surface (the pre-ISSUE-5 substring dispatch served the flightrec
+    body to any request merely *containing* ``/debug/flightrec``, e.g. in
+    a query string):
+
+    - ``GET /metrics`` — Prometheus text (parity metrics.rs:18-39); with
+      ``Accept: application/openmetrics-text`` the body carries bucket
+      exemplars (trace ids on ``cdn_e2e_latency_seconds``) and ``# EOF``.
+    - ``GET /healthz`` / ``GET /readyz`` — liveness/readiness JSON
+      (:mod:`pushcdn_tpu.proto.health`); 503 when a check fails or the
+      process is draining. Never initializes jax.
+    - ``GET /tasks`` — asyncio task dump (the poor man's tokio-console).
+    - ``GET /debug/flightrec[?limit=N]`` — live flight-recorder trails,
+      capped at N events total (default 10000).
+    - ``GET /debug/...`` — component-registered routes (broker:
+      ``/debug/topology``).
+
+    Non-GET methods get 405, unknown paths 404, a garbled request line
+    400. Returns the server; also spawns the supervised background
+    samplers (running-latency calculator, event-loop-lag sampler, task
+    profiler) and registers the built-in liveness checks.
     """
-    from pushcdn_tpu.proto import flightrec
+    from pushcdn_tpu.proto import flightrec, health
     from pushcdn_tpu.proto.error import parse_endpoint
     host, port = parse_endpoint(bind_endpoint)
 
-    def _plain(body: bytes, content_type: bytes = b"text/plain") -> bytes:
-        return (b"HTTP/1.1 200 OK\r\nContent-Type: " + content_type
-                + f"\r\nContent-Length: {len(body)}\r\n\r\n".encode() + body)
+    def _resp(status: int, body: bytes,
+              content_type: str = "text/plain",
+              extra_headers: str = "") -> bytes:
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  405: "Method Not Allowed",
+                  503: "Service Unavailable"}.get(status, "OK")
+        return (f"HTTP/1.1 {status} {reason}\r\n"
+                f"Content-Type: {content_type}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"{extra_headers}\r\n".encode() + body)
+
+    async def _route(method: str, path: str, params: Dict[str, str],
+                     headers: Dict[str, str]) -> bytes:
+        if method != "GET":
+            return _resp(405, b"only GET is supported\n",
+                         extra_headers="Allow: GET\r\n")
+        if path == "/metrics":
+            om = "openmetrics" in headers.get("accept", "")
+            return _resp(200, render_all(openmetrics=om).encode(),
+                         "application/openmetrics-text; version=1.0.0; "
+                         "charset=utf-8" if om
+                         else "text/plain; version=0.0.4")
+        if path == "/healthz":
+            status, body = await health.render_healthz()
+            return _resp(status, body.encode(), "application/json")
+        if path == "/readyz":
+            status, body = await health.render_readyz()
+            return _resp(status, body.encode(), "application/json")
+        if path == "/tasks":
+            # async-runtime introspection (the reference wires
+            # tokio-console behind tokio_unstable; here a plain dump of
+            # every live asyncio task: name, state, current frame)
+            return _resp(200, render_tasks().encode())
+        if path == "/debug/flightrec":
+            try:
+                limit = int(params.get("limit", ""))
+            except ValueError:
+                limit = None
+            return _resp(200, flightrec.render_all(limit=limit).encode())
+        provider = DEBUG_ROUTES.get(path)
+        if provider is not None:
+            result = provider(params)
+            if asyncio.iscoroutine(result):
+                result = await result
+            if isinstance(result, dict):
+                import json as json_mod
+                return _resp(200, (json_mod.dumps(result) + "\n").encode(),
+                             "application/json")
+            status, content_type, body = result
+            return _resp(status, body.encode(), content_type)
+        return _resp(404, b"not found\n")
 
     async def handler(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
             request = await reader.readline()
-            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
-                pass
-            if b"/debug/flightrec" in request:
-                writer.write(_plain(flightrec.render_all().encode()))
-            elif b"/metrics" in request:
-                writer.write(_plain(
-                    render_all().encode(),
-                    b"text/plain; version=0.0.4"))
-            elif b"/tasks" in request:
-                # async-runtime introspection (the reference wires
-                # tokio-console behind tokio_unstable; here a plain dump of
-                # every live asyncio task: name, state, current frame)
-                writer.write(_plain(render_tasks().encode()))
+            headers: Dict[str, str] = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, sep, v = line.partition(b":")
+                if sep:
+                    headers[k.strip().decode("latin1").lower()] = \
+                        v.strip().decode("latin1")
+            parts = request.split()
+            if len(parts) < 2:
+                writer.write(_resp(400, b"bad request line\n"))
             else:
-                writer.write(b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n\r\n")
+                method = parts[0].decode("latin1")
+                target = parts[1].decode("latin1")
+                path, _, query = target.partition("?")
+                writer.write(await _route(method, path, _parse_qs(query),
+                                          headers))
             await writer.drain()
         except Exception:
             pass
@@ -588,6 +885,13 @@ async def serve_metrics(bind_endpoint: str) -> asyncio.AbstractServer:
                 pass
 
     server = await asyncio.start_server(handler, host, port)
+    health.register_liveness("loop-lag", _check_loop_lag)
+    health.register_liveness("samplers", _check_samplers)
+    # prune samplers from dead/foreign event loops (in-process restarts,
+    # test suites) so the live loop gets its own set
+    loop = asyncio.get_running_loop()
+    _BACKGROUND_TASKS[:] = [t for t in _BACKGROUND_TASKS
+                            if not t.done() and t.get_loop() is loop]
     if not _BACKGROUND_TASKS:  # exactly one sampler set per process
         _BACKGROUND_TASKS.append(asyncio.create_task(
             supervised(_running_latency_calculator, "running-latency"),
@@ -595,4 +899,8 @@ async def serve_metrics(bind_endpoint: str) -> asyncio.AbstractServer:
         _BACKGROUND_TASKS.append(asyncio.create_task(
             supervised(_loop_lag_sampler, "loop-lag"),
             name="metrics-loop-lag"))
+        if profile_interval_s() > 0:
+            _BACKGROUND_TASKS.append(asyncio.create_task(
+                supervised(_task_profiler, "task-profiler"),
+                name="metrics-task-profiler"))
     return server

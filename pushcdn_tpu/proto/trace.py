@@ -109,6 +109,11 @@ def emit(hop: str, trace: Trace, detail: str = "") -> None:
     child = _HOP_CHILDREN.get(hop)
     (child if child is not None
      else HOP_LATENCY.labels(hop=hop)).observe(lat)
+    if hop == "delivery":
+        # the SLO histogram: publish→delivery as the receiver saw it, with
+        # an OpenMetrics exemplar pinning the bucket to this trace id
+        metrics_mod.E2E_LATENCY.observe(
+            lat, exemplar={"trace_id": f"{tid:016x}"})
     recent.append((hop, tid, origin, now, detail))
     if _LOG_PATH:
         _log({"hop": hop, "trace_id": tid, "origin_ns": origin,

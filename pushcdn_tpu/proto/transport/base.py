@@ -354,6 +354,29 @@ class Connection:
         if self._writer_task is None:
             self._writer_task = asyncio.create_task(self._writer_loop())
 
+    def queue_stats(self) -> tuple:
+        """``(entries, bytes)`` waiting in the send queue — the topology
+        endpoint's per-peer backpressure view. Event-loop context only:
+        peeks the queue's internal deque without mutating it (an entry
+        dequeued concurrently just stops being counted)."""
+        depth = self._send_q.qsize()
+        total = 0
+        try:
+            for item in list(self._send_q._queue):
+                if isinstance(item, tuple):
+                    item = item[0]
+                if isinstance(item, list):
+                    for p in item:
+                        data = p.data if isinstance(p, Bytes) else p
+                        total += len(data)
+                elif isinstance(item, (Bytes, PreEncoded)):
+                    total += len(item.data)
+                elif isinstance(item, (bytes, bytearray, memoryview)):
+                    total += len(item)
+        except Exception:
+            pass
+        return depth, total
+
     # -- actor loops --------------------------------------------------------
 
     # Batch small frames into one buffer per flush: per-frame event-loop +

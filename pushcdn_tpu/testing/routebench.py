@@ -16,11 +16,14 @@ import statistics
 import time
 from typing import Optional
 
+import numpy as np
+
 
 async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
                        trials: int = 3, payload: int = 512,
                        batch: int = 64,
-                       trace_every: int = 0) -> Optional[dict]:
+                       trace_every: int = 0,
+                       deliver_spans: bool = False) -> Optional[dict]:
     """Measure broker forwarding msgs/s with the routing plane forced to
     ``impl`` (``auto``/``native``/``python``). Returns ``None`` when
     ``impl == "native"`` but the kernel is unavailable (callers emit a
@@ -29,7 +32,14 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
 
     ``trace_every > 0`` stamps every Nth sent frame with a lifecycle-trace
     context (proto.trace wire flag), exactly what a client publishing at
-    ``PUSHCDN_TRACE_SAMPLE=N`` produces — the trace-overhead A/B row."""
+    ``PUSHCDN_TRACE_SAMPLE=N`` produces — the trace-overhead A/B row.
+    ``deliver_spans=True`` makes receivers additionally do what a real
+    client does with a traced frame: emit the ``delivery`` span (feeding
+    ``cdn_e2e_latency_seconds``); the result dict then carries
+    ``e2e_lat_s``, the raw publish→delivery latencies, for bench-side
+    p50/p99. Kept opt-in because these receivers skip frame decode (a
+    real client pays it anyway), so the flag-scan is bench-side cost that
+    must not pollute the broker-side trace-overhead A/B."""
     from pushcdn_tpu.broker.tasks import cutthrough
     from pushcdn_tpu.broker.test_harness import TestDefinition
     from pushcdn_tpu.native import routeplan
@@ -52,18 +62,48 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
             connected_users=[[]] + [[0]] * receivers).run()
         try:
             frame = serialize(Broadcast([0], os.urandom(payload)))
-            traced_frame = trace_lib.stamp_frame(
-                frame, trace_lib.new_trace()) if trace_every else None
             sender = run.user(0).remote
             msgs = max(batch, (msgs // batch) * batch)
+            e2e_lat_s: list = []
+
+            def _note_delivery(data) -> None:
+                # the real client's per-traced-frame work: strip the
+                # trace block + emit the delivery span (the e2e SLO seam)
+                _, tr = trace_lib.strip_frame(bytes(data))
+                if tr is not None:
+                    trace_lib.emit("delivery", tr)
+                    e2e_lat_s.append(max(time.time_ns() - tr[1], 0) / 1e9)
 
             async def drain(conn, n):
                 got = 0
                 async with asyncio.timeout(120):
                     while got < n:
                         for item in await conn.recv_frames(n - got):
-                            got += item.remaining \
-                                if type(item) is FrameChunk else 1
+                            if type(item) is FrameChunk:
+                                got += item.remaining
+                                if deliver_spans and trace_every:
+                                    # vectorized flag scan: one fancy-index
+                                    # per chunk, per-frame work only for
+                                    # the 1-in-N actually-traced frames (a
+                                    # scalar Python loop here costs ~14%
+                                    # of the forwarding rate and would
+                                    # dominate the A/B it exists to serve)
+                                    offs_a = np.asarray(item.offs, np.int64)
+                                    firsts = np.frombuffer(
+                                        item.buf, np.uint8)[offs_a]
+                                    hits = np.nonzero(
+                                        firsts & trace_lib.TRACE_FLAG)[0]
+                                    for i in hits.tolist():
+                                        o = int(offs_a[i])
+                                        ln = int(item.lens[i])
+                                        _note_delivery(
+                                            memoryview(item.buf)[o:o + ln])
+                            else:
+                                got += 1
+                                if deliver_spans and trace_every \
+                                        and len(item.data) \
+                                        and item.data[0] & trace_lib.TRACE_FLAG:
+                                    _note_delivery(item.data)
                             item.release()
 
             rates = []
@@ -76,13 +116,16 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
                 for _ in range(msgs // batch):
                     if trace_every:
                         # deterministic 1-in-N mix: the exact wire a
-                        # sampled publisher produces
+                        # sampled publisher produces (stamped fresh per
+                        # traced frame — real origins, so the delivery
+                        # side's e2e latencies are meaningful)
                         frames = []
                         for _i in range(batch):
                             sent += 1
-                            frames.append(traced_frame
-                                          if sent % trace_every == 0
-                                          else frame)
+                            frames.append(
+                                trace_lib.stamp_frame(frame,
+                                                      trace_lib.new_trace())
+                                if sent % trace_every == 0 else frame)
                         await sender.send_raw_many(frames)
                     else:
                         await sender.send_raw_many([frame] * batch)
@@ -92,7 +135,8 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
             med = statistics.median(rates)
             return {"median": med, "trials": rates, "msgs": msgs,
                     "receivers": receivers, "payload": payload,
-                    "delivered": med * receivers}
+                    "delivered": med * receivers,
+                    "e2e_lat_s": e2e_lat_s}
         finally:
             await run.shutdown()
     finally:

@@ -3,20 +3,36 @@
 discovery store + marshal + 2 brokers + an echo client, each a real OS
 process over TCP; SQLite stands in for KeyDB).
 
-    python scripts/local_cluster.py [--duration 30]
+    python scripts/local_cluster.py [--duration 30] [--topology]
 
-Exits nonzero if any component dies early or the client fails to echo.
+Beyond the end-to-end echo, the run proves the observability plane
+(ISSUE 5) end to end:
+
+- every process serves ``/healthz`` + ``/readyz`` (readiness is observed
+  FALSE before broker0's listeners bind, TRUE once the cluster is up, and
+  FALSE again during drain — before the listeners close);
+- broker ``/debug/topology`` reflects the actual mesh (each broker sees
+  the other as its one peer; the client appears as a user exactly once);
+- ``scripts/trace_report.py --strict`` over the per-process span logs
+  reports per-hop p50/p99 for a complete publish→delivery chain with zero
+  orphaned spans (with ``--trace-log``).
+
+Exits nonzero if any component dies early, the client fails to echo, or
+any observability check fails.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import subprocess
 import sys
 import tempfile
 import time
+import urllib.error
+import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -24,11 +40,240 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 from pushcdn_tpu.bin.common import spawn_binary  # noqa: E402
 
+# brokers keep serving (readiness already 503) this long after SIGINT —
+# the window the drain check probes
+DRAIN_GRACE_S = 2.0
+
 
 def spawn(name: str, *args: str, env_extra=None) -> subprocess.Popen:
     proc = spawn_binary(name, *args, env_extra=env_extra)
     print(f"[cluster] {name} up (pid {proc.pid})")
     return proc
+
+
+def http_get(port: int, path: str, timeout: float = 2.0):
+    """(status, body_str) from a process's observability endpoint; None
+    when nothing answers (connection refused / timeout)."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as exc:  # 4xx/5xx still carry a body
+        return exc.code, exc.read().decode()
+    except (urllib.error.URLError, OSError, TimeoutError):
+        return None
+
+
+def wait_http(port: int, path: str, wait_s: float = 8.0):
+    """Poll until the endpoint answers at all; returns (status, body)."""
+    deadline = time.time() + wait_s
+    while time.time() < deadline:
+        res = http_get(port, path, timeout=1.0)
+        if res is not None:
+            return res
+        time.sleep(0.05)
+    return None
+
+
+def check_readiness_before_bind(port: int) -> bool:
+    """broker0 starts its metrics endpoint BEFORE binding listeners (and
+    holds the bind for PUSHCDN_BIND_DELAY_S): the first /readyz answer
+    must be 503 with the listeners check failing."""
+    res = wait_http(port, "/readyz")
+    if res is None:
+        print("[cluster] FAIL: broker0 /readyz never answered during startup")
+        return False
+    status, body = res
+    if status != 503:
+        print(f"[cluster] FAIL: pre-bind /readyz was {status}, wanted 503 "
+              f"(body {body[:200]})")
+        return False
+    try:
+        doc = json.loads(body)
+        listeners_ok = doc["checks"]["listeners"]["ok"]
+    except (ValueError, KeyError):
+        print(f"[cluster] FAIL: pre-bind /readyz body unparseable: {body[:200]}")
+        return False
+    if listeners_ok:
+        print("[cluster] FAIL: pre-bind /readyz 503 but listeners check ok?")
+        return False
+    print("[cluster] readiness pre-bind: 503 not-ready (listeners unbound) "
+          "as expected")
+    return True
+
+
+def check_health(ports: dict) -> bool:
+    """/healthz + /readyz on every process: 200s with the check schema."""
+    for name, port in ports.items():
+        for path in ("/healthz", "/readyz"):
+            res = None
+            deadline = time.time() + 10.0
+            while time.time() < deadline:  # readiness may lag startup
+                res = http_get(port, path)
+                if res is not None and res[0] == 200:
+                    break
+                time.sleep(0.2)
+            if res is None:
+                print(f"[cluster] FAIL: {name} {path} unreachable")
+                return False
+            status, body = res
+            try:
+                doc = json.loads(body)
+                checks = doc["checks"]
+                assert isinstance(checks, dict)
+                for c in checks.values():
+                    assert isinstance(c["ok"], bool)
+                    assert "detail" in c
+            except (ValueError, KeyError, AssertionError):
+                print(f"[cluster] FAIL: {name} {path} schema drift: "
+                      f"{body[:300]}")
+                return False
+            if status != 200:
+                print(f"[cluster] FAIL: {name} {path} = {status} "
+                      f"({body[:300]})")
+                return False
+    print(f"[cluster] health OK ({len(ports)} processes serve "
+          "/healthz + /readyz)")
+    return True
+
+
+TOPOLOGY_KEYS = ("identity", "draining", "interest_version", "num_users",
+                 "num_brokers", "peers", "users", "interest", "cutthrough")
+
+
+def fetch_topology(port: int):
+    res = http_get(port, "/debug/topology")
+    if res is None or res[0] != 200:
+        return None
+    try:
+        return json.loads(res[1])
+    except ValueError:
+        return None
+
+
+def check_topology(broker_ports: dict) -> bool:
+    """Each broker's /debug/topology must reflect the real mesh: the other
+    broker as its one peer, and the echo client as a user exactly once."""
+    topos = {}
+    for name, port in broker_ports.items():
+        deadline = time.time() + 10.0
+        topo = None
+        while time.time() < deadline:
+            topo = fetch_topology(port)
+            if topo is not None and topo.get("num_brokers", 0) >= 1:
+                break
+            time.sleep(0.2)
+        if topo is None:
+            print(f"[cluster] FAIL: {name} /debug/topology unreachable")
+            return False
+        missing = [k for k in TOPOLOGY_KEYS if k not in topo]
+        if missing:
+            print(f"[cluster] FAIL: {name} topology schema drift: "
+                  f"missing {missing}")
+            return False
+        topos[name] = topo
+    idents = {name: t["identity"] for name, t in topos.items()}
+    for name, topo in topos.items():
+        peer_ids = [p["id"] for p in topo["peers"]]
+        expected = [i for n, i in idents.items() if n != name]
+        if sorted(peer_ids) != sorted(expected):
+            print(f"[cluster] FAIL: {name} mesh mismatch: peers={peer_ids} "
+                  f"expected={expected}")
+            return False
+    total_users = sum(t["num_users"] for t in topos.values())
+    if total_users != 1:
+        print(f"[cluster] FAIL: expected exactly 1 connected user across "
+              f"the mesh, saw {total_users}")
+        return False
+    print("[cluster] topology OK (mesh verified: each broker sees the "
+          "other; 1 user connected)")
+    return True
+
+
+def render_merged_topology(broker_ports: dict) -> None:
+    """One merged cluster view from every broker's /debug/topology."""
+    print("[cluster] ---- merged topology ----")
+    for name, port in sorted(broker_ports.items()):
+        topo = fetch_topology(port)
+        if topo is None:
+            print(f"  {name}: <unreachable>")
+            continue
+        cut = topo.get("cutthrough") or {}
+        print(f"  {name} [{topo['identity']}] users={topo['num_users']} "
+              f"brokers={topo['num_brokers']} "
+              f"interest_v={topo['interest_version']} "
+              f"draining={topo['draining']}")
+        for p in topo["peers"]:
+            print(f"    peer {p['id']}: queue={p['writer_queue_depth']} "
+                  f"in-flight={p['bytes_in_flight']}B topics={p['topics']}")
+        for u in topo["users"]:
+            print(f"    user {u['key']}: topics={u['topics']} "
+                  f"queue={u['writer_queue_depth']}")
+        if cut:
+            print(f"    cut-through: usable={cut.get('usable')} "
+                  f"age={cut.get('snapshot_age_s')}s "
+                  f"churn-skips={cut.get('churn_guard_skips_left')}")
+    print("[cluster] ---- end topology ----")
+
+
+def check_drain(name: str, proc: subprocess.Popen, port: int) -> bool:
+    """SIGINT the process and verify /readyz flips to 503 (draining)
+    BEFORE the listeners close — the process keeps answering through the
+    drain grace window."""
+    proc.send_signal(signal.SIGINT)
+    deadline = time.time() + DRAIN_GRACE_S + 3.0
+    while time.time() < deadline:
+        res = http_get(port, "/readyz", timeout=0.5)
+        if res is None:
+            if proc.poll() is not None:
+                print(f"[cluster] FAIL: {name} exited before its drain "
+                      "readiness flip was observable")
+                return False
+            time.sleep(0.05)
+            continue
+        status, body = res
+        drain_latched = False
+        if status == 503:
+            try:
+                drain_latched = json.loads(body)["draining"] is True
+            except (ValueError, KeyError):
+                drain_latched = False
+        if drain_latched:
+            print(f"[cluster] drain readiness flip observed on {name} "
+                  "(503 draining while still serving)")
+            proc.wait(timeout=DRAIN_GRACE_S + 10)
+            return True
+        time.sleep(0.05)
+    print(f"[cluster] FAIL: {name} never reported draining on /readyz")
+    return False
+
+
+def run_trace_report(trace_dir: str, wait_s: float = 10.0) -> bool:
+    """The CI gate: merge the span logs and require per-hop stats for at
+    least one complete chain with zero orphans (retried briefly — the
+    broker's last spans land moments after the client prints its echo)."""
+    script = os.path.join(REPO, "scripts", "trace_report.py")
+    deadline = time.time() + wait_s
+    proc = None
+    while True:
+        proc = subprocess.run(
+            [sys.executable, script, "--strict", "--json", trace_dir],
+            capture_output=True, text=True, timeout=60)
+        if proc.returncode == 0 or time.time() >= deadline:
+            break
+        time.sleep(0.3)
+    if proc.returncode != 0:
+        print(f"[cluster] FAIL: trace_report strict gate:\n"
+              f"{proc.stdout[-1500:]}\n{proc.stderr[-500:]}")
+        return False
+    report = json.loads(proc.stdout)
+    hops = report["per_hop"]
+    print(f"[cluster] trace report OK: {report['complete_chains']} complete "
+          f"chain(s), {report['orphaned_spans']} orphaned spans; "
+          "per-hop p50/p99 ms: "
+          + " ".join(f"{hop}={s['p50_ms']}/{s['p99_ms']}"
+                     for hop, s in hops.items()))
+    return True
 
 
 def check_trace_chain(trace_dir: str, wait_s: float = 5.0) -> bool:
@@ -75,11 +320,13 @@ def main() -> int:
     ap.add_argument("--device-plane", action="store_true",
                     help="brokers route eligible traffic on the attached "
                          "device (single-shard planes)")
+    ap.add_argument("--topology", action="store_true",
+                    help="render one merged cluster view from every "
+                         "broker's /debug/topology once the mesh is up")
     ap.add_argument("--trace-log", metavar="DIR", default=None,
                     help="write per-process lifecycle-trace span JSONL "
-                         "under DIR and verify one complete span chain "
-                         "(publish -> auth -> ingress -> plan -> egress "
-                         "-> delivery)")
+                         "under DIR, verify one complete span chain, and "
+                         "run scripts/trace_report.py --strict over it")
     args = ap.parse_args()
 
     if args.trace_log:
@@ -87,7 +334,7 @@ def main() -> int:
 
     def trace_env(name: str):
         if not args.trace_log:
-            return None
+            return {}
         return {"PUSHCDN_TRACE_LOG":
                 os.path.join(args.trace_log, f"{name}.jsonl")}
 
@@ -101,9 +348,19 @@ def main() -> int:
         with socket.socket() as s:
             s.bind(("127.0.0.1", 0))
             bp = min(s.getsockname()[1], 65000 - 200)
+    metrics_ports = {"broker0": bp + 100, "broker1": bp + 101,
+                     "marshal": bp + 102, "client": bp + 103}
+    broker_ports = {"broker0": bp + 100, "broker1": bp + 101}
     procs: list[tuple[str, subprocess.Popen]] = []
+    ok = True
     try:
         for i in range(2):
+            env = {**trace_env(f"broker{i}"),
+                   "PUSHCDN_DRAIN_GRACE_S": str(DRAIN_GRACE_S)}
+            if i == 0:
+                # hold broker0's listener binds open so the not-ready-
+                # before-bind state is externally observable
+                env["PUSHCDN_BIND_DELAY_S"] = "1.5"
             procs.append((f"broker{i}", spawn(
                 "broker",
                 "--discovery-endpoint", db,
@@ -112,14 +369,19 @@ def main() -> int:
                 "--private-advertise-endpoint", f"127.0.0.1:{bp + i * 2 + 1}",
                 "--private-bind-endpoint", f"127.0.0.1:{bp + i * 2 + 1}",
                 "--user-transport", "tcp",   # plain tcp for the local demo
-                "--metrics-bind-endpoint", f"127.0.0.1:{bp + 100 + i}",
+                "--metrics-bind-endpoint",
+                f"127.0.0.1:{metrics_ports[f'broker{i}']}",
                 *(["--device-plane"] if args.device_plane else []),
-                env_extra=trace_env(f"broker{i}"))))
+                env_extra=env)))
+            if i == 0:
+                ok = check_readiness_before_bind(metrics_ports["broker0"]) \
+                    and ok
         time.sleep(1.5)  # brokers register + mesh up
         procs.append(("marshal", spawn(
             "marshal",
             "--discovery-endpoint", db,
             "--bind-endpoint", f"127.0.0.1:{bp + 50}",
+            "--metrics-bind-endpoint", f"127.0.0.1:{metrics_ports['marshal']}",
             "--user-transport", "tcp",
             env_extra=trace_env("marshal"))))
         time.sleep(1.0)
@@ -128,6 +390,7 @@ def main() -> int:
             "--marshal-endpoint", f"127.0.0.1:{bp + 50}",
             "--transport", "tcp",
             "--interval", "1.0", "--key-seed", "7",
+            "--metrics-bind-endpoint", f"127.0.0.1:{metrics_ports['client']}",
             env_extra=trace_env("client"))))
 
         deadline = time.time() + args.duration
@@ -148,7 +411,22 @@ def main() -> int:
         if not echoed:
             print("[cluster] FAIL: client never echoed")
             return 1
-        if args.trace_log and not check_trace_chain(args.trace_log):
+
+        # ---- observability plane checks (ISSUE 5) ----
+        ok = check_health(metrics_ports) and ok
+        ok = check_topology(broker_ports) and ok
+        if args.topology:
+            render_merged_topology(broker_ports)
+        if args.trace_log:
+            ok = check_trace_chain(args.trace_log) and ok
+            ok = run_trace_report(args.trace_log) and ok
+        # drain LAST: SIGINT broker1 and watch readiness flip before its
+        # listeners close (the client may briefly reconnect after; every
+        # earlier check has already run)
+        broker1 = next(p for n, p in procs if n == "broker1")
+        ok = check_drain("broker1", broker1, metrics_ports["broker1"]) and ok
+
+        if not ok:
             return 1
         print("[cluster] OK: end-to-end echo through real processes")
         return 0
@@ -156,7 +434,13 @@ def main() -> int:
         for _name, proc in procs:
             if proc.poll() is None:
                 proc.send_signal(signal.SIGINT)
-        time.sleep(0.5)
+        # brokers drain for DRAIN_GRACE_S before exiting — give the grace
+        # window (plus margin) before escalating, or the "clean shutdown"
+        # is actually a SIGKILL mid-drain
+        deadline = time.time() + DRAIN_GRACE_S + 2.0
+        while time.time() < deadline and any(
+                proc.poll() is None for _name, proc in procs):
+            time.sleep(0.1)
         for _name, proc in procs:
             if proc.poll() is None:
                 proc.kill()

@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Offline lifecycle-trace aggregator: merge multi-process
+``PUSHCDN_TRACE_LOG`` JSONL span files, assemble per-trace-id chains, and
+report where the latency goes.
+
+    python scripts/trace_report.py [--top N] [--json] PATH [PATH...]
+
+``PATH`` is a span JSONL file or a directory of them (``*.jsonl``, the
+layout ``scripts/local_cluster.py --trace-log DIR`` writes). The report
+shows:
+
+- per-hop latency from the trace origin: p50 / p95 / p99 / max — the
+  transfer-level attribution ("RPC Considered Harmful") that per-message
+  averages hide;
+- the top-N slowest COMPLETE chains (publish → … → delivery), each with
+  its hop-by-hop breakdown;
+- orphaned / incomplete chain counts (a chain missing its delivery span
+  means the message died in flight — or the receiver never logged),
+  duplicate spans dropped, and clock-skewed hops (a hop timestamped
+  before its predecessor: cross-machine clock skew, clamped to 0 in the
+  stats and counted so the reader knows the numbers are floor values).
+
+Exit status: 0 when at least one complete chain exists and ``--strict``
+is off; with ``--strict``, nonzero on ANY orphaned span or incomplete
+chain (the CI gate ``scripts/local_cluster.py`` runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+# chain-order canonical hops (auth precedes publish chronologically: the
+# connection trace originates at dial time and the marshal stamps auth
+# before the client's first publish reuses the id)
+HOPS = ("auth", "publish", "ingress", "plan", "egress", "delivery")
+REQUIRED = frozenset(("publish", "ingress", "plan", "egress", "delivery"))
+
+
+def load_spans(paths: List[str]) -> Tuple[List[dict], int]:
+    """Read span records from files/directories; returns
+    ``(spans, duplicates_dropped)``. Duplicates — same (trace_id, hop,
+    t_ns), e.g. a log shipped twice — are dropped here so every
+    downstream count is over unique spans."""
+    files: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            files.extend(sorted(glob.glob(os.path.join(path, "*.jsonl"))))
+        else:
+            files.append(path)
+    spans: List[dict] = []
+    seen = set()
+    duplicates = 0
+    for path in files:
+        try:
+            fh = open(path)
+        except OSError as exc:
+            print(f"trace_report: cannot read {path}: {exc}",
+                  file=sys.stderr)
+            continue
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    rec["origin_ns"]  # build_report dereferences it too
+                    key = (rec["trace_id"], rec["hop"], rec["t_ns"])
+                except (ValueError, KeyError, TypeError):
+                    continue  # torn/garbled line: skip, never crash
+                if key in seen:
+                    duplicates += 1
+                    continue
+                seen.add(key)
+                rec.setdefault("detail", "")
+                spans.append(rec)
+    return spans, duplicates
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def build_report(spans: List[dict], duplicates: int = 0,
+                 top: int = 5) -> dict:
+    """Assemble chains and stats from (deduplicated) span records."""
+    by_id: Dict[int, List[dict]] = {}
+    for rec in spans:
+        by_id.setdefault(rec["trace_id"], []).append(rec)
+
+    per_hop: Dict[str, List[float]] = {}
+    skewed = 0
+    complete: List[dict] = []
+    incomplete = 0
+    orphaned_spans = 0
+    for tid, recs in by_id.items():
+        recs.sort(key=lambda r: r["t_ns"])
+        hops = {r["hop"] for r in recs}
+        # per-hop latency from the carried origin (floor at 0: a receiver
+        # whose clock runs behind the origin's reports negative latency —
+        # counted as skew, clamped in the stats)
+        for r in recs:
+            lat = (r["t_ns"] - r["origin_ns"]) / 1e9
+            if lat < 0:
+                skewed += 1
+                lat = 0.0
+            per_hop.setdefault(r["hop"], []).append(lat)
+        if REQUIRED <= hops:
+            delivery = max((r for r in recs if r["hop"] == "delivery"),
+                           key=lambda r: r["t_ns"])
+            complete.append({
+                "trace_id": tid,
+                "e2e_ms": max(delivery["t_ns"] - delivery["origin_ns"], 0)
+                / 1e6,
+                "recs": recs,
+            })
+        else:
+            incomplete += 1
+            orphaned_spans += len(recs)
+
+    hop_stats = {}
+    for hop, vals in per_hop.items():
+        vals.sort()
+        hop_stats[hop] = {
+            "count": len(vals),
+            "p50_ms": round(_pct(vals, 0.50) * 1e3, 3),
+            "p95_ms": round(_pct(vals, 0.95) * 1e3, 3),
+            "p99_ms": round(_pct(vals, 0.99) * 1e3, 3),
+            "max_ms": round(vals[-1] * 1e3, 3),
+        }
+
+    complete.sort(key=lambda c: c["e2e_ms"], reverse=True)
+    slowest = []
+    for chain in complete[:max(top, 0)]:
+        prev_t = None
+        breakdown = []
+        for r in chain["recs"]:
+            dt = 0.0 if prev_t is None else (r["t_ns"] - prev_t) / 1e6
+            breakdown.append({
+                "hop": r["hop"],
+                "at_ms": round(max(r["t_ns"] - r["origin_ns"], 0) / 1e6, 3),
+                "dt_ms": round(max(dt, 0.0), 3),
+                "skewed": dt < 0,
+                "detail": r.get("detail", ""),
+            })
+            prev_t = r["t_ns"]
+        slowest.append({"trace_id": f"{chain['trace_id']:016x}",
+                        "e2e_ms": round(chain["e2e_ms"], 3),
+                        "hops": breakdown})
+
+    return {
+        "spans": len(spans),
+        "duplicates_dropped": duplicates,
+        "trace_ids": len(by_id),
+        "complete_chains": len(complete),
+        "incomplete_chains": incomplete,
+        "orphaned_spans": orphaned_spans,
+        "skewed_hops": skewed,
+        "per_hop": {hop: hop_stats[hop] for hop in HOPS
+                    if hop in hop_stats},
+        "slowest": slowest,
+    }
+
+
+def format_report(report: dict) -> str:
+    out = [
+        f"{report['spans']} spans / {report['trace_ids']} trace ids "
+        f"({report['duplicates_dropped']} duplicates dropped, "
+        f"{report['skewed_hops']} clock-skewed hops)",
+        f"chains: {report['complete_chains']} complete, "
+        f"{report['incomplete_chains']} incomplete "
+        f"({report['orphaned_spans']} orphaned spans)",
+        "",
+        f"{'hop':<10} {'count':>6} {'p50 ms':>9} {'p95 ms':>9} "
+        f"{'p99 ms':>9} {'max ms':>9}",
+    ]
+    for hop, s in report["per_hop"].items():
+        out.append(f"{hop:<10} {s['count']:>6} {s['p50_ms']:>9.3f} "
+                   f"{s['p95_ms']:>9.3f} {s['p99_ms']:>9.3f} "
+                   f"{s['max_ms']:>9.3f}")
+    if report["slowest"]:
+        out.append("")
+        out.append(f"top {len(report['slowest'])} slowest complete chains:")
+        for chain in report["slowest"]:
+            out.append(f"  trace {chain['trace_id']}  "
+                       f"e2e {chain['e2e_ms']:.3f} ms")
+            for h in chain["hops"]:
+                skew = "  [skewed]" if h["skewed"] else ""
+                detail = f"  ({h['detail']})" if h["detail"] else ""
+                out.append(f"    {h['hop']:<10} +{h['dt_ms']:8.3f} ms  "
+                           f"@{h['at_ms']:8.3f} ms{detail}{skew}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge PUSHCDN_TRACE_LOG JSONL files and attribute "
+                    "per-hop latency")
+    ap.add_argument("paths", nargs="+",
+                    help="span .jsonl files or directories of them")
+    ap.add_argument("--top", type=int, default=5,
+                    help="how many slowest chains to break down")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on any orphaned span or incomplete "
+                         "chain (the CI gate)")
+    args = ap.parse_args(argv)
+    spans, duplicates = load_spans(args.paths)
+    report = build_report(spans, duplicates=duplicates, top=args.top)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(format_report(report))
+    if report["complete_chains"] == 0:
+        print("trace_report: FAIL: no complete chain", file=sys.stderr)
+        return 1
+    if args.strict and (report["orphaned_spans"]
+                        or report["incomplete_chains"]):
+        print("trace_report: FAIL (strict): "
+              f"{report['incomplete_chains']} incomplete chains / "
+              f"{report['orphaned_spans']} orphaned spans",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

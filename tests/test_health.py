@@ -1,0 +1,362 @@
+"""ISSUE 5 observability plane: /healthz + /readyz semantics (state
+transitions, drain-before-listener-close, ready-flip flight-recorder
+events), the routed metrics HTTP server (405/404/400, no substring
+misrouting), /debug/topology schema over an in-process mesh, and the
+per-task sampling profiler's attribution."""
+
+import asyncio
+import json
+
+import pytest
+
+from pushcdn_tpu.proto import flightrec, health
+from pushcdn_tpu.proto import metrics as metrics_mod
+
+
+async def _get(port: int, path: str, method: str = "GET",
+               accept: str = "") -> tuple:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    req = f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+    if accept:
+        req += f"Accept: {accept}\r\n"
+    writer.write((req + "\r\n").encode())
+    await writer.drain()
+    data = await reader.read(-1)
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    return int(head.split(b" ")[1]), body.decode()
+
+
+async def _serve():
+    server = await metrics_mod.serve_metrics("127.0.0.1:0")
+    return server, server.sockets[0].getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# routed HTTP server (the satellite bugfix the tentpole builds on)
+# ---------------------------------------------------------------------------
+
+async def test_non_get_rejected_405():
+    server, port = await _serve()
+    try:
+        status, _ = await _get(port, "/metrics", method="POST")
+        assert status == 405
+        status, _ = await _get(port, "/healthz", method="DELETE")
+        assert status == 405
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_query_string_cannot_misroute():
+    """The latent bug: a request merely CONTAINING /debug/flightrec used
+    to be served the flightrec body. The parsed route table dispatches on
+    the actual path."""
+    server, port = await _serve()
+    try:
+        status, body = await _get(port, "/metrics?q=/debug/flightrec")
+        assert status == 200
+        assert "# TYPE cdn_bytes_sent counter" in body
+        assert "flight recorder" not in body
+        # and an unknown path that merely mentions a route is 404
+        status, _ = await _get(port, "/nope/metrics")
+        assert status == 404
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_flightrec_limit_query_caps_body():
+    rec = flightrec.FlightRecorder("limit-test-rec")
+    for i in range(20):
+        rec.record("evt", f"n{i}")
+    server, port = await _serve()
+    try:
+        status, body = await _get(port, "/debug/flightrec?limit=3")
+        assert status == 200
+        # only the most recent events of this recorder survive the cap
+        assert "n19" in body
+        assert "n0" not in body
+        status, full = await _get(port, "/debug/flightrec")
+        assert "n0" in full  # default limit is generous
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_openmetrics_negotiation_carries_exemplars():
+    metrics_mod.E2E_LATENCY.observe(0.002,
+                                    exemplar={"trace_id": "feedface01"})
+    server, port = await _serve()
+    try:
+        status, body = await _get(port, "/metrics",
+                                  accept="application/openmetrics-text")
+        assert status == 200
+        assert body.rstrip().endswith("# EOF")
+        assert '# {trace_id="feedface01"}' in body
+        # OM mandates the _total suffix on counter SAMPLES (family name
+        # in TYPE stays bare) — a strict parser rejects bare counters
+        assert "# TYPE cdn_bytes_sent counter" in body
+        assert "\ncdn_bytes_sent_total " in body
+        # plain scrapes stay strict prometheus 0.0.4: no exemplars, no
+        # suffix migration
+        _, plain = await _get(port, "/metrics")
+        assert "trace_id=" not in plain
+        assert "# EOF" not in plain
+        assert "cdn_bytes_sent_total" not in plain
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+# ---------------------------------------------------------------------------
+# /healthz + /readyz
+# ---------------------------------------------------------------------------
+
+async def test_healthz_reports_builtin_checks():
+    server, port = await _serve()
+    try:
+        status, body = await _get(port, "/healthz")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["status"] == "ok"
+        assert doc["checks"]["loop-lag"]["ok"] is True
+        assert doc["checks"]["samplers"]["ok"] is True
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+async def test_readyz_drain_latch_and_ready_flip_event():
+    server, port = await _serve()
+    try:
+        status, _ = await _get(port, "/readyz")
+        assert status == 200
+        before = len(flightrec.task_recorder())
+        health.set_draining("unit-test drain")
+        status, body = await _get(port, "/readyz")
+        assert status == 503
+        doc = json.loads(body)
+        assert doc["draining"] is True
+        assert doc["checks"]["draining"]["ok"] is False
+        # the flip was recorded the moment set_draining ran
+        assert len(flightrec.task_recorder()) > before
+        trail = flightrec.task_recorder().trail()
+        assert "ready-flip" in trail and "draining: unit-test drain" in trail
+    finally:
+        health.clear_draining()
+        server.close()
+        await server.wait_closed()
+
+
+async def test_failing_check_name_lands_in_ready_flip():
+    health.register_readiness("unit-fails", lambda: (False, "on purpose"))
+    server, port = await _serve()
+    try:
+        status, body = await _get(port, "/readyz")
+        assert status == 503
+        assert json.loads(body)["checks"]["unit-fails"]["ok"] is False
+        trail = flightrec.task_recorder().trail()
+        assert "unit-fails" in trail
+        # recovery transitions back to ready
+        health.register_readiness("unit-fails", lambda: (True, "fixed"))
+        status, _ = await _get(port, "/readyz")
+        assert status == 200
+    finally:
+        health.unregister("unit-fails")
+        server.close()
+        await server.wait_closed()
+
+
+async def test_raising_check_reports_unhealthy_not_500():
+    def boom():
+        raise RuntimeError("check exploded")
+    health.register_readiness("unit-boom", boom)
+    server, port = await _serve()
+    try:
+        status, body = await _get(port, "/readyz")
+        assert status == 503
+        assert "check exploded" in body
+    finally:
+        health.unregister("unit-boom")
+        server.close()
+        await server.wait_closed()
+
+
+# ---------------------------------------------------------------------------
+# broker readiness lifecycle (discovery down -> not ready -> recovers;
+# drain flips readiness BEFORE the listeners close)
+# ---------------------------------------------------------------------------
+
+async def test_broker_readiness_transitions():
+    from pushcdn_tpu.broker.test_harness import TestDefinition
+    run = await TestDefinition(
+        connected_users=[[0]],
+        connected_brokers=[([0], [b"remote-user"])],
+        metrics_bind_endpoint="127.0.0.1:0").run()
+    broker = run.broker
+    port = broker._metrics_server.sockets[0].getsockname()[1]
+    try:
+        status, body = await _get(port, "/readyz")
+        assert status == 200, body
+        doc = json.loads(body)
+        assert set(doc["checks"]) >= {"listeners", "discovery", "mesh"}
+
+        # discovery down: expire the cached probe, make the active one fail
+        real = broker.discovery.get_other_brokers
+
+        async def dead():
+            raise OSError("discovery store unreachable")
+
+        broker.discovery.get_other_brokers = dead
+        broker._discovery_probe_at = None
+        status, body = await _get(port, "/readyz")
+        assert status == 503
+        assert json.loads(body)["checks"]["discovery"]["ok"] is False
+
+        # recovers once the store answers again (cache expired manually —
+        # production pays at most one probe per TTL)
+        broker.discovery.get_other_brokers = real
+        broker._discovery_probe_at = None
+        status, _ = await _get(port, "/readyz")
+        assert status == 200
+
+        # drain: readiness flips false while the listeners are STILL up
+        broker.begin_drain("test drain")
+        status, body = await _get(port, "/readyz")
+        assert status == 503
+        assert json.loads(body)["draining"] is True
+        assert broker.listeners_bound  # nothing closed yet
+    finally:
+        await run.shutdown()
+    assert health.draining() is None  # stop() cleans the global latch
+
+
+async def test_broker_mesh_check_solo_vs_partitioned():
+    from pushcdn_tpu.broker.test_harness import TestDefinition
+    run = await TestDefinition(metrics_bind_endpoint="127.0.0.1:0").run()
+    broker = run.broker
+    try:
+        # no peers connected, discovery says nobody else exists: solo is
+        # intentional => ready
+        broker.last_peer_count = 0
+        ok, detail = broker._check_mesh()
+        assert ok and "solo" in detail
+        # discovery reports peers we can't reach: NOT ready
+        broker.last_peer_count = 3
+        ok, detail = broker._check_mesh()
+        assert not ok and "3" in detail
+    finally:
+        await run.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# /debug/topology
+# ---------------------------------------------------------------------------
+
+async def test_topology_dump_schema_over_mesh():
+    from pushcdn_tpu.broker.test_harness import TestDefinition
+    run = await TestDefinition(
+        connected_users=[[0], [1]],
+        connected_brokers=[([0], [b"remote-user"])],
+        metrics_bind_endpoint="127.0.0.1:0").run()
+    broker = run.broker
+    port = broker._metrics_server.sockets[0].getsockname()[1]
+    try:
+        status, body = await _get(port, "/debug/topology")
+        assert status == 200
+        topo = json.loads(body)
+        for key in ("identity", "draining", "interest_version", "num_users",
+                    "num_brokers", "peers", "users", "users_truncated",
+                    "interest", "cutthrough"):
+            assert key in topo, f"topology schema drift: missing {key}"
+        assert topo["num_users"] == 2
+        assert topo["num_brokers"] == 1
+        [peer] = topo["peers"]
+        assert peer["id"] == run.peer(0).identifier
+        assert peer["topics"] == 1
+        assert {"writer_queue_depth", "bytes_in_flight"} <= set(peer)
+        assert {u["topics"] for u in topo["users"]} == {1}
+        card = topo["interest"]["topic_cardinality"]
+        assert card == {"0": 1, "1": 1}
+        # 2 local users + 1 remote user owned by the peer
+        assert topo["interest"]["direct_map_size"] == 3
+    finally:
+        await run.shutdown()
+    # unregistered on stop: the route 404s for the next owner
+    server, port = await _serve()
+    try:
+        status, _ = await _get(port, "/debug/topology")
+        assert status == 404
+    finally:
+        server.close()
+        await server.wait_closed()
+
+
+# ---------------------------------------------------------------------------
+# per-task sampling profiler
+# ---------------------------------------------------------------------------
+
+async def test_profiler_attributes_hot_task_family():
+    async def hot():
+        while True:
+            await asyncio.sleep(0.001)
+
+    # two instances of one family (trailing ids strip to one label)
+    tasks = [asyncio.create_task(hot(), name=f"deliberately-hot-task-{i:04x}")
+             for i in range(2)]
+    profiler = asyncio.create_task(metrics_mod._task_profiler(0.02))
+    try:
+        await asyncio.sleep(0.25)
+    finally:
+        profiler.cancel()
+        for t in tasks:
+            t.cancel()
+    child = metrics_mod.TASK_SAMPLES.labels(task="deliberately-hot-task")
+    # ~12 ticks x 2 tasks; generous floor for slow CI
+    assert child.value >= 6
+    rendered = metrics_mod.TASK_SAMPLES.render()
+    assert 'cdn_task_samples{task="deliberately-hot-task"}' in rendered
+
+
+def test_task_family_normalization():
+    f = metrics_mod._task_family
+    assert f("Task-123") == "Task"
+    assert f("user-receive-7f3a2b") == "user-receive"
+    assert f("heartbeat") == "heartbeat"
+    assert f("dial-0xdeadbeef") == "dial"
+    assert f("42") == "anonymous"
+
+
+def test_native_seconds_children_render():
+    body = metrics_mod.NATIVE_SECONDS.render()
+    for kernel in ("route_plan", "egress_encode", "bls_verify"):
+        assert f'cdn_native_seconds{{kernel="{kernel}"}}' in body
+
+
+async def test_profiler_cardinality_cap_folds_to_other():
+    saved = dict(metrics_mod._family_children)
+    try:
+        metrics_mod._family_children.clear()
+        for i in range(metrics_mod._MAX_TASK_FAMILIES):
+            metrics_mod._family_child(f"fam{i}x")  # 'x' so digits survive
+        over = metrics_mod._family_child("one-family-too-many")
+        assert over is metrics_mod._family_children["other"]
+    finally:
+        metrics_mod._family_children.clear()
+        metrics_mod._family_children.update(saved)
+
+
+@pytest.mark.parametrize("path", ["/healthz", "/readyz"])
+async def test_health_endpoints_never_import_jax(path):
+    """Same rule as cdn_build_info: probing health must not initialize
+    (or newly import) jax — the render path is pure stdlib."""
+    import sys
+    had_jax = "jax" in sys.modules
+    server, port = await _serve()
+    try:
+        await _get(port, path)
+    finally:
+        server.close()
+        await server.wait_closed()
+    assert ("jax" in sys.modules) == had_jax

@@ -28,13 +28,34 @@ def _ruff_cmd():
         return None
 
 
+# the directories the gate covers — every new observability file (ISSUE 5:
+# proto/health.py, scripts/trace_report.py, tests/test_health.py,
+# tests/test_trace_report.py) lives inside them and is asserted present
+# below so a future move out of the linted tree fails loudly
+RUFF_SCOPE = ["pushcdn_tpu", "tests", "benches", "scripts", "bench.py"]
+
+ISSUE5_FILES = [
+    "pushcdn_tpu/proto/health.py",
+    "scripts/trace_report.py",
+    "tests/test_health.py",
+    "tests/test_trace_report.py",
+]
+
+
+def test_issue5_files_inside_lint_scope():
+    for rel in ISSUE5_FILES:
+        assert os.path.exists(os.path.join(REPO, rel)), rel
+        assert any(rel == scope or rel.startswith(scope + "/")
+                   for scope in RUFF_SCOPE), \
+            f"{rel} is outside the ruff gate's scope {RUFF_SCOPE}"
+
+
 def test_ruff_check_clean():
     cmd = _ruff_cmd()
     if cmd is None:
         pytest.skip("ruff not installed in this image; lint gate inactive")
     proc = subprocess.run(
-        [*cmd, "check", "pushcdn_tpu", "tests", "benches", "scripts",
-         "bench.py"],
+        [*cmd, "check", *RUFF_SCOPE],
         cwd=REPO, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, \
         f"ruff check found issues:\n{proc.stdout}\n{proc.stderr}"

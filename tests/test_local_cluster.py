@@ -42,15 +42,29 @@ def test_local_cluster_end_to_end_echo_and_clean_shutdown(tmp_path):
     proc = subprocess.run(
         [sys.executable, SCRIPT, "--duration", "10", "--base-port", "0",
          "--trace-log", trace_dir],
-        env=env, capture_output=True, text=True, timeout=120)
+        env=env, capture_output=True, text=True, timeout=180)
     out = proc.stdout + proc.stderr
-    assert proc.returncode == 0, f"local_cluster failed:\n{out[-4000:]}"
-    assert "OK: end-to-end echo through real processes" in out, out[-4000:]
+    assert proc.returncode == 0, f"local_cluster failed:\n{out[-6000:]}"
+    assert "OK: end-to-end echo through real processes" in out, out[-6000:]
     # ISSUE 4: one complete lifecycle span chain (auth + publish ->
     # ingress -> plan -> egress -> delivery on ONE trace id) assembled
     # from the per-process JSONL span logs
-    assert "trace chain complete" in out, out[-4000:]
+    assert "trace chain complete" in out, out[-6000:]
+    # ISSUE 5: the observability plane, proven end to end by the runner —
+    # readiness false before broker0's listeners bind...
+    assert "readiness pre-bind: 503 not-ready" in out, out[-6000:]
+    # ...every process (2 brokers, marshal, client) serving /healthz +
+    # /readyz with the check schema...
+    assert "health OK (4 processes" in out, out[-6000:]
+    # ...broker /debug/topology reflecting the actual mesh...
+    assert "topology OK" in out, out[-6000:]
+    # ...trace_report --strict: per-hop p50/p99 for a complete chain with
+    # zero orphaned spans...
+    assert "trace report OK" in out, out[-6000:]
+    assert "0 orphaned spans" in out, out[-6000:]
+    # ...and readiness flipping false during drain BEFORE listeners close
+    assert "drain readiness flip observed" in out, out[-6000:]
     # clean shutdown: the runner SIGINTs every component and exits 0 —
     # a component that survives SIGINT is killed and would have left
     # "FAIL" markers; assert none
-    assert "FAIL" not in out, out[-4000:]
+    assert "FAIL" not in out, out[-6000:]

@@ -199,6 +199,13 @@ async def test_scrape_exposes_build_info_and_new_gauges():
         assert 'cdn_route_batch_frames{path="cutthrough"}' in body
         assert 'cdn_bls_pk_cache{stat="hits"}' in body
         assert 'cdn_egress_frames{peer="user"}' in body
+        # ISSUE 5 families: e2e SLO histogram, native-seam attribution,
+        # task-profiler samples
+        assert "cdn_e2e_latency_seconds_bucket" in body
+        assert 'cdn_native_seconds{kernel="route_plan"}' in body
+        assert 'cdn_native_seconds{kernel="egress_encode"}' in body
+        assert 'cdn_native_seconds{kernel="bls_verify"}' in body
+        assert "cdn_task_samples" in body
     finally:
         server.close()
         await server.wait_closed()

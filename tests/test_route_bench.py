@@ -24,12 +24,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "benches", "route_bench.py")
 
 
-def test_route_bench_smoke():
+def test_route_bench_smoke(tmp_path):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    out_json = str(tmp_path / "BENCH_smoke.json")
     proc = subprocess.run(
-        [sys.executable, SCRIPT, "--quick"],
-        env=env, capture_output=True, text=True, timeout=120)
+        [sys.executable, SCRIPT, "--quick", "--out-json", out_json],
+        env=env, capture_output=True, text=True, timeout=240)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, f"route_bench failed:\n{out[-4000:]}"
     rows = [json.loads(line) for line in proc.stdout.splitlines()
@@ -66,3 +67,22 @@ def test_route_bench_smoke():
         assert tr_rows["on"].get("sample") == 1024
         assert any(r.get("tier") == "on-vs-off"
                    for r in by_bench["route/trace_overhead"])
+    # ISSUE 5: the whole-plane (profiler + tracing + e2e histogram)
+    # overhead A/B and the e2e percentile rows
+    assert "route/profiler_overhead" in by_bench, rows
+    if not any(r["unit"] == "skipped"
+               for r in by_bench["route/profiler_overhead"]):
+        planes = {r.get("plane") for r in by_bench["route/profiler_overhead"]
+                  if r["unit"] == "msgs/s"}
+        assert {"off", "on"} <= planes, rows
+        assert "route/e2e_latency" in by_bench, rows
+        e2e_tiers = {r["tier"] for r in by_bench["route/e2e_latency"]}
+        assert {"p50", "p99"} <= e2e_tiers, rows
+    # ISSUE 5 satellite: the machine-readable bench artifact was written
+    # with the headline block (the BENCH_r09.json producer)
+    with open(out_json) as fh:
+        doc = json.load(fh)
+    assert doc["round"] == 9
+    assert "route_bench" in doc
+    assert isinstance(doc["route_bench"]["rows"], list)
+    assert "headline" in doc["route_bench"]

@@ -1,0 +1,127 @@
+"""scripts/trace_report.py: chain assembly over synthetic multi-process
+span JSONL — complete chains, orphans, duplicate spans, clock-skewed
+hops — plus the CLI's strict-gate exit codes."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "trace_report.py")
+
+_spec = importlib.util.spec_from_file_location("trace_report", SCRIPT)
+trace_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(trace_report)
+
+MS = 1_000_000  # ns
+
+
+def _span(tid, hop, origin_ns, at_ms, detail=""):
+    t_ns = origin_ns + int(at_ms * MS)
+    return {"hop": hop, "trace_id": tid, "origin_ns": origin_ns,
+            "t_ns": t_ns, "lat_s": at_ms / 1e3, "detail": detail}
+
+
+def _write(path, spans):
+    with open(path, "w") as fh:
+        for s in spans:
+            fh.write(json.dumps(s) + "\n")
+
+
+def _synthetic_dir(tmp_path):
+    origin = 1_700_000_000_000_000_000
+    # chain A: complete, slow-ish (e2e 9ms), spread over three "process"
+    # files exactly like a local_cluster run
+    a_client = [_span(1, "publish", origin, 0.1),
+                _span(1, "delivery", origin, 9.0)]
+    a_broker = [_span(1, "ingress", origin, 2.0),
+                _span(1, "plan", origin, 2.4),
+                _span(1, "egress", origin, 3.0)]
+    a_marshal = [_span(1, "auth", origin, 1.0)]
+    # chain B: complete + fast, with a clock-SKEWED delivery (receiver
+    # clock behind the origin: negative latency)
+    b = [_span(2, "publish", origin, 0.1),
+         _span(2, "ingress", origin, 0.5),
+         _span(2, "plan", origin, 0.6),
+         _span(2, "egress", origin, 0.9),
+         _span(2, "delivery", origin, -1.5)]
+    # chain C: ORPHANED — publish + broker hops, delivery never happened
+    c = [_span(3, "publish", origin, 0.1),
+         _span(3, "ingress", origin, 0.4),
+         _span(3, "plan", origin, 0.5)]
+    # duplicates: chain A's ingress span shipped twice (same t_ns)
+    dup = [a_broker[0], a_broker[0]]
+    _write(tmp_path / "client.jsonl", a_client + b)
+    _write(tmp_path / "broker0.jsonl", a_broker + c + dup)
+    _write(tmp_path / "marshal.jsonl", a_marshal)
+    (tmp_path / "garbled.jsonl").write_text('{"not a span"}\nnot json\n')
+    return tmp_path
+
+
+def test_chain_assembly_orphans_dupes_skew(tmp_path):
+    _synthetic_dir(tmp_path)
+    spans, dups = trace_report.load_spans([str(tmp_path)])
+    assert dups == 2  # dup list re-ships a span already in a_broker
+    report = trace_report.build_report(spans, duplicates=dups, top=5)
+    assert report["trace_ids"] == 3
+    assert report["complete_chains"] == 2
+    assert report["incomplete_chains"] == 1
+    assert report["orphaned_spans"] == 3  # chain C's spans
+    assert report["skewed_hops"] == 1     # chain B's delivery
+    assert report["duplicates_dropped"] == 2
+    # per-hop stats exist for every hop present, in canonical order
+    assert list(report["per_hop"]) == ["auth", "publish", "ingress",
+                                       "plan", "egress", "delivery"]
+    assert report["per_hop"]["delivery"]["count"] == 2
+    # skew clamps to 0, so p50 over [0, 9ms] is one of the two
+    assert report["per_hop"]["delivery"]["max_ms"] == 9.0
+    # slowest chain is A, broken down hop by hop in time order
+    slowest = report["slowest"][0]
+    assert slowest["trace_id"] == f"{1:016x}"
+    assert slowest["e2e_ms"] == 9.0
+    hops = [h["hop"] for h in slowest["hops"]]
+    assert hops == ["publish", "auth", "ingress", "plan", "egress",
+                    "delivery"]
+    # dt of the ingress hop = 2.0ms - 1.0ms (after auth)
+    ingress = slowest["hops"][2]
+    assert abs(ingress["dt_ms"] - 1.0) < 1e-6
+
+
+def test_format_report_is_readable(tmp_path):
+    _synthetic_dir(tmp_path)
+    spans, dups = trace_report.load_spans([str(tmp_path)])
+    text = trace_report.format_report(
+        trace_report.build_report(spans, duplicates=dups))
+    assert "2 complete" in text
+    assert "1 incomplete" in text
+    assert "p99 ms" in text
+    assert "slowest complete chains" in text
+
+
+def test_cli_strict_gate(tmp_path):
+    _synthetic_dir(tmp_path)
+    # non-strict: complete chains exist -> 0
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--json", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["complete_chains"] == 2
+    # strict: the orphaned chain fails the gate
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--strict", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert "orphaned" in proc.stderr
+
+
+def test_cli_fails_without_any_complete_chain(tmp_path):
+    _write(tmp_path / "only.jsonl",
+           [_span(9, "publish", 1_700_000_000_000_000_000, 0.1)])
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert "no complete chain" in proc.stderr
