@@ -310,6 +310,163 @@ async def bench_trace_overhead(impl: str, receivers: int, msgs: int,
 
 
 # ---------------------------------------------------------------------------
+# tier 4 (ISSUE 6): multi-core shard scaling — REAL OS processes over TCP
+# ---------------------------------------------------------------------------
+
+def _free_port_block() -> int:
+    import socket
+    while True:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        if port <= 64000:
+            return port
+
+
+async def _shard_forward_once(shards: int, receivers: int, msgs: int,
+                              trials: int, payload: int,
+                              batch: int = 64) -> Optional[dict]:
+    """One shard-count row: spawn discovery + marshal + ONE broker binary
+    (``--shards N``) as real processes, drive 1 sender + R receivers via
+    the real client library over TCP, count at the receivers' transport
+    drain. ``--shards 1`` is the same-run baseline (byte-for-byte the
+    single-process broker)."""
+    import signal
+    import tempfile
+
+    from pushcdn_tpu.bin.common import keypair_from_seed, spawn_binary
+    from pushcdn_tpu.client import Client, ClientConfig
+    from pushcdn_tpu.proto.message import Broadcast, serialize
+    from pushcdn_tpu.proto.transport.base import FrameChunk
+    from pushcdn_tpu.proto.transport.tcp import Tcp
+
+    bp = _free_port_block()
+    db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-shardbench-"),
+                      "cdn.sqlite")
+    procs = []
+    clients = []
+    try:
+        procs.append(spawn_binary(
+            "broker",
+            "--discovery-endpoint", db,
+            "--public-advertise-endpoint", f"127.0.0.1:{bp}",
+            "--public-bind-endpoint", f"127.0.0.1:{bp}",
+            "--private-advertise-endpoint", f"127.0.0.1:{bp + 1}",
+            "--private-bind-endpoint", f"127.0.0.1:{bp + 1}",
+            "--user-transport", "tcp", "--broker-transport", "tcp",
+            "--shards", str(shards),
+            # deterministic round-robin accept spread: receiver i lands on
+            # worker i % N (SO_REUSEPORT's hash spread is luck-dependent
+            # at 9 connections; the measured data path is identical).
+            # capture=False: the bench never drains the pipe, and a
+            # blocked log write would wedge the measured processes.
+            env_extra={"PUSHCDN_SHARD_ACCEPT": "handoff"}, capture=False))
+        procs.append(spawn_binary(
+            "marshal",
+            "--discovery-endpoint", db,
+            "--bind-endpoint", f"127.0.0.1:{bp + 2}",
+            "--user-transport", "tcp", capture=False))
+        await asyncio.sleep(1.0)
+
+        async def connect(seed: int, topics) -> Client:
+            c = Client(ClientConfig(
+                marshal_endpoint=f"127.0.0.1:{bp + 2}",
+                keypair=keypair_from_seed(seed),
+                protocol=Tcp, subscribed_topics=set(topics)))
+            async with asyncio.timeout(30):
+                while True:
+                    try:
+                        await c.ensure_initialized()
+                        return c
+                    except Exception:
+                        await asyncio.sleep(0.3)
+
+        for r in range(receivers):
+            clients.append(await connect(100 + r, [0]))
+        sender = await connect(99, [])
+        clients.append(sender)
+        await asyncio.sleep(0.7)  # interest deltas settle across shards
+
+        frame = serialize(Broadcast([0], os.urandom(payload)))
+        msgs = max(batch, (msgs // batch) * batch)
+
+        async def drain(conn, n):
+            got = 0
+            async with asyncio.timeout(180):
+                while got < n:
+                    for item in await conn.recv_frames(n - got):
+                        got += item.remaining if type(item) is FrameChunk \
+                            else 1
+                        item.release()
+
+        rates = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            drains = [asyncio.create_task(
+                drain(clients[r]._connection, msgs))
+                for r in range(receivers)]
+            send_conn = sender._connection
+            for _ in range(msgs // batch):
+                await send_conn.send_raw_many([frame] * batch)
+                await asyncio.sleep(0)
+            await asyncio.gather(*drains)
+            rates.append(msgs / (time.perf_counter() - t0))
+        med = statistics.median(rates)
+        return {"median": med, "trials": rates, "msgs": msgs,
+                "delivered": med * receivers}
+    except (asyncio.TimeoutError, Exception) as exc:
+        emit("route/shard_forward", 0, "skipped", shards=shards,
+             reason=f"harness failed: {exc!r}")
+        return None
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        deadline = time.time() + 8.0
+        while time.time() < deadline and any(p.poll() is None
+                                             for p in procs):
+            await asyncio.sleep(0.1)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+async def bench_shard_scaling(shard_counts, receivers: int, msgs: int,
+                              trials: int, payload: int = 512) -> dict:
+    """Shard-count rows (1/2/4) for the 8-receiver forwarding figure.
+    Labels carry the host's usable core count — on a 1-core container the
+    rows are honestly flat; near-linear scaling needs cores >= shards."""
+    cpus = len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity") \
+        else (os.cpu_count() or 1)
+    out: dict = {}
+    for n in shard_counts:
+        res = await _shard_forward_once(n, receivers, msgs, trials, payload)
+        gc.collect()
+        if res is None:
+            continue
+        out[n] = res["median"]
+        emit("route/shard_forward", res["median"], "msgs/s", shards=n,
+             receivers=receivers, msgs=res["msgs"], payload=payload,
+             delivered_msgs_s=round(res["delivered"], 1), cpus=cpus,
+             backend="cpu",
+             trials=[round(r, 1) for r in res["trials"]])
+    base = out.get(1)
+    if base:
+        for n, med in out.items():
+            if n != 1:
+                emit("route/shard_forward", med / base, "x",
+                     tier=f"shards{n}-vs-1", cpus=cpus,
+                     note=("scaling requires cores >= shards; "
+                           f"this host has {cpus}"))
+    return {f"shard{n}_msgs_s": round(v, 1) for n, v in out.items()}
+
+
+# ---------------------------------------------------------------------------
 # tier 2: end-to-end broker forwarding through the wire
 # ---------------------------------------------------------------------------
 
@@ -334,7 +491,8 @@ async def bench_forward(impl: str, receivers: int, msgs: int,
 
 
 async def amain(quick: bool, impl_arg: str,
-                out_json: Optional[str] = None) -> None:
+                out_json: Optional[str] = None,
+                shard_rows: Optional[str] = None) -> None:
     from pushcdn_tpu.bin.common import tune_gc
     tune_gc()
     impls = ("native", "python") if impl_arg == "auto" else (impl_arg,)
@@ -373,6 +531,14 @@ async def amain(quick: bool, impl_arg: str,
         trace_impl, receivers=8, msgs=2_000 if quick else 10_000,
         trials=2 if quick else 3)
 
+    # ISSUE 6: multi-core shard scaling rows (real OS processes over TCP)
+    if shard_rows != "none":
+        counts = [int(x) for x in
+                  (shard_rows or ("1,2" if quick else "1,2,4")).split(",")]
+        stats.update(await bench_shard_scaling(
+            counts, receivers=8, msgs=1_500 if quick else 6_000,
+            trials=2 if quick else 3))
+
     if out_json:
         write_bench_json(out_json, "route_bench", stats, RESULTS)
 
@@ -390,7 +556,7 @@ def write_bench_json(path: str, section: str, headline: dict,
                 doc = json.load(fh)
         except (OSError, ValueError):
             doc = {}
-    doc.setdefault("round", 9)
+    doc.setdefault("round", 10)
     doc[section] = {"headline": headline, "rows": rows}
     with open(path, "w") as fh:
         json.dump(doc, fh, indent=1)
@@ -407,9 +573,13 @@ def main() -> None:
                          "'auto' runs the native-vs-python A/B")
     ap.add_argument("--out-json", default=None, metavar="PATH",
                     help="merge this run's rows + headline into a "
-                         "machine-readable bench file (e.g. BENCH_r09.json)")
+                         "machine-readable bench file (e.g. BENCH_r10.json)")
+    ap.add_argument("--shard-rows", default=None, metavar="N,N,...",
+                    help="shard counts for the route/shard_forward tier "
+                         "(default 1,2,4; 1,2 with --quick; 'none' skips)")
     args = ap.parse_args()
-    asyncio.run(amain(args.quick, args.route_impl, args.out_json))
+    asyncio.run(amain(args.quick, args.route_impl, args.out_json,
+                      args.shard_rows))
 
 
 if __name__ == "__main__":

@@ -44,6 +44,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--global-permits", action="store_true")
     p.add_argument("--scheme", default="ed25519",
                    help="signature scheme: ed25519 | bls-bn254")
+    # ---- sharded data plane (ISSUE 6) ---------------------------------
+    p.add_argument("--shards", type=int, default=None,
+                   help="shard the data plane across N worker OS "
+                        "processes (default: PUSHCDN_SHARDS or 1 = "
+                        "single-process, byte-for-byte today's behavior)."
+                        " Shard 0 owns the mesh; users spread across "
+                        "workers via SO_REUSEPORT (or parent fd-handoff)")
+    p.add_argument("--shard-index", type=int, default=None,
+                   help=argparse.SUPPRESS)  # internal: worker role
+    p.add_argument("--shard-ipc", default=None,
+                   help=argparse.SUPPRESS)  # internal: worker IPC spec
     # ---- device data plane (the TPU path) -----------------------------
     p.add_argument("--device-plane", action="store_true",
                    help="route eligible messages through the attached "
@@ -75,7 +86,100 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _worker_argv_base() -> list:
+    """This process's argv minus the flags the supervisor rewrites per
+    worker (--shards; --metrics-bind-endpoint is reassigned per shard)."""
+    import sys
+    argv = []
+    skip = False
+    for a in sys.argv[1:]:
+        if skip:
+            skip = False
+            continue
+        if a in ("--shards", "--metrics-bind-endpoint"):
+            skip = True
+            continue
+        if a.startswith("--shards=") or \
+                a.startswith("--metrics-bind-endpoint="):
+            continue
+        argv.append(a)
+    return argv
+
+
+async def run_supervisor(args: argparse.Namespace, shards: int) -> None:
+    """Parent of a sharded broker: spawn N workers, relay control-plane
+    deltas, aggregate observability, propagate drains (ISSUE 6)."""
+    import sys
+
+    from pushcdn_tpu.broker import sharding
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ["PYTHONPATH"] = (
+        repo + os.pathsep + os.environ["PYTHONPATH"]
+        if os.environ.get("PYTHONPATH") else repo)
+    base = _worker_argv_base()
+
+    def worker_argv(shard: int, spec_json: str, metrics_endpoint):
+        argv = [sys.executable, "-m", "pushcdn_tpu.bin.broker", *base,
+                "--shard-index", str(shard), "--shard-ipc", spec_json]
+        if metrics_endpoint:
+            argv += ["--metrics-bind-endpoint", metrics_endpoint]
+        return argv
+
+    acceptor = None
+    if not sharding.reuseport_available():
+        if args.user_transport != "tcp":
+            # the handoff acceptor deals RAW TCP fds; a TLS/QUIC user
+            # transport would silently answer handshakes in plaintext
+            # (or never accept at all) — refuse loudly instead
+            raise SystemExit(
+                "--shards without SO_REUSEPORT uses the parent fd-handoff "
+                "acceptor, which supports only --user-transport tcp "
+                f"(got {args.user_transport!r}); use a platform with "
+                "SO_REUSEPORT for TLS/QUIC user transports")
+        acceptor = args.public_bind_endpoint
+    sup = sharding.ShardSupervisor(
+        shards, args.metrics_bind_endpoint, worker_argv,
+        acceptor_endpoint=acceptor)
+    await sup.start()
+    drain = asyncio.Event()
+    installed = install_drain_signals(drain, on_signal=sup.begin_drain)
+    exit_task = asyncio.create_task(sup.wait_any_worker_exit(),
+                                    name="shard-reaper")
+    drain_task = asyncio.create_task(drain.wait(), name="drain-wait")
+    try:
+        await asyncio.wait({exit_task, drain_task},
+                           return_when=asyncio.FIRST_COMPLETED)
+        if installed and drain.is_set():
+            # workers flipped not-ready on the forwarded SIGTERM and are
+            # serving out the grace window; reap them BEFORE the parent's
+            # aggregated endpoint goes away
+            await sup.reap(drain_grace_s() + 15.0)
+            await sup.stop()
+            return
+        rc = exit_task.result() if exit_task.done() else 1
+        sup.signal_workers()
+        await sup.reap(5.0)
+        await sup.stop()
+        raise SystemExit(rc if rc not in (0, None) else 1)
+    finally:
+        for t in (exit_task, drain_task):
+            t.cancel()
+
+
 async def amain(args: argparse.Namespace) -> None:
+    from pushcdn_tpu.broker import sharding
+
+    shards = sharding.shards_from_env(args.shards)
+    if shards > 1 and (args.device_plane or args.mesh_shards is not None):
+        raise SystemExit("--shards is a host-data-plane feature; combine "
+                         "with --device-plane/--mesh-shards once the "
+                         "device plane learns shard-local staging")
+    if args.shard_index is None and shards > 1:
+        await run_supervisor(args, shards)
+        return
+
     run_def = run_def_from_args(args.broker_transport, args.user_transport,
                                 args.discovery_endpoint, args.num_topics,
                                 args.global_permits, scheme=args.scheme)
@@ -93,6 +197,23 @@ async def amain(args: argparse.Namespace) -> None:
         if args.device_batch_window is not None:
             out["batch_window_s"] = args.device_batch_window
         return out
+
+    spec = None
+    if args.shard_index is not None:
+        import json as json_mod
+        if not args.shard_ipc:
+            raise SystemExit("--shard-index is internal (spawned by "
+                             "--shards); it requires --shard-ipc")
+        spec = json_mod.loads(args.shard_ipc)
+        # per-worker span log: the workers inherit the parent's
+        # PUSHCDN_TRACE_LOG — suffix it so two shards never interleave
+        # writes in one JSONL (proto.trace reads the env at import, but
+        # lazily opens the file, so adjusting here is race-free)
+        trace_path = os.environ.get("PUSHCDN_TRACE_LOG")
+        if trace_path:
+            from pushcdn_tpu.proto import trace as trace_mod_
+            root, ext = os.path.splitext(trace_path)
+            trace_mod_._LOG_PATH = f"{root}-shard{spec['shard']}{ext}"
 
     device_plane = None
     if args.device_plane:
@@ -120,7 +241,17 @@ async def amain(args: argparse.Namespace) -> None:
         device_plane=device_plane,
         # a mesh-group deployment's inter-broker plane is the device mesh
         form_mesh=args.mesh_shards is None,
+        # worker-shard role (ISSUE 6): shard 0 owns mesh + control tasks
+        shard_index=(spec["shard"] if spec else 0),
+        num_shards=(spec["num_shards"] if spec else 1),
+        bind_private=(spec is None or spec["shard"] == 0),
+        reuse_port=(spec is not None and "accept_fd" not in spec),
+        accept_handoff_fd=(spec.get("accept_fd") if spec else None),
     ))
+    if spec is not None:
+        from pushcdn_tpu.broker import sharding
+        runtime = sharding.runtime_from_spec(broker, spec)
+        runtime.attach()
     if args.mesh_shards is not None:
         # cross-host SPMD mesh group: join the distributed runtime, build
         # the global mesh, attach this broker to its shard

@@ -27,6 +27,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--key-seed", type=int, default=None)
     p.add_argument("--topic", type=int, action="append", default=None)
     p.add_argument("--interval", type=float, default=5.0)
+    p.add_argument("--direct-to-seed", type=int, default=None,
+                   help="send directs to the user whose keypair derives "
+                        "from this seed instead of ourselves (two clients "
+                        "messaging each other — the cross-shard traffic "
+                        "driver for a --shards broker)")
     p.add_argument("--scheme", default="ed25519",
                    help="signature scheme: ed25519 | bls-bn254")
     p.add_argument("--metrics-bind-endpoint", default=None,
@@ -71,10 +76,14 @@ async def amain(args: argparse.Namespace) -> None:
                             bytes(message.message)[:64])
 
     recv_task = asyncio.create_task(receiver())
+    direct_target = client.public_key
+    if args.direct_to_seed is not None:
+        direct_target = keypair_from_seed(args.direct_to_seed,
+                                          args.scheme).public_key
     n = 0
     try:
         while True:
-            await client.send_direct_message(client.public_key,
+            await client.send_direct_message(direct_target,
                                              f"echo {n}".encode())
             await client.send_broadcast_message(topics, f"hello {n}".encode())
             n += 1

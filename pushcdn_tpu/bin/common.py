@@ -81,17 +81,32 @@ def drain_grace_s() -> float:
         return 0.0
 
 
-def install_drain_signals(event: asyncio.Event) -> bool:
+def install_drain_signals(event: asyncio.Event, on_signal=None) -> bool:
     """Route SIGINT/SIGTERM to ``event.set()`` instead of
     KeyboardInterrupt, so the server binaries can drain gracefully:
     readiness flips false first, listeners close after the grace window.
     Returns False where signal handlers are unavailable (non-main thread,
-    Windows proactor) — callers keep the KeyboardInterrupt fallback."""
+    Windows proactor) — callers keep the KeyboardInterrupt fallback.
+
+    ``on_signal`` (optional) runs in the handler alongside the latch —
+    the sharded broker's parent uses it to PROPAGATE the drain: readiness
+    flips false on every worker shard first (the callback forwards
+    SIGTERM), the workers serve out ``PUSHCDN_DRAIN_GRACE_S``, and the
+    parent reaps them before its own listeners close."""
     loop = asyncio.get_running_loop()
+
+    def _fire() -> None:
+        event.set()
+        if on_signal is not None:
+            try:
+                on_signal()
+            except Exception:
+                pass
+
     installed = False
     for sig in (signal_mod.SIGINT, signal_mod.SIGTERM):
         try:
-            loop.add_signal_handler(sig, event.set)
+            loop.add_signal_handler(sig, _fire)
             installed = True
         except (NotImplementedError, RuntimeError, ValueError):
             pass
@@ -139,11 +154,16 @@ def keypair_from_seed(seed: Optional[int],
     return scheme_by_name(scheme).generate_keypair(seed=seed)
 
 
-def spawn_binary(name: str, *args: str, env_extra=None):
+def spawn_binary(name: str, *args: str, env_extra=None, capture=True):
     """Launch ``pushcdn_tpu.bin.<name>`` as a child process with the repo
     prepended to PYTHONPATH (setdefault breaks under any preexisting
     PYTHONPATH, e.g. an accelerator site dir) — the one spawner the local
-    cluster runner and the binary smoke tests share."""
+    cluster runner and the binary smoke tests share.
+
+    ``capture=False`` sends the child's output to /dev/null instead of a
+    pipe — REQUIRED for spawners that never drain the pipe: a chatty
+    child (e.g. a ``--shards`` broker whose workers share the fd) blocks
+    forever once the 64 KiB pipe buffer fills."""
     import os
     import subprocess
     import sys
@@ -154,7 +174,9 @@ def spawn_binary(name: str, *args: str, env_extra=None):
                          if env.get("PYTHONPATH") else repo)
     if env_extra:
         env.update(env_extra)
+    sink = subprocess.PIPE if capture else subprocess.DEVNULL
     return subprocess.Popen(
         [sys.executable, "-m", f"pushcdn_tpu.bin.{name}", *args],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True)
+        env=env, stdout=sink,
+        stderr=subprocess.STDOUT if capture else subprocess.DEVNULL,
+        text=capture)

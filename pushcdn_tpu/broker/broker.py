@@ -88,6 +88,17 @@ class BrokerConfig:
     # False = register in discovery but never dial host broker links
     # (deployments whose inter-broker plane is the device mesh only)
     form_mesh: bool = True
+    # ---- sharded data plane (ISSUE 6) ----
+    # worker-shard role: shard 0 owns the private (mesh) listener and the
+    # heartbeat/sync/whitelist control tasks; other workers run only the
+    # user data plane. reuse_port spreads accepted users across workers.
+    shard_index: int = 0
+    num_shards: int = 1
+    bind_private: bool = True
+    reuse_port: bool = False
+    # fd-handoff fallback (no SO_REUSEPORT): adopt accepted sockets from
+    # the parent over this inherited unix-socketpair fd instead of binding
+    accept_handoff_fd: Optional[int] = None
 
 
 class Broker:
@@ -110,6 +121,7 @@ class Broker:
         self.host_links_kick = asyncio.Event()
         self._metrics_server = None
         self.device_plane = None
+        self.shard_runtime = None  # ShardRuntime when this is one of N workers
         self.seen_dialing: set[str] = set()  # peers we're currently dialing
         # readiness state (ISSUE 5): listeners-bound latch, cached
         # discovery probe (refreshed by the heartbeat task and, past the
@@ -157,12 +169,27 @@ class Broker:
         try:
             # public listener carries users, private carries peer brokers
             # (lib.rs:190-212)
-            self.user_listener = await self.run_def.user_def.protocol.bind(
-                _substitute_local_ip(c.public_bind_endpoint),
-                certificate=self.certificate)
-            self.broker_listener = await self.run_def.broker_def.protocol.bind(
-                _substitute_local_ip(c.private_bind_endpoint),
-                certificate=self.certificate)
+            if c.accept_handoff_fd is not None:
+                # sharded fd-handoff fallback: adopt accepted sockets from
+                # the parent acceptor instead of binding (no SO_REUSEPORT)
+                import socket as socket_mod
+
+                from pushcdn_tpu.broker.sharding import FdHandoffListener
+                self.user_listener = FdHandoffListener(socket_mod.socket(
+                    socket_mod.AF_UNIX, socket_mod.SOCK_STREAM,
+                    fileno=c.accept_handoff_fd))
+            elif c.reuse_port:
+                self.user_listener = await self.run_def.user_def.protocol.bind(
+                    _substitute_local_ip(c.public_bind_endpoint),
+                    certificate=self.certificate, reuse_port=True)
+            else:
+                self.user_listener = await self.run_def.user_def.protocol.bind(
+                    _substitute_local_ip(c.public_bind_endpoint),
+                    certificate=self.certificate)
+            if c.bind_private:
+                self.broker_listener = await self.run_def.broker_def.protocol.bind(
+                    _substitute_local_ip(c.private_bind_endpoint),
+                    certificate=self.certificate)
             self.listeners_bound = True
 
             if c.device_plane is not None:
@@ -240,7 +267,10 @@ class Broker:
         """Ready when the mesh has ≥1 live peer link, or being solo is
         intentional: discovery reports no other brokers (we ARE the
         deployment), or the inter-broker plane is the device mesh
-        (form_mesh=False)."""
+        (form_mesh=False), or this is a non-zero worker shard (the mesh
+        links live on shard 0)."""
+        if self.config.num_shards > 1 and self.config.shard_index != 0:
+            return True, "mesh links owned by shard 0"
         n = self.connections.num_brokers
         if n >= 1:
             return True, f"{n} peer links"
@@ -292,7 +322,9 @@ class Broker:
             str(t): len(conns.user_topics.get_keys_by_value(t))
             for t in sorted(set(conns.user_topics.values()))}
         state = getattr(self, "_route_state", None)
+        runtime = self.shard_runtime
         return {
+            "shard_runtime": runtime.stats() if runtime is not None else None,
             "identity": str(self.identity),
             "draining": health_mod.draining() is not None,
             "interest_version": conns.interest_version,
@@ -312,18 +344,32 @@ class Broker:
     # -- supervision --------------------------------------------------------
 
     async def start(self) -> None:
-        """Spawn the five supervised tasks (lib.rs:269-318)."""
+        """Spawn the five supervised tasks (lib.rs:269-318). A non-zero
+        worker shard runs only the user data plane (+ whitelist for its
+        own users); shard 0 / unsharded brokers run the full set."""
         if self.device_plane is not None:
             await self.device_plane.start()
         metrics_mod.PRE_RENDER_HOOKS.append(self.update_metrics)
         spawn = asyncio.create_task
         self._tasks = [
-            spawn(heartbeat_task.run_heartbeat_task(self), name="heartbeat"),
-            spawn(sync_task.run_sync_task(self), name="sync"),
+            spawn(listener_tasks.run_user_listener_task(self),
+                  name="user-listener"),
             spawn(whitelist_task.run_whitelist_task(self), name="whitelist"),
-            spawn(listener_tasks.run_user_listener_task(self), name="user-listener"),
-            spawn(listener_tasks.run_broker_listener_task(self), name="broker-listener"),
         ]
+        if self.config.bind_private:
+            self._tasks += [
+                spawn(heartbeat_task.run_heartbeat_task(self),
+                      name="heartbeat"),
+                spawn(sync_task.run_sync_task(self), name="sync"),
+                spawn(listener_tasks.run_broker_listener_task(self),
+                      name="broker-listener"),
+            ]
+        if self.shard_runtime is not None:
+            self._tasks.append(spawn(self.shard_runtime.run_ring_drain(),
+                                     name="shard-ring-drain"))
+            bus = self.shard_runtime.bus
+            if bus is not None and hasattr(bus, "run"):
+                self._tasks.append(spawn(bus.run(), name="shard-bus"))
 
     async def run_until_failure(self) -> None:
         """Fail-fast: the first core task to exit brings the broker down
@@ -355,6 +401,9 @@ class Broker:
         if self._tasks:
             await asyncio.gather(*self._tasks, return_exceptions=True)
         self.connections.remove_all()
+        if self.shard_runtime is not None:
+            self.shard_runtime.close()
+            self.shard_runtime = None
         for listener in (self.user_listener, self.broker_listener):
             if listener is not None:
                 try:

@@ -88,6 +88,23 @@ class Connections:
         # batch awaits on egress/device backpressure) invalidates the cache
         # the same way the reference's per-message query would see it.
         self.interest_version = 0
+        # ---- sharded data plane (ISSUE 6) ----
+        # This process may be one of N worker shards presenting as ONE
+        # broker identity. Siblings' users/mesh links are tracked here so
+        # routing (scalar and cut-through) can hand their fan-out to the
+        # shard rings; all four stay empty (and cost nothing) at N == 1.
+        self.num_shards = 1
+        self.shard_id = 0
+        self.remote_user_shard: Dict[UserPublicKey, int] = {}   # key -> shard
+        self.remote_broker_shard: Dict[str, int] = {}           # ident -> shard
+        # control-plane delta emitter (ShardRuntime installs it): every
+        # local routing-state mutation is mirrored to sibling shards as a
+        # versioned delta via the parent hub
+        self.shard_notifier = None
+
+    def _notify_shards(self, event: tuple) -> None:
+        if self.shard_notifier is not None:
+            self.shard_notifier(event)
 
     # ---- users ------------------------------------------------------------
 
@@ -103,6 +120,11 @@ class Connections:
                         mnemonic(public_key))
             self._teardown(existing, "evicted by reconnect")
             self.user_topics.remove_key(public_key)
+        # a user migrating here from a sibling shard (REUSEPORT lands the
+        # reconnect on a different worker) sheds its remote record; the
+        # ``user`` delta below makes the old shard evict its stale conn
+        if self.remote_user_shard.pop(public_key, None) is not None:
+            self.user_topics.remove_key(public_key)
         self.interest_version += 1
         self.users[public_key] = UserHandle(connection, abort_handle)
         if topics:
@@ -110,6 +132,7 @@ class Connections:
         self.direct_map.insert(public_key, self.identity)
         if self.observer is not None:
             self.observer.on_user_added(public_key, topics)
+        self._notify_shards(("user", public_key, list(topics)))
         logger.info("user %s connected (topics=%s)", mnemonic(public_key), topics)
 
     def remove_user(self, public_key: UserPublicKey,
@@ -125,6 +148,7 @@ class Connections:
         self.direct_map.remove_if_equals(public_key, self.identity)
         if self.observer is not None:
             self.observer.on_user_removed(public_key)
+        self._notify_shards(("user_del", public_key))
         logger.info("user %s removed: %s", mnemonic(public_key), reason)
 
     def has_user(self, public_key: UserPublicKey) -> bool:
@@ -148,9 +172,11 @@ class Connections:
             self._teardown(existing, "evicted by reconnect")
             self.broker_topics.remove_key(identifier)
         self.interest_version += 1
+        self.remote_broker_shard.pop(identifier, None)  # now a live link
         self.brokers[identifier] = BrokerHandle(
             connection, abort_handle,
             topic_sync_map=VersionedMap(local_identity=identifier))
+        self._notify_shards(("mesh_topics", identifier, []))
         logger.info("broker %s connected", identifier)
 
     def remove_broker(self, identifier: str, reason: str = "disconnected") -> None:
@@ -164,6 +190,7 @@ class Connections:
         # owned — they will re-appear when they reconnect elsewhere
         # (remove_by_value_no_modify, versioned_map.rs).
         dropped = self.direct_map.remove_by_value_no_modify(identifier)
+        self._notify_shards(("mesh_broker_del", identifier))
         logger.info("broker %s removed (%s); forgot %d routed users",
                     identifier, reason, len(dropped))
 
@@ -193,6 +220,9 @@ class Connections:
             if self.observer is not None:
                 self.observer.on_subscription_changed(
                     public_key, self.user_topics.get_values_of_key(public_key))
+            self._notify_shards((
+                "user", public_key,
+                list(self.user_topics.get_values_of_key(public_key))))
 
     def unsubscribe_user_from(self, public_key: UserPublicKey,
                               topics: List[Topic]) -> None:
@@ -205,6 +235,10 @@ class Connections:
             if self.observer is not None:
                 self.observer.on_subscription_changed(
                     public_key, self.user_topics.get_values_of_key(public_key))
+            if handle is not None:
+                self._notify_shards((
+                    "user", public_key,
+                    list(self.user_topics.get_values_of_key(public_key))))
 
     def subscribe_broker_to(self, identifier: str, topics: List[Topic]) -> None:
         if identifier in self.brokers and topics:
@@ -216,6 +250,74 @@ class Connections:
         if topics:
             self.interest_version += 1
             self.broker_topics.dissociate_key_from_values(identifier, topics)
+
+    # ---- sibling-shard delta application (ISSUE 6) -------------------------
+    # Called by ShardRuntime.apply_event with state relayed from sibling
+    # worker processes; these never re-emit to the shard bus (the parent
+    # hub already fans deltas to every other worker).
+
+    def set_remote_user(self, public_key: UserPublicKey, shard: int,
+                        topics: List[Topic]) -> None:
+        """A sibling shard owns (or re-announced) this user. Evicts any
+        local connection for the same key — the cross-shard flavor of the
+        double-connect kick (the user reconnected and SO_REUSEPORT landed
+        them on another worker)."""
+        if public_key in self.users:
+            logger.info("user %s connected on shard %d; evicting local",
+                        mnemonic(public_key), shard)
+            self.remove_user(public_key,
+                             reason=f"user connected on shard {shard}")
+        self.interest_version += 1
+        self.remote_user_shard[public_key] = shard
+        self.user_topics.remove_key(public_key)
+        if topics:
+            self.user_topics.associate_key_with_values(public_key,
+                                                       list(topics))
+        if self.shard_id == 0:
+            # shard 0 fronts the mesh: its DirectMap replica must claim
+            # every shard's users so UserSync advertises the whole box
+            self.direct_map.insert(public_key, self.identity)
+
+    def remove_remote_user(self, public_key: UserPublicKey,
+                           shard: int) -> None:
+        """Sibling user disconnect. ``shard`` guards against reorder with
+        a migration: a del from the OLD shard must not clobber the record
+        the NEW shard's announcement just installed."""
+        if self.remote_user_shard.get(public_key) != shard:
+            return
+        self.interest_version += 1
+        del self.remote_user_shard[public_key]
+        self.user_topics.remove_key(public_key)
+        if self.shard_id == 0:
+            self.direct_map.remove_if_equals(public_key, self.identity)
+
+    def set_remote_broker(self, identifier: str, shard: int,
+                          topics: List[Topic]) -> None:
+        """Shard ``shard`` (0 — the mesh owner) holds a live link to this
+        peer broker; record its advertised topics so broadcasts here plan
+        fan-out through the ring to the link-owning shard."""
+        if identifier in self.brokers:
+            return  # we hold the live link ourselves
+        self.interest_version += 1
+        self.remote_broker_shard[identifier] = shard
+        self.broker_topics.remove_key(identifier)
+        if topics:
+            self.broker_topics.associate_key_with_values(identifier,
+                                                         list(topics))
+
+    def remove_remote_broker(self, identifier: str) -> None:
+        self.interest_version += 1
+        self.remote_broker_shard.pop(identifier, None)
+        self.broker_topics.remove_key(identifier)
+        # same local forget as remove_broker: users the dead peer owned
+        # reappear when they reconnect elsewhere
+        self.direct_map.remove_by_value_no_modify(identifier)
+
+    @property
+    def num_users_global(self) -> int:
+        """Users across ALL shards of this broker (what shard 0 reports
+        to discovery so the marshal's load balancing sees the box)."""
+        return len(self.users) + len(self.remote_user_shard)
 
     # ---- routing queries --------------------------------------------------
 
@@ -268,10 +370,15 @@ class Connections:
 
     # ---- sync application -------------------------------------------------
 
-    def apply_user_sync(self, payload) -> List[UserPublicKey]:
+    def apply_user_sync(self, payload,
+                        from_sibling: bool = False) -> List[UserPublicKey]:
         """Merge a peer's DirectMap delta. Returns local users to EVICT
         because the merge says they are now owned elsewhere — the
-        double-connect kick across brokers (connections/mod.rs:154-162)."""
+        double-connect kick across brokers (connections/mod.rs:154-162).
+
+        ``from_sibling=True`` marks a payload relayed by a sibling shard
+        (the mesh links live on shard 0; it forwards every merge): applied
+        identically but not re-emitted to the shard bus."""
         incoming = VersionedMap.deserialize_entries(payload)
         changed = self.direct_map.merge(incoming)
         if changed:
@@ -281,10 +388,18 @@ class Connections:
             # interest caches key only on topic queries, which a DirectMap
             # merge can't affect, so the extra bump is conservative there.
             self.interest_version += 1
+            if not from_sibling:
+                self._notify_shards(("usersync", bytes(payload)))
         evict: List[UserPublicKey] = []
         for key, _old, new in changed:
             if new is not None and new != self.identity and key in self.users:
                 evict.append(key)
+            if new is not None and new != self.identity:
+                # a user the mesh now places on ANOTHER broker can't be a
+                # sibling-shard resident either: drop the stale record so
+                # routing stops ring-forwarding to a shard that lost it
+                if self.remote_user_shard.pop(key, None) is not None:
+                    self.user_topics.remove_key(key)
         for key in evict:
             logger.info("user %s connected elsewhere (%s); evicting",
                         mnemonic(key), self.direct_map.get(key))
@@ -307,6 +422,10 @@ class Connections:
                 self.subscribe_broker_to(from_broker, [int(topic)])
             else:
                 self.unsubscribe_broker_from(from_broker, [int(topic)])
+        if changed:
+            self._notify_shards((
+                "mesh_topics", from_broker,
+                list(self.broker_topics.get_values_of_key(from_broker))))
 
     # ---- teardown ---------------------------------------------------------
 

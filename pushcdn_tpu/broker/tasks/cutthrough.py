@@ -135,14 +135,23 @@ class RouteState:
 
     __slots__ = ("broker", "planner", "version", "user_keys", "broker_ids",
                  "usable", "_frames_since_rebuild", "_skip_rebuilds",
-                 "built_at")
+                 "built_at", "n_local_users", "n_local_brokers",
+                 "remote_user_shards", "remote_broker_shards")
 
     def __init__(self, broker: "Broker", planner):
         self.broker = broker
         self.planner = planner
         self.version = -1
+        # peer index space: [local users][sibling-shard users][local
+        # broker links][mesh brokers held by another shard]. The planner
+        # only distinguishes users (< n_users) from brokers — sibling
+        # users count as users so broker-origin frames still reach them.
         self.user_keys: List[bytes] = []
         self.broker_ids: List[str] = []
+        self.n_local_users = 0
+        self.n_local_brokers = 0
+        self.remote_user_shards: List[int] = []
+        self.remote_broker_shards: List[int] = []
         self.usable = True
         # cold start counts as amortized: the first build must not arm
         # the churn backoff
@@ -175,8 +184,13 @@ class RouteState:
             # scalar for this invalidation instead of rebuilding again
             self._skip_rebuilds -= 1
             return False
-        users = list(conns.users.keys())
-        brokers = list(conns.brokers.keys())
+        local_users = list(conns.users.keys())
+        remote_users = list(conns.remote_user_shard.keys())
+        users = local_users + remote_users
+        local_brokers = list(conns.brokers.keys())
+        remote_brokers = [ident for ident in conns.remote_broker_shard
+                          if ident not in conns.brokers]
+        brokers = local_brokers + remote_brokers
         n_u, n_b = len(users), len(brokers)
         peer_masks = np.zeros((max(n_u + n_b, 1), routeplan.MASK_WORDS),
                               np.uint64)
@@ -192,17 +206,22 @@ class RouteState:
         user_index = {key: i for i, key in enumerate(users)}
         broker_index = {ident: n_u + j for j, ident in enumerate(brokers)}
         identity = conns.identity
-        dkeys: List[bytes] = []
-        owners: List[int] = []
+        dmap: dict = {}
         for key, owner in conns.direct_map.items():
             peer = user_index.get(key) if owner == identity \
                 else broker_index.get(owner)
             if peer is not None:
-                dkeys.append(bytes(key))
-                owners.append(peer)
+                dmap[bytes(key)] = peer
             # unresolvable owner (user/broker not connected): omitted — a
             # plan miss drops the frame, exactly like the scalar flush
             # finding no connection
+        # sibling-shard users aren't in this worker's DirectMap replica
+        # (only shard 0 mirrors the claims for the mesh) — add them so
+        # Direct frames plan straight onto the ring
+        for key in remote_users:
+            dmap.setdefault(bytes(key), user_index[key])
+        dkeys = list(dmap.keys())
+        owners = list(dmap.values())
         self.usable = self.planner.build(
             n_u, n_b, valid, peer_masks, dkeys,
             np.asarray(owners, np.int32))
@@ -210,6 +229,12 @@ class RouteState:
             self.version = conns.interest_version
             self.user_keys = users
             self.broker_ids = brokers
+            self.n_local_users = len(local_users)
+            self.n_local_brokers = len(local_brokers)
+            self.remote_user_shards = [conns.remote_user_shard[k]
+                                       for k in remote_users]
+            self.remote_broker_shards = [conns.remote_broker_shard[i]
+                                         for i in remote_brokers]
             self.built_at = time.monotonic()
             metrics_mod.ROUTE_TABLE_REBUILDS.inc()
             if self._frames_since_rebuild < _REBUILD_MIN_FRAMES:
@@ -237,6 +262,8 @@ class RouteState:
         # during a send await) may re-plan or rebuild, so nothing below
         # the first await may touch planner scratch or snapshot state.
         n_users = self.planner.n_users
+        n_local_u = self.n_local_users
+        n_local_b = self.n_local_brokers
         order = np.argsort(peers, kind="stable")
         speers = peers[order]
         sframes = frames[order]
@@ -246,13 +273,33 @@ class RouteState:
         buf = chunk.buf
         mv = None
         sends: list = []  # (is_user, key_or_ident, data, owner, n_frames)
+        ring: Optional[dict] = None  # shard -> [(kind, ident, idx array)]
         for s, e in zip(starts.tolist(), ends.tolist()):
             peer = int(speers[s])
             idx = sframes[s:e]
             if peer < n_users:
+                if peer >= n_local_u:
+                    # sibling-shard user: cross-shard handoff (collected
+                    # per shard; written to the ring below, still inside
+                    # the synchronous phase — idx is COPIED because the
+                    # pair arrays are reusable planner scratch)
+                    shard = self.remote_user_shards[peer - n_local_u]
+                    if ring is None:
+                        ring = {}
+                    ring.setdefault(shard, []).append(
+                        (0, bytes(self.user_keys[peer]), idx.copy()))
+                    continue
                 target = (True, self.user_keys[peer])
             else:
-                target = (False, self.broker_ids[peer - n_users])
+                b = peer - n_users
+                if b >= n_local_b:
+                    shard = self.remote_broker_shards[b - n_local_b]
+                    if ring is None:
+                        ring = {}
+                    ring.setdefault(shard, []).append(
+                        (1, self.broker_ids[b].encode(), idx.copy()))
+                    continue
+                target = (False, self.broker_ids[b])
             first, last = int(idx[0]), int(idx[-1])
             if last - first + 1 == len(idx):
                 # contiguous run: the chunk's own bytes ARE the wire
@@ -269,6 +316,12 @@ class RouteState:
                 if data is None:  # can't happen on in-range indices
                     continue
             sends.append((*target, data, owner, len(idx)))
+        if ring is not None:
+            # still phase 1 (synchronous): the ring write copies the wire
+            # bytes straight out of the pooled chunk into shared memory —
+            # pre-encoded chunks + per-peer index lists, no per-frame
+            # message objects, no re-serialization (ISSUE 6)
+            broker.shard_runtime.handoff_chunk(buf, offs, lens, ring)
         # Phase 2 — sends (may await). Connections are looked up by
         # stable identity here, like the scalar flush: a peer that left
         # mid-batch drops its frames; failure ⇒ removal.

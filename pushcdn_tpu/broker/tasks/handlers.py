@@ -75,12 +75,16 @@ class EgressBatch:
     into the batch, so span emission can tell a routed message from a
     dropped one (unknown recipient, no interest)."""
 
-    __slots__ = ("broker", "users", "brokers", "appended", "_traces")
+    __slots__ = ("broker", "users", "brokers", "shards", "appended",
+                 "_traces")
 
     def __init__(self, broker: "Broker"):
         self.broker = broker
         self.users: dict = {}
         self.brokers: dict = {}
+        # sharded data plane: {shard -> {(kind, ident) -> [clones]}} —
+        # flushed as ONE handoff-ring record per shard (ISSUE 6)
+        self.shards: dict = {}
         self.appended = 0
         self._traces: Optional[list] = None
 
@@ -105,6 +109,18 @@ class EgressBatch:
         lst.append(raw.clone())
         self.appended += 1
 
+    def to_shard(self, shard: int, kind: int, ident, raw: Bytes) -> None:
+        """Queue a fan-out clone for a peer living on a sibling shard
+        (``kind`` is shardring.KIND_USER/KIND_BROKER)."""
+        targets = self.shards.get(shard)
+        if targets is None:
+            targets = self.shards[shard] = {}
+        lst = targets.get((kind, ident))
+        if lst is None:
+            lst = targets[(kind, ident)] = []
+        lst.append(raw.clone())
+        self.appended += 1
+
     def release_all(self) -> None:
         for frames in self.users.values():
             for f in frames:
@@ -114,6 +130,39 @@ class EgressBatch:
             for f in frames:
                 f.release()
         self.brokers.clear()
+        for targets in self.shards.values():
+            for frames in targets.values():
+                for f in frames:
+                    f.release()
+        self.shards.clear()
+
+    def _flush_shards(self) -> None:
+        """Hand each sibling shard its batch as one ring record: every
+        distinct frame's bytes written once, each peer carrying its
+        frame-index list (no re-serialization at the boundary). Synchronous
+        — ring-full degrades to the runtime's counted relay fallback."""
+        runtime = self.broker.shard_runtime
+        for shard, targets in self.shards.items():
+            frames: list = []
+            index_of: dict = {}
+            peers = []
+            for (kind, ident), clones in targets.items():
+                idx = []
+                for c in clones:
+                    key = id(c.data)
+                    i = index_of.get(key)
+                    if i is None:
+                        i = index_of[key] = len(frames)
+                        frames.append(c.data)
+                    idx.append(i)
+                peers.append((kind,
+                              ident if isinstance(ident, bytes)
+                              else ident.encode(), idx))
+            runtime.handoff(shard, frames, peers)
+            for clones in targets.values():
+                for c in clones:
+                    c.release()
+        self.shards.clear()
 
     @staticmethod
     async def _send_batch(conn, frames: list) -> None:
@@ -140,6 +189,12 @@ class EgressBatch:
         broker = self.broker
         traces, self._traces = self._traces, None
         try:
+            if self.shards:
+                # cross-shard handoff first: synchronous ring writes, so a
+                # backpressured local peer below can't delay the sibling
+                # (per-peer targets are disjoint — order across them is
+                # not observable)
+                self._flush_shards()
             # brokers first (reference fan-out order, handler.rs:240-272)
             while self.brokers:
                 ident, frames = self.brokers.popitem()
@@ -227,10 +282,35 @@ def _emit_scalar_trace(message, egress: EgressBatch, before: int) -> None:
 def route_direct(broker: "Broker", recipient: bytes, raw: Bytes,
                  to_user_only: bool, egress: EgressBatch) -> None:
     """One-hop direct routing decision (broker/handler.rs:197-237)."""
-    owner = broker.connections.get_broker_identifier_of_user(recipient)
+    conns = broker.connections
+    if conns.num_shards > 1:
+        # sharded data plane: "our user" spans every worker shard of this
+        # identity. A sibling's user rides the handoff ring (allowed even
+        # for broker-origin frames — the sibling IS this broker); a mesh
+        # owner reachable only via shard 0's links rides the ring too.
+        from pushcdn_tpu.broker import shardring
+        if recipient in conns.users:
+            egress.to_user(recipient, raw)
+            return
+        shard = conns.remote_user_shard.get(recipient)
+        if shard is not None:
+            egress.to_shard(shard, shardring.KIND_USER, recipient, raw)
+            return
+        owner = conns.get_broker_identifier_of_user(recipient)
+        if owner is None or owner == conns.identity or to_user_only:
+            return  # unknown/stale user, or one-hop rule: drop
+        if owner in conns.brokers:
+            egress.to_broker(owner, raw)
+        else:
+            link_shard = conns.remote_broker_shard.get(owner)
+            if link_shard is not None:
+                egress.to_shard(link_shard, shardring.KIND_BROKER, owner,
+                                raw)
+        return
+    owner = conns.get_broker_identifier_of_user(recipient)
     if owner is None:
         return  # unknown user: drop
-    if owner == broker.connections.identity:
+    if owner == conns.identity:
         egress.to_user(recipient, raw)
     elif not to_user_only:
         # forward one hop to the owning broker; the remote end delivers
@@ -268,6 +348,34 @@ def route_broadcast(broker: "Broker", topics: Sequence[int], raw: Bytes,
                 list(topics), to_users_only))
             interest_cache[key] = hit
         users, brokers = hit[1]
+    conns = broker.connections
+    if conns.num_shards > 1:
+        # sharded data plane: the interest tables span the whole box, so
+        # a hit may live on a sibling shard (user) or be reachable only
+        # through shard 0's mesh links (broker) — ride the handoff ring
+        from pushcdn_tpu.broker import shardring
+        local_users = conns.users
+        local_brokers = conns.brokers
+        for ident in brokers:
+            if ident in exclude_brokers:
+                continue
+            if ident in local_brokers:
+                egress.to_broker(ident, raw)
+            else:
+                link_shard = conns.remote_broker_shard.get(ident)
+                if link_shard is not None:
+                    egress.to_shard(link_shard, shardring.KIND_BROKER,
+                                    ident, raw)
+        if not users_via_device:
+            for user in users:
+                if user in local_users:
+                    egress.to_user(user, raw)
+                else:
+                    shard = conns.remote_user_shard.get(user)
+                    if shard is not None:
+                        egress.to_shard(shard, shardring.KIND_USER, user,
+                                        raw)
+        return
     for ident in brokers:
         if ident not in exclude_brokers:
             egress.to_broker(ident, raw)

@@ -44,8 +44,12 @@ async def heartbeat_once(broker: "Broker") -> None:
     # the readiness plane so /readyz's cached-TTL check stays fresh for
     # free in steady state (ISSUE 5)
     try:
+        # num_users_global: on a sharded broker, shard 0 heartbeats for the
+        # whole box (the marshal's load balancing must see every worker's
+        # users, not just shard 0's)
         await broker.discovery.perform_heartbeat(
-            broker.connections.num_users, broker.config.membership_ttl_s)
+            broker.connections.num_users_global,
+            broker.config.membership_ttl_s)
     except Exception as exc:
         broker.note_discovery_probe(False, f"heartbeat failed: {exc!r}")
         raise

@@ -294,6 +294,31 @@ ROUTE_TABLE_REBUILDS = Counter(
     "cdn_route_table_rebuilds",
     "Cut-through snapshot rebuilds (routing state changed)")
 
+# Sharded data plane (broker/sharding.py): cross-shard handoff accounting.
+# path=ring is the zero-copy shared-memory fast path; path=fallback is the
+# counted drop-to-control-plane relay a full ring degrades to (the drain
+# never blocks on a slow sibling).
+SHARD_HANDOFF_RECORDS = Counter(
+    "cdn_shard_handoff_records",
+    "Cross-shard handoff records by path (ring = shared-memory, "
+    "fallback = control-plane relay after ring-full)",
+    labels=("path",))
+SHARD_HANDOFF_RING = SHARD_HANDOFF_RECORDS.labels(path="ring")
+SHARD_HANDOFF_FALLBACK = SHARD_HANDOFF_RECORDS.labels(path="fallback")
+SHARD_HANDOFF_SHED = SHARD_HANDOFF_RECORDS.labels(path="shed")
+SHARD_HANDOFF_FRAMES = Counter(
+    "cdn_shard_handoff_frames",
+    "Frames carried by cross-shard handoff records", labels=("path",))
+SHARD_HANDOFF_FRAMES_RING = SHARD_HANDOFF_FRAMES.labels(path="ring")
+SHARD_HANDOFF_FRAMES_FALLBACK = SHARD_HANDOFF_FRAMES.labels(path="fallback")
+SHARD_HANDOFF_FRAMES_SHED = SHARD_HANDOFF_FRAMES.labels(path="shed")
+SHARD_RING_TORN = Counter(
+    "cdn_shard_ring_torn_reads",
+    "Cross-shard ring drains that backed off on a torn/uncommitted record")
+SHARD_DELTAS_APPLIED = Counter(
+    "cdn_shard_deltas_applied",
+    "Control-plane interest deltas applied from sibling shards")
+
 # Egress fan-out accounting by peer type (EgressBatch.flush / the
 # cut-through _send_plan increment batch-wise).
 EGRESS_FRAMES = Counter(

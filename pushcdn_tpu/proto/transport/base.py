@@ -1348,4 +1348,8 @@ class Protocol(abc.ABC):
 
     @classmethod
     @abc.abstractmethod
-    async def bind(cls, endpoint: str, certificate=None) -> Listener: ...
+    async def bind(cls, endpoint: str, certificate=None,
+                   reuse_port: bool = False) -> Listener:
+        """``reuse_port=True`` requests SO_REUSEPORT so N worker shards
+        can bind the same endpoint and let the kernel spread accepts
+        (transports without a kernel socket — Memory — reject it)."""

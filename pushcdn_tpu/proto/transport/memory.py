@@ -218,7 +218,11 @@ class Memory(Protocol):
         return Connection(ours, limiter, label=f"memory:{endpoint}")
 
     @classmethod
-    async def bind(cls, endpoint: str, certificate=None) -> Listener:
+    async def bind(cls, endpoint: str, certificate=None,
+                   reuse_port: bool = False) -> Listener:
+        if reuse_port:
+            bail(ErrorKind.CONNECTION,
+                 "memory transport has no kernel socket to SO_REUSEPORT")
         if endpoint in _REGISTRY.listeners:
             bail(ErrorKind.CONNECTION, f"memory endpoint {endpoint!r} already bound")
         listener = MemoryListener(endpoint)

@@ -1243,7 +1243,13 @@ class Quic(Protocol):
 
     @classmethod
     async def bind(cls, endpoint: str,
-                   certificate: Optional[Certificate] = None) -> Listener:
+                   certificate: Optional[Certificate] = None,
+                   reuse_port: bool = False) -> Listener:
+        if reuse_port:
+            bail(ErrorKind.CONNECTION,
+                 "quic sharding via SO_REUSEPORT is not supported yet "
+                 "(connection IDs would need kernel steering); run "
+                 "--shards with a TCP user transport")
         host, port = parse_endpoint(endpoint)
         if certificate is None:
             certificate = local_certificate()

@@ -85,12 +85,15 @@ class Tcp(Protocol):
                           label=f"tcp:{endpoint}")
 
     @classmethod
-    async def bind(cls, endpoint: str, certificate=None) -> Listener:
+    async def bind(cls, endpoint: str, certificate=None,
+                   reuse_port: bool = False) -> Listener:
         host, port = parse_endpoint(endpoint)
         listener = TcpListener()
         try:
-            server = await asyncio.start_server(listener._on_client, host, port)
-        except OSError as exc:
+            server = await asyncio.start_server(
+                listener._on_client, host, port,
+                **({"reuse_port": True} if reuse_port else {}))
+        except (OSError, ValueError) as exc:
             bail(ErrorKind.CONNECTION, f"tcp bind to {endpoint} failed", exc)
         listener._server = server
         listener.bound_port = server.sockets[0].getsockname()[1]

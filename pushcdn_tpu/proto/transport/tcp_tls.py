@@ -92,15 +92,18 @@ class TcpTls(Protocol):
 
     @classmethod
     async def bind(cls, endpoint: str,
-                   certificate: "Certificate | None" = None) -> Listener:
+                   certificate: "Certificate | None" = None,
+                   reuse_port: bool = False) -> Listener:
         host, port = parse_endpoint(endpoint)
         if certificate is None:
             certificate = local_certificate()
         listener = TcpTlsListener()
         try:
             server = await asyncio.start_server(
-                listener._on_client, host, port, ssl=certificate.server_context())
-        except (OSError, ssl.SSLError) as exc:
+                listener._on_client, host, port,
+                ssl=certificate.server_context(),
+                **({"reuse_port": True} if reuse_port else {}))
+        except (OSError, ssl.SSLError, ValueError) as exc:
             bail(ErrorKind.CONNECTION, f"tls bind to {endpoint} failed", exc)
         listener._server = server
         listener.bound_port = server.sockets[0].getsockname()[1]

@@ -90,7 +90,13 @@ def check_readiness_before_bind(port: int) -> bool:
         return False
     try:
         doc = json.loads(body)
-        listeners_ok = doc["checks"]["listeners"]["ok"]
+        # sharded brokers aggregate worker checks as "shardN:listeners";
+        # an unreachable worker ("shardN:reachable" false) is the same
+        # not-ready-before-bind state observed earlier in startup
+        relevant = [c["ok"] for name, c in doc["checks"].items()
+                    if name.rsplit(":", 1)[-1] in ("listeners",
+                                                   "reachable")]
+        listeners_ok = bool(relevant) and all(relevant)
     except (ValueError, KeyError):
         print(f"[cluster] FAIL: pre-bind /readyz body unparseable: {body[:200]}")
         return False
@@ -151,9 +157,9 @@ def fetch_topology(port: int):
         return None
 
 
-def check_topology(broker_ports: dict) -> bool:
+def check_topology(broker_ports: dict, expected_users: int = 1) -> bool:
     """Each broker's /debug/topology must reflect the real mesh: the other
-    broker as its one peer, and the echo client as a user exactly once."""
+    broker as its one peer, and every client as a user exactly once."""
     topos = {}
     for name, port in broker_ports.items():
         deadline = time.time() + 10.0
@@ -181,13 +187,42 @@ def check_topology(broker_ports: dict) -> bool:
                   f"expected={expected}")
             return False
     total_users = sum(t["num_users"] for t in topos.values())
-    if total_users != 1:
-        print(f"[cluster] FAIL: expected exactly 1 connected user across "
-              f"the mesh, saw {total_users}")
+    if total_users != expected_users:
+        print(f"[cluster] FAIL: expected exactly {expected_users} connected "
+              f"user(s) across the mesh, saw {total_users}")
         return False
-    print("[cluster] topology OK (mesh verified: each broker sees the "
-          "other; 1 user connected)")
+    print(f"[cluster] topology OK (mesh verified: each broker sees the "
+          f"other; {total_users} user(s) connected)")
     return True
+
+
+def check_shard_plane(port: int, num_shards: int) -> bool:
+    """Sharded broker0: the merged topology must show users spread across
+    2+ worker shards and the handoff rings having carried records — the
+    proof the cross-shard zero-copy hop ran for real."""
+    deadline = time.time() + 15.0
+    last = None
+    while time.time() < deadline:
+        topo = fetch_topology(port)
+        if topo is not None:
+            last = topo
+            shards = topo.get("shards") or {}
+            user_shards = {u.get("shard") for u in topo.get("users", [])}
+            ring_records = 0
+            for stats in shards.values():
+                for r in ((stats or {}).get("rings") or {}).get(
+                        "in", {}).values():
+                    ring_records += r.get("records", 0)
+            if len(shards) == num_shards and len(user_shards) >= 2 \
+                    and ring_records > 0:
+                print(f"[cluster] shard plane OK: {len(shards)} workers, "
+                      f"users on shards {sorted(user_shards)}, "
+                      f"{ring_records} cross-shard ring records drained")
+                return True
+        time.sleep(0.3)
+    print(f"[cluster] FAIL: shard plane never showed cross-shard traffic "
+          f"(last topology: {json.dumps(last)[:600]})")
+    return False
 
 
 def render_merged_topology(broker_ports: dict) -> None:
@@ -327,6 +362,11 @@ def main() -> int:
                     help="write per-process lifecycle-trace span JSONL "
                          "under DIR, verify one complete span chain, and "
                          "run scripts/trace_report.py --strict over it")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="run broker0 with a sharded data plane (N worker "
+                         "processes); spawns a second client so directs "
+                         "cross the shard boundary, and asserts the "
+                         "handoff rings carried them")
     args = ap.parse_args()
 
     if args.trace_log:
@@ -341,26 +381,47 @@ def main() -> int:
     db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-cluster-"), "cdn.sqlite")
     bp = args.base_port
     if bp == 0:
-        # bind one free port and take the following ~100 as the range —
+        # bind one free port and take the following ~200 as the range —
         # racy in principle, but ephemeral allocations are sparse and the
-        # components fail loudly on a collision
+        # components fail loudly on a collision. The range must ALSO cover
+        # each broker's per-shard worker metrics endpoints (parent port +
+        # 1 + shard), so a clamped pick near the top of the port space is
+        # re-drawn instead of silently colliding (ISSUE 6 satellite).
         import socket
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            bp = min(s.getsockname()[1], 65000 - 200)
-    metrics_ports = {"broker0": bp + 100, "broker1": bp + 101,
-                     "marshal": bp + 102, "client": bp + 103}
-    broker_ports = {"broker0": bp + 100, "broker1": bp + 101}
+        while True:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                candidate = s.getsockname()[1]
+            if candidate <= 65000 - 200:
+                bp = candidate
+                break
+    # metrics layout: each broker parent gets a 20-port block so its
+    # per-shard worker endpoints (parent + 1 + shard) never collide with
+    # the next component even when both brokers spawn workers
+    metrics_ports = {"broker0": bp + 100, "broker1": bp + 120,
+                     "marshal": bp + 140, "client": bp + 141}
+    broker_ports = {"broker0": bp + 100, "broker1": bp + 120}
+    if args.shards > 1:
+        metrics_ports["client2"] = bp + 142
     procs: list[tuple[str, subprocess.Popen]] = []
     ok = True
     try:
         for i in range(2):
             env = {**trace_env(f"broker{i}"),
                    "PUSHCDN_DRAIN_GRACE_S": str(DRAIN_GRACE_S)}
+            shard_flags = []
             if i == 0:
                 # hold broker0's listener binds open so the not-ready-
                 # before-bind state is externally observable
                 env["PUSHCDN_BIND_DELAY_S"] = "1.5"
+                if args.shards > 1:
+                    shard_flags = ["--shards", str(args.shards)]
+                    # deterministic round-robin accept distribution: the
+                    # two clients land on DIFFERENT workers, so their
+                    # directs must cross the shard boundary (this also
+                    # CI-covers the fd-handoff accept path; SO_REUSEPORT
+                    # is covered by benches/route_bench.py --shards)
+                    env["PUSHCDN_SHARD_ACCEPT"] = "handoff"
             procs.append((f"broker{i}", spawn(
                 "broker",
                 "--discovery-endpoint", db,
@@ -371,6 +432,7 @@ def main() -> int:
                 "--user-transport", "tcp",   # plain tcp for the local demo
                 "--metrics-bind-endpoint",
                 f"127.0.0.1:{metrics_ports[f'broker{i}']}",
+                *shard_flags,
                 *(["--device-plane"] if args.device_plane else []),
                 env_extra=env)))
             if i == 0:
@@ -392,12 +454,24 @@ def main() -> int:
             "--interval", "1.0", "--key-seed", "7",
             "--metrics-bind-endpoint", f"127.0.0.1:{metrics_ports['client']}",
             env_extra=trace_env("client"))))
+        if args.shards > 1:
+            time.sleep(1.0)  # client 1 accepts first -> worker 0
+            procs.append(("client2", spawn(
+                "client",
+                "--marshal-endpoint", f"127.0.0.1:{bp + 50}",
+                "--transport", "tcp",
+                "--interval", "1.0", "--key-seed", "8",
+                "--direct-to-seed", "7",  # cross-shard directs to client 1
+                "--metrics-bind-endpoint",
+                f"127.0.0.1:{metrics_ports['client2']}",
+                env_extra=trace_env("client2"))))
 
         deadline = time.time() + args.duration
         echoed = False
-        client = procs[-1][1]
+        client = next(p for n, p in procs if n == "client")
+        others = [(n, p) for n, p in procs if n != "client"]
         while time.time() < deadline:
-            for name, proc in procs[:-1]:
+            for name, proc in others:
                 if proc.poll() is not None:
                     print(f"[cluster] FAIL: {name} died early")
                     print(proc.stdout.read()[-2000:])
@@ -414,7 +488,14 @@ def main() -> int:
 
         # ---- observability plane checks (ISSUE 5) ----
         ok = check_health(metrics_ports) and ok
-        ok = check_topology(broker_ports) and ok
+        ok = check_topology(broker_ports,
+                            expected_users=2 if args.shards > 1 else 1) \
+            and ok
+        if args.shards > 1:
+            # ---- sharded data plane (ISSUE 6): users on 2+ workers and
+            # cross-shard directs carried by the handoff rings
+            ok = check_shard_plane(metrics_ports["broker0"],
+                                   args.shards) and ok
         if args.topology:
             render_merged_topology(broker_ports)
         if args.trace_log:

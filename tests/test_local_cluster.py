@@ -68,3 +68,40 @@ def test_local_cluster_end_to_end_echo_and_clean_shutdown(tmp_path):
     # a component that survives SIGINT is killed and would have left
     # "FAIL" markers; assert none
     assert "FAIL" not in out, out[-6000:]
+
+
+@pytest.mark.skipif(os.environ.get("PUSHCDN_SKIP_CLUSTER_TEST") == "1",
+                    reason="PUSHCDN_SKIP_CLUSTER_TEST=1")
+@pytest.mark.skipif(not _loopback_available(),
+                    reason="no loopback TCP in this sandbox")
+def test_local_cluster_sharded_broker(tmp_path):
+    """ISSUE 6: the same cluster with broker0 sharded across 2 worker OS
+    processes (fd-handoff accept distribution, so the two clients land on
+    different workers deterministically). Asserts the aggregated
+    observability plane answers for the whole shard group, the handoff
+    rings carried real cross-shard directs, and trace_report --strict
+    sees complete span chains with zero orphans THROUGH the cross-shard
+    hop."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    trace_dir = str(tmp_path / "spans")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--duration", "25", "--base-port", "0",
+         "--shards", "2", "--trace-log", trace_dir],
+        env=env, capture_output=True, text=True, timeout=240)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"sharded local_cluster failed:\n{out[-6000:]}"
+    assert "OK: end-to-end echo through real processes" in out, out[-6000:]
+    # the aggregated parent endpoint serves health for 5 processes
+    # (2 brokers + marshal + 2 clients), with broker0 fronting its workers
+    assert "health OK (5 processes" in out, out[-6000:]
+    assert "topology OK" in out, out[-6000:]
+    # users landed on BOTH workers and the rings carried their directs
+    assert "shard plane OK: 2 workers" in out, out[-6000:]
+    # complete lifecycle chains (client2 -> worker1 -> ring -> worker0 ->
+    # client1 among them), zero orphaned spans under --strict
+    assert "trace chain complete" in out, out[-6000:]
+    assert "trace report OK" in out, out[-6000:]
+    assert "0 orphaned spans" in out, out[-6000:]
+    assert "drain readiness flip observed" in out, out[-6000:]
+    assert "FAIL" not in out, out[-6000:]

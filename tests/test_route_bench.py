@@ -78,11 +78,26 @@ def test_route_bench_smoke(tmp_path):
         assert "route/e2e_latency" in by_bench, rows
         e2e_tiers = {r["tier"] for r in by_bench["route/e2e_latency"]}
         assert {"p50", "p99"} <= e2e_tiers, rows
+    # ISSUE 6: the multi-process shard-scaling tier (real broker binary
+    # with --shards N over TCP). Flat ratios are legal on a 1-core CI
+    # host — asserted here: the rows exist, parse, and carry the honest
+    # cpu-count label; the scaling figure itself is a BENCH number.
+    assert "route/shard_forward" in by_bench, rows
+    shard_rows = {r["shards"]: r for r in by_bench["route/shard_forward"]
+                  if r["unit"] == "msgs/s"}
+    if not any(r["unit"] == "skipped"
+               for r in by_bench["route/shard_forward"]):
+        assert {1, 2} <= set(shard_rows), rows
+        for r in shard_rows.values():
+            assert r["value"] > 0 and r["cpus"] >= 1 \
+                and r["backend"] == "cpu", r
+        assert any(r.get("tier") == "shards2-vs-1"
+                   for r in by_bench["route/shard_forward"]), rows
     # ISSUE 5 satellite: the machine-readable bench artifact was written
-    # with the headline block (the BENCH_r09.json producer)
+    # with the headline block (the BENCH_r10.json producer)
     with open(out_json) as fh:
         doc = json.load(fh)
-    assert doc["round"] == 9
+    assert doc["round"] == 10
     assert "route_bench" in doc
     assert isinstance(doc["route_bench"]["rows"], list)
     assert "headline" in doc["route_bench"]

@@ -260,6 +260,144 @@ async def test_traced_frame_mid_chunk_equivalence():
         assert len(got) == 13
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 6: 1-shard vs N-shard equivalence — the cross-shard handoff must
+# be semantically invisible (identical per-peer delivery SEQUENCES per
+# connection, identical disconnect decisions, balanced pool permits on
+# EVERY shard's byte pool)
+# ---------------------------------------------------------------------------
+
+async def _run_sharded_mix(impl: str, frames, as_user: bool,
+                           num_shards: int = 2):
+    """The sharded twin of ``_run_mix``: same topology, users spread
+    round-robin across worker shards (sender user-0 / peer-0 on shard 0),
+    every frame batch sent as one chunk."""
+    from pushcdn_tpu.testing.shardharness import run_sharded
+    prev_impl = cutthrough.ROUTE_IMPL
+    prev_win = Memory.set_duplex_window(512 * 1024)
+    cutthrough.ROUTE_IMPL = impl
+    try:
+        run = await run_sharded(
+            [(i % num_shards, topics)
+             for i, topics in enumerate(USER_TOPICS)],
+            num_shards=num_shards, connected_brokers=BROKER_DEFS)
+        try:
+            sender = (run.user(0) if as_user else run.peer(0)).remote
+            try:
+                await sender.send_raw_many(list(frames), flush=True)
+            except Exception:
+                pass  # disconnected mid-send: a legal outcome
+            await asyncio.sleep(0.15)
+            await run.settle(40)
+
+            deliveries = {}
+            for i in range(1, len(USER_TOPICS)):
+                deliveries[f"user-{i}"] = await _drain_all(
+                    run.user(i).remote)
+            for j in range(len(BROKER_DEFS)):
+                if not (not as_user and j == 0):
+                    deliveries[f"peer-{j}"] = await _drain_all(
+                        run.peer(j).remote)
+            if as_user:
+                deliveries["user-0"] = await _drain_all(run.user(0).remote)
+
+            shard0 = run.brokers[0]
+            if as_user:
+                alive = shard0.connections.has_user(b"user-0")
+            else:
+                alive = shard0.connections.has_broker(
+                    run.peer(0).identifier)
+
+            balanced = True
+            for broker in run.brokers:
+                pool = broker.limiter.pool
+                if pool is None:
+                    continue
+                for _ in range(20):
+                    gc.collect()
+                    if pool.available == pool.capacity:
+                        break
+                    await asyncio.sleep(0.02)
+                balanced = balanced and pool.available == pool.capacity
+            return deliveries, alive, balanced
+        finally:
+            await run.shutdown()
+    finally:
+        cutthrough.ROUTE_IMPL = prev_impl
+        Memory.set_duplex_window(prev_win)
+
+
+@pytest.mark.parametrize("seed", range(4))
+async def test_sharded_user_mix_equivalence(seed):
+    """Seeded user-origin mixes through a 2-shard group vs the 1-shard
+    broker: identical per-peer delivery sequences, disconnects, permit
+    balance — with the sender's fan-out crossing the handoff rings for
+    the odd-shard receivers."""
+    rng = np.random.default_rng(5000 + seed)
+    frames = _gen_frames(rng, 50, as_user=True)
+    d_shard, alive_s, bal_s = await _run_sharded_mix("native", frames,
+                                                     as_user=True)
+    d_single, alive_1, bal_1 = await _run_mix("native", frames,
+                                              as_user=True, chunked=True)
+    assert alive_s == alive_1, f"seed {seed}: disconnect decisions differ"
+    assert d_shard == d_single, f"seed {seed}: delivery sequences differ"
+    assert bal_s and bal_1, f"seed {seed}: pool permits leaked"
+
+
+@pytest.mark.parametrize("seed", range(2))
+async def test_sharded_broker_mix_equivalence(seed):
+    """Broker-origin (mesh) mixes arrive on shard 0 and must reach
+    sibling-shard users over the rings with local-users-only semantics
+    intact (no loop, no mesh re-forward)."""
+    rng = np.random.default_rng(6000 + seed)
+    frames = _gen_frames(rng, 50, as_user=False)
+    d_shard, alive_s, bal_s = await _run_sharded_mix("native", frames,
+                                                     as_user=False)
+    d_single, alive_1, bal_1 = await _run_mix("native", frames,
+                                              as_user=False, chunked=True)
+    assert alive_s == alive_1, f"seed {seed}: link-drop decisions differ"
+    assert d_shard == d_single, f"seed {seed}: delivery sequences differ"
+    assert bal_s and bal_1, f"seed {seed}: pool permits leaked"
+
+
+async def test_sharded_scalar_impl_equivalence():
+    """The scalar loops drive the same shard-egress seam (EgressBatch
+    ``to_shard``): a python-impl sharded run must match the 1-shard run
+    too — the handoff isn't a cut-through-only feature."""
+    rng = np.random.default_rng(7000)
+    frames = _gen_frames(rng, 40, as_user=True)
+    d_shard, alive_s, bal_s = await _run_sharded_mix("python", frames,
+                                                     as_user=True)
+    d_single, alive_1, bal_1 = await _run_mix("python", frames,
+                                              as_user=True, chunked=True)
+    assert alive_s == alive_1
+    assert d_shard == d_single
+    assert bal_s and bal_1
+
+
+async def test_sharded_subscribe_propagates_to_sibling():
+    """A Subscribe on one shard must reach sibling snapshots (versioned
+    delta via the bus) before later traffic routes: sender on shard 0
+    subscribes, a sibling-shard user broadcasts, sender receives."""
+    from pushcdn_tpu.testing.shardharness import run_sharded
+    prev = Memory.set_duplex_window(512 * 1024)
+    try:
+        run = await run_sharded([(0, []), (1, [])], num_shards=2)
+        try:
+            await run.user(0).remote.send_raw(
+                serialize(Subscribe([1])), flush=True)
+            await run.settle(30)
+            await run.user(1).remote.send_raw(
+                serialize(Broadcast([1], b"cross-shard-pub")), flush=True)
+            await run.settle(40)
+            got = await _drain_all(run.user(0).remote)
+            assert got == [serialize(Broadcast((1,), b"cross-shard-pub"))]
+        finally:
+            await run.shutdown()
+    finally:
+        Memory.set_duplex_window(prev)
+
+
 async def test_depth1_singles_equivalence():
     """Flushed singles ride the depth-1 Bytes path through the cut-through
     drain; decisions must still match the scalar loops."""

@@ -1,0 +1,258 @@
+"""Property tests for the cross-shard shared-memory handoff ring
+(ISSUE 6 satellite): wraparound, torn-write detection, ring-full
+fallback accounting, lease-ordered slot reclamation, and the worker
+runtime's counted drop-to-relay degradation."""
+
+import asyncio
+import struct
+
+import numpy as np
+import pytest
+
+from pushcdn_tpu.broker import shardring as sr
+
+
+@pytest.fixture
+def ring():
+    name = sr.create_ring(16 * 1024)
+    w = sr.RingWriter(name, 16 * 1024)
+    r = sr.RingReader(name, 16 * 1024)
+    try:
+        yield w, r
+    finally:
+        w.close()
+        r.close()
+        sr.unlink_ring(name)
+
+
+def test_roundtrip_frames_and_peers(ring):
+    w, r = ring
+    assert w.try_push([b"alpha", b"bravo!"],
+                      [(sr.KIND_USER, b"user-1", [0, 1]),
+                       (sr.KIND_BROKER, b"pub:1/priv:1", [1])])
+    recs = r.drain()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec.peers == [(sr.KIND_USER, b"user-1", [0, 1]),
+                         (sr.KIND_BROKER, b"pub:1/priv:1", [1])]
+    # streams are u32-BE length-delimited wire bytes
+    assert bytes(rec.stream_for([0, 1])) == \
+        b"\x00\x00\x00\x05alpha\x00\x00\x00\x06bravo!"
+    assert bytes(rec.stream_for([1])) == b"\x00\x00\x00\x06bravo!"
+    # contiguous index run -> zero-copy view of the shm payload
+    assert isinstance(rec.stream_for([0, 1]), memoryview)
+    rec.release()
+    assert r.tail == r.head
+
+
+def test_prefixed_frames_copied_verbatim(ring):
+    w, r = ring
+    wire = b"\x00\x00\x00\x03abc"
+    assert w.try_push([wire], [(sr.KIND_USER, b"u", [0])], prefixed=True)
+    rec = r.drain()[0]
+    assert bytes(rec.stream_for([0])) == wire
+    rec.release()
+
+
+def test_non_contiguous_index_gathers(ring):
+    w, r = ring
+    assert w.try_push([b"a", b"b", b"c"], [(sr.KIND_USER, b"u", [0, 2])])
+    rec = r.drain()[0]
+    data = rec.stream_for([0, 2])
+    assert not isinstance(data, memoryview)
+    assert bytes(data) == b"\x00\x00\x00\x01a\x00\x00\x00\x01c"
+    rec.release()
+
+
+def test_wraparound_many_records(ring):
+    """Thousands of pushes through a small ring: every record survives the
+    wrap (PAD records at the boundary), sequences stay intact, and the
+    ring fully reclaims."""
+    w, r = ring
+    rng = np.random.default_rng(11)
+    sent, got = [], []
+    pending = 0
+    for i in range(3000):
+        payload = bytes(rng.integers(0, 256, int(rng.integers(1, 900)),
+                                     dtype=np.uint8))
+        while not w.try_push([payload], [(sr.KIND_USER, b"u", [0])]):
+            recs = r.drain(8)
+            assert recs, "ring full but nothing drainable"
+            for rec in recs:
+                got.append(bytes(rec.stream_for([0]))[4:])
+                rec.release()
+        sent.append(payload)
+        pending += 1
+        if pending % 7 == 0:
+            for rec in r.drain(3):
+                got.append(bytes(rec.stream_for([0]))[4:])
+                rec.release()
+    for rec in r.drain(100000):
+        got.append(bytes(rec.stream_for([0]))[4:])
+        rec.release()
+    assert got == sent
+    assert r.tail == r.head
+    assert r.torn_reads == 0
+
+
+def test_ring_full_counts_drops(ring):
+    w, r = ring
+    big = b"z" * 5000
+    pushed = 0
+    while w.try_push([big], [(sr.KIND_USER, b"u", [0])]):
+        pushed += 1
+    assert pushed >= 2
+    assert w.dropped == 1
+    assert not w.try_push([big], [(sr.KIND_USER, b"u", [0])])
+    assert w.dropped == 2
+    # draining frees the space again
+    for rec in r.drain(100):
+        rec.release()
+    assert w.try_push([big], [(sr.KIND_USER, b"u", [0])])
+
+
+def test_torn_write_detected_and_recovered(ring):
+    """A record whose commit word hasn't landed (simulated mid-write
+    state) stops the drain and is counted; once the commit appears the
+    record drains normally."""
+    w, r = ring
+    assert w.try_push([b"first"], [(sr.KIND_USER, b"u", [0])])
+    pos = sr.HEADER_BYTES + (r._cursor % r.capacity)
+    saved = bytes(r.buf[pos + 4:pos + 8])
+    r.buf[pos + 4:pos + 8] = b"\x00\x00\x00\x00"  # wipe the commit word
+    assert r.drain() == []
+    assert r.torn_reads == 1
+    assert r.drain() == []
+    assert r.torn_reads == 2
+    r.buf[pos + 4:pos + 8] = saved  # "writer finishes" the record
+    recs = r.drain()
+    assert len(recs) == 1
+    assert bytes(recs[0].stream_for([0])) == b"\x00\x00\x00\x05first"
+    recs[0].release()
+
+
+def test_corrupt_length_detected(ring):
+    w, r = ring
+    assert w.try_push([b"x"], [(sr.KIND_USER, b"u", [0])])
+    pos = sr.HEADER_BYTES + (r._cursor % r.capacity)
+    r.buf[pos:pos + 4] = struct.pack("<I", r.capacity + 8)  # absurd length
+    assert r.drain() == []
+    assert r.torn_reads == 1
+
+
+def test_lease_pins_slot_until_last_holder_drops(ring):
+    """Slot reclamation is in-order and waits for every pending flush's
+    lease — the PreEncoded.owner contract."""
+    w, r = ring
+    assert w.try_push([b"one"], [(sr.KIND_USER, b"u", [0])])
+    assert w.try_push([b"two"], [(sr.KIND_USER, b"u", [0])])
+    rec1, rec2 = r.drain()
+    lease1 = rec1.lease()
+    rec1.release()
+    rec2.release()  # rec2 done FIRST: reclamation must still wait on rec1
+    assert r.tail == 0
+    del lease1
+    assert r.tail == r.head
+
+
+def test_notify_socket_signals_every_push():
+    """EVERY push sends a wakeup byte: an empty->nonempty-only scheme
+    races the consumer's lease-deferred tail (a push while the oldest
+    slot is still pinned by a pending flush would never re-notify, and
+    the consumer would sleep forever on a nonempty ring)."""
+    rx, tx = sr.notify_pair()
+    name = sr.create_ring(8192)
+    try:
+        w = sr.RingWriter(name, 8192, notify_sock=tx)
+        r = sr.RingReader(name, 8192)
+        assert w.try_push([b"a"], [(sr.KIND_USER, b"u", [0])])
+        assert rx.recv(16) == b"\x01"
+        assert w.try_push([b"b"], [(sr.KIND_USER, b"u", [0])])
+        assert rx.recv(16) == b"\x01"
+        with pytest.raises(BlockingIOError):
+            rx.recv(16)
+        for rec in r.drain():
+            rec.release()
+        assert w.try_push([b"c"], [(sr.KIND_USER, b"u", [0])])
+        assert rx.recv(16) == b"\x01"
+        w.close()
+        r.close()
+    finally:
+        rx.close()
+        tx.close()
+        sr.unlink_ring(name)
+
+
+# ---------------------------------------------------------------------------
+# runtime-level: ring-full falls back to the counted control-plane relay
+# ---------------------------------------------------------------------------
+
+async def test_runtime_ring_full_falls_back_to_relay():
+    from pushcdn_tpu.broker import sharding
+
+    class _Conns:
+        num_shards = 2
+        shard_id = 0
+        shard_notifier = None
+
+    class _Broker:
+        connections = _Conns()
+
+    name = sr.create_ring(4096)
+    rx, tx = sr.notify_pair()
+    try:
+        w = sr.RingWriter(name, 4096, notify_sock=tx)
+        rt = sharding.ShardRuntime(_Broker(), 0, 2, {1: w}, {}, None)
+        relayed = []
+
+        class _Bus:
+            def publish(self, origin, event):
+                relayed.append((origin, event))
+        rt.set_bus(_Bus())
+        big = b"q" * 1200
+        # fill the ring, then the next handoff must relay (counted), and
+        # subsequent handoffs stay on the relay path until drained+acked
+        n_ring = 0
+        while True:
+            before = rt.relay_fallbacks
+            rt.handoff(1, [big], [(sr.KIND_USER, b"u", [0])])
+            if rt.relay_fallbacks > before:
+                break
+            n_ring += 1
+        assert n_ring >= 1
+        assert w.dropped == 1
+        assert len(relayed) == 1
+        origin, event = relayed[0]
+        assert event[0] == "relay" and event[1] == 1
+        kind, ident, stream, n = event[2][0]
+        assert (kind, ident, n) == (sr.KIND_USER, b"u", 1)
+        assert stream == len(big).to_bytes(4, "big") + big
+        # still degraded: next handoff relays too (order barrier holds
+        # until the consumer drains AND acks)
+        rt.handoff(1, [b"tail"], [(sr.KIND_USER, b"u", [0])])
+        assert len(relayed) == 2
+        # drain + ack -> ring usable again
+        r = sr.RingReader(name, 4096)
+        for rec in r.drain(1000):
+            rec.release()
+        rt.apply_event(1, ("relay_ack", 0, rt._relay_epoch[1]))
+        assert not rt._relay_unacked[1]  # ack released the byte budget
+        before = rt.relay_fallbacks
+        rt.handoff(1, [b"back"], [(sr.KIND_USER, b"u", [0])])
+        assert rt.relay_fallbacks == before  # rode the ring again
+        # doubly-degraded shedding: with the relay budget exhausted and
+        # the ring full, further handoffs are DROPPED with a counter —
+        # bounded degradation, never unbounded control-plane queues
+        while w.try_push([big], [(sr.KIND_USER, b"u", [0])]):
+            pass  # refill the ring
+        rt._RELAY_MAX_BYTES = 2000
+        rt.handoff(1, [big], [(sr.KIND_USER, b"u", [0])])  # relays (1204B)
+        shed_before = rt.relay_shed
+        rt.handoff(1, [big], [(sr.KIND_USER, b"u", [0])])  # over budget
+        assert rt.relay_shed == shed_before + 1
+        r.close()
+        w.close()
+    finally:
+        rx.close()
+        tx.close()
+        sr.unlink_ring(name)
