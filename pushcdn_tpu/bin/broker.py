@@ -142,7 +142,16 @@ async def run_supervisor(args: argparse.Namespace, shards: int) -> None:
     sup = sharding.ShardSupervisor(
         shards, args.metrics_bind_endpoint, worker_argv,
         acceptor_endpoint=acceptor)
-    await sup.start()
+    try:
+        await sup.start()
+    except BaseException:
+        # half-started (e.g. parent metrics bind EADDRINUSE after the
+        # workers spawned): kill whatever came up and unlink the rings —
+        # REUSEPORT workers would otherwise keep serving as orphans
+        sup.signal_workers()
+        await sup.reap(5.0)
+        await sup.stop()
+        raise
     drain = asyncio.Event()
     installed = install_drain_signals(drain, on_signal=sup.begin_drain)
     exit_task = asyncio.create_task(sup.wait_any_worker_exit(),

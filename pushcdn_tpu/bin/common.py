@@ -154,7 +154,8 @@ def keypair_from_seed(seed: Optional[int],
     return scheme_by_name(scheme).generate_keypair(seed=seed)
 
 
-def spawn_binary(name: str, *args: str, env_extra=None, capture=True):
+def spawn_binary(name: str, *args: str, env_extra=None, capture=True,
+                 log_path=None):
     """Launch ``pushcdn_tpu.bin.<name>`` as a child process with the repo
     prepended to PYTHONPATH (setdefault breaks under any preexisting
     PYTHONPATH, e.g. an accelerator site dir) — the one spawner the local
@@ -163,7 +164,9 @@ def spawn_binary(name: str, *args: str, env_extra=None, capture=True):
     ``capture=False`` sends the child's output to /dev/null instead of a
     pipe — REQUIRED for spawners that never drain the pipe: a chatty
     child (e.g. a ``--shards`` broker whose workers share the fd) blocks
-    forever once the 64 KiB pipe buffer fills."""
+    forever once the 64 KiB pipe buffer fills. ``log_path`` redirects
+    output to a file instead: the pipe-wedge fix that still preserves
+    crash output for postmortems (overrides ``capture``)."""
     import os
     import subprocess
     import sys
@@ -174,9 +177,13 @@ def spawn_binary(name: str, *args: str, env_extra=None, capture=True):
                          if env.get("PYTHONPATH") else repo)
     if env_extra:
         env.update(env_extra)
+    argv = [sys.executable, "-m", f"pushcdn_tpu.bin.{name}", *args]
+    if log_path is not None:
+        with open(log_path, "ab") as sink_file:
+            return subprocess.Popen(argv, env=env, stdout=sink_file,
+                                    stderr=subprocess.STDOUT)
     sink = subprocess.PIPE if capture else subprocess.DEVNULL
     return subprocess.Popen(
-        [sys.executable, "-m", f"pushcdn_tpu.bin.{name}", *args],
-        env=env, stdout=sink,
+        argv, env=env, stdout=sink,
         stderr=subprocess.STDOUT if capture else subprocess.DEVNULL,
         text=capture)

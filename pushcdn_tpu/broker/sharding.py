@@ -62,6 +62,14 @@ _FRAME_LEN = struct.Struct(">I")
 DEFAULT_RING_BYTES = int(os.environ.get("PUSHCDN_SHARD_RING_BYTES",
                                         str(4 * 1024 * 1024)))
 
+# per-worker cap on the parent hub's outbound control-socket buffer: the
+# relay budget bounds per-producer relay bytes, but broadcast deltas
+# (connect/subscribe storms) are unbudgeted, so a worker that stops
+# draining its control socket must be cut loose before it grows the
+# parent heap without bound
+HUB_MAX_BUFFER = int(os.environ.get("PUSHCDN_SHARD_HUB_MAX_BUFFER",
+                                    str(32 * 1024 * 1024)))
+
 
 def shards_from_env(flag_value: Optional[int]) -> int:
     if flag_value is not None:
@@ -186,9 +194,13 @@ class ShardRuntime:
         # with relay delivery, so a relay task can never overtake ring
         # records (or another relay) from the same producer mid-dispatch
         self._origin_locks: Dict[int, asyncio.Lock] = {}
+        # readers abandoned by the poison guard: out of rings_in (never
+        # drained again) but still closed with the runtime
+        self._poisoned_readers: List[shardring.RingReader] = []
         self.relay_fallbacks = 0
         self.relay_shed = 0
         self.deltas_applied = 0
+        self._sync_kick_pending = False
 
     def _origin_lock(self, origin: int) -> asyncio.Lock:
         lock = self._origin_locks.get(origin)
@@ -227,6 +239,8 @@ class ShardRuntime:
             w.close()
         for r in self.rings_in.values():
             r.close()
+        for r in self._poisoned_readers:
+            r.close()
 
     def _emit(self, event: tuple) -> None:
         if self.bus is not None:
@@ -237,6 +251,27 @@ class ShardRuntime:
     def apply_event(self, origin: int, event: tuple) -> None:
         kind = event[0]
         conns = self.broker.connections
+        # data-plane relay traffic and unknown events must NOT inflate
+        # the interest-delta counters: during a ring-full window the
+        # relay+ack chatter would otherwise read as a subscription storm
+        if kind == "relay":
+            asyncio.ensure_future(self._deliver_relay(origin, event[2],
+                                                      event[3]))
+            return
+        if kind == "relay_ack":
+            epoch = event[2]
+            self._acked_epoch[origin] = max(
+                self._acked_epoch.get(origin, 0), epoch)
+            unacked = self._relay_unacked.get(origin)
+            if unacked:
+                for e in [e for e in unacked if e <= epoch]:
+                    del unacked[e]
+            return
+        if kind not in ("user", "user_del", "usersync", "mesh_topics",
+                        "mesh_broker_del"):
+            logger.warning("unknown shard delta %r from shard %d",
+                           kind, origin)
+            return
         self.deltas_applied += 1
         metrics_mod.SHARD_DELTAS_APPLIED.inc()
         if kind == "user":
@@ -251,30 +286,24 @@ class ShardRuntime:
             conns.set_remote_broker(event[1], origin, event[2])
         elif kind == "mesh_broker_del":
             conns.remove_remote_broker(event[1])
-        elif kind == "relay":
-            asyncio.ensure_future(self._deliver_relay(origin, event[2],
-                                                      event[3]))
-        elif kind == "relay_ack":
-            epoch = event[2]
-            self._acked_epoch[origin] = max(
-                self._acked_epoch.get(origin, 0), epoch)
-            unacked = self._relay_unacked.get(origin)
-            if unacked:
-                for e in [e for e in unacked if e <= epoch]:
-                    del unacked[e]
-        else:
-            logger.warning("unknown shard delta %r from shard %d",
-                           kind, origin)
 
     def _kick_mesh_sync(self) -> None:
         """Shard 0 pushes partial syncs promptly when sibling membership
         changes (strong consistency across the mesh — the same semantics
-        a local user connect gets from the listener)."""
+        a local user connect gets from the listener). Kicks COALESCE: a
+        delta storm (thousands of sibling connects applied in one bus
+        drain) schedules one push task, not one per delta — the pending
+        flag clears before the CRDT diff is computed, so a delta landing
+        after that point just schedules the next push."""
         if self.shard_id != 0 or not self.broker.connections.brokers:
             return
+        if self._sync_kick_pending:
+            return
+        self._sync_kick_pending = True
         from pushcdn_tpu.broker.tasks import sync as sync_task
 
         async def _push():
+            self._sync_kick_pending = False
             try:
                 await sync_task.partial_user_sync(self.broker)
                 await sync_task.partial_topic_sync(self.broker)
@@ -292,9 +321,11 @@ class ShardRuntime:
                            "plane until drained", self.shard_id, dst)
 
     def _ring_usable(self, dst: int) -> bool:
+        w = self.rings_out.get(dst)
+        if w is not None and w.poisoned:
+            return False  # consumer abandoned it: relay for good
         if not self._fallback.get(dst, False):
             return True
-        w = self.rings_out.get(dst)
         if w is None:
             return False
         # leave the degraded mode only once the consumer fully drained the
@@ -433,17 +464,43 @@ class ShardRuntime:
         finally:
             rec.release()
 
+    # consecutive no-progress retries on one uncommitted/corrupt record
+    # before the ring is declared poisoned (a mid-write window is
+    # microseconds; seconds of stall mean the producer died mid-push or
+    # the slot is corrupt, and spinning would starve every other ring
+    # and relay behind this origin's lock forever)
+    _RING_POISON_RETRIES = 4000
+
     async def _drain_reader(self, src: int,
                             reader: shardring.RingReader) -> None:
+        stalled = 0
         while True:
             recs = reader.drain(64)
             if not recs:
                 if reader.backlog > 0:
                     # torn record mid-write: give the producer a beat
                     metrics_mod.SHARD_RING_TORN.inc()
+                    stalled += 1
+                    if stalled >= self._RING_POISON_RETRIES:
+                        logger.error(
+                            "ring %d->%d poisoned: record never committed "
+                            "after %d retries; abandoning the ring (the "
+                            "producer degrades to the counted relay path)",
+                            src, self.shard_id, stalled)
+                        metrics_mod.SHARD_RING_POISONED.inc()
+                        # flag the header FIRST: the producer's next
+                        # try_push fails over to the relay, so a stalled-
+                        # then-resumed producer can't keep feeding (and
+                        # counting path=ring deliveries into) a ring
+                        # nobody will ever drain again
+                        reader.poison()
+                        if self.rings_in.pop(src, None) is not None:
+                            self._poisoned_readers.append(reader)
+                        return
                     await asyncio.sleep(0.0005)
                     continue
                 return
+            stalled = 0
             for rec in recs:
                 await self._dispatch(rec)
 
@@ -462,7 +519,8 @@ class ShardRuntime:
                             break
                 except (BlockingIOError, InterruptedError):
                     pass
-            for src, reader in self.rings_in.items():
+            # list(): a poisoned ring may be dropped mid-iteration
+            for src, reader in list(self.rings_in.items()):
                 async with self._origin_lock(src):
                     await self._drain_reader(src, reader)
 
@@ -634,7 +692,14 @@ class FdHandoffAcceptor:
                                             reuse_port=False)
         self._listen.setblocking(False)
         self._workers = worker_socks
+        for s in worker_socks:
+            # a full handoff buffer must RAISE so the round-robin can try
+            # the next worker — a blocking send_fds would freeze the whole
+            # parent loop behind one wedged worker
+            s.setblocking(False)
         self._next = 0
+        self.handoff_retries = 0
+        self.handoff_drops = 0
         loop = asyncio.get_running_loop()
         loop.add_reader(self._listen.fileno(), self._on_accept)
 
@@ -642,13 +707,30 @@ class FdHandoffAcceptor:
         try:
             while True:
                 sock, _addr = self._listen.accept()
-                target = self._workers[self._next % len(self._workers)]
-                self._next += 1
                 try:
-                    socket.send_fds(target, [b"\x01"], [sock.fileno()])
-                except OSError:
-                    pass
-                sock.close()  # worker owns its dup'd fd now
+                    delivered = False
+                    for _ in range(len(self._workers)):
+                        target = self._workers[self._next
+                                               % len(self._workers)]
+                        self._next += 1
+                        try:
+                            socket.send_fds(target, [b"\x01"],
+                                            [sock.fileno()])
+                            delivered = True
+                            break
+                        except OSError:
+                            # this worker's handoff buffer is full
+                            # (accept burst) or its pair died: try the
+                            # next worker in the rotation
+                            self.handoff_retries += 1
+                    if not delivered:
+                        self.handoff_drops += 1
+                        logger.warning(
+                            "fd handoff: no worker took the accepted "
+                            "connection; dropping it (%d dropped total)",
+                            self.handoff_drops)
+                finally:
+                    sock.close()  # worker owns its dup'd fd now
         except (BlockingIOError, InterruptedError):
             pass
         except OSError:
@@ -683,14 +765,46 @@ def build_worker_ipc(num_shards: int,
                      ) -> Tuple[List[_WorkerHandle], List[str]]:
     """Create rings + notify + control plumbing for ``num_shards``
     workers. Returns (handles, ring_names) — the parent unlinks the ring
-    shm at teardown."""
+    shm at teardown. Partial failure (fd exhaustion at high shard
+    counts, shm creation errors) cleans up everything already created —
+    leaked /dev/shm segments outlive the process."""
     names: Dict[Tuple[int, int], str] = {}
+    notify: Dict[int, Tuple[socket.socket, socket.socket]] = {}
+    handles: List[_WorkerHandle] = []
+    try:
+        return _build_worker_ipc(num_shards, ring_bytes, names, notify,
+                                 handles)
+    except BaseException:
+        for nm in names.values():
+            shardring.unlink_ring(nm)
+        for rx, tx in notify.values():
+            for s in (rx, tx):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        for h in handles:
+            for s in (h.parent_control, getattr(h, "_child_ctl", None)):
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+        raise
+
+
+def _build_worker_ipc(num_shards: int, ring_bytes: int,
+                      names: Dict[Tuple[int, int], str],
+                      notify: Dict[int, Tuple[socket.socket,
+                                              socket.socket]],
+                      handles: List[_WorkerHandle]
+                      ) -> Tuple[List[_WorkerHandle], List[str]]:
     for i in range(num_shards):
         for j in range(num_shards):
             if i != j:
                 names[(i, j)] = shardring.create_ring(ring_bytes)
-    notify = {i: shardring.notify_pair() for i in range(num_shards)}
-    handles: List[_WorkerHandle] = []
+    for i in range(num_shards):
+        notify[i] = shardring.notify_pair()
     for i in range(num_shards):
         parent_ctl, child_ctl = socket.socketpair(socket.AF_UNIX,
                                                   socket.SOCK_STREAM)
@@ -779,12 +893,23 @@ def _inject_shard_label(text: str, shard: int) -> str:
         if not line or line.startswith("#"):
             out.append(line)
             continue
-        name, _, rest = line.partition(" ")
-        if "{" in name:
-            fam, _, labels = name.partition("{")
-            labels = labels.rstrip("}")
-            out.append(f'{fam}{{shard="{shard}",{labels}}} {rest}')
+        brace = line.find("{")
+        space = line.find(" ")
+        if brace != -1 and (space == -1 or brace < space):
+            # label values may legally contain spaces (and escaped
+            # quotes), so the sample-name boundary is the LAST '}' —
+            # never the first space
+            close = line.rfind("}")
+            if close <= brace:
+                out.append(line)  # malformed: pass through untouched
+                continue
+            fam = line[:brace]
+            labels = line[brace + 1:close]
+            rest = line[close + 1:].lstrip()
+            sep = "," if labels else ""
+            out.append(f'{fam}{{shard="{shard}"{sep}{labels}}} {rest}')
         else:
+            name, _, rest = line.partition(" ")
             out.append(f'{name}{{shard="{shard}"}} {rest}')
     return "\n".join(out)
 
@@ -806,12 +931,56 @@ class ShardSupervisor:
         self.acceptor_endpoint = acceptor_endpoint
         self.handles: List[_WorkerHandle] = []
         self.ring_names: List[str] = []
+        # initialized here (not in start()) so stop() is safe to call on
+        # a supervisor whose start() failed partway
+        self._hub_writers: Dict[int, asyncio.StreamWriter] = {}
+        self._hub_tasks: List[asyncio.Task] = []
         self._server = None
         self._acceptor = None
         self._version = 0
         self._draining = False
+        self.hub_disconnects = 0
+        # the disconnect bound must exceed the aggregate LEGAL relay
+        # volume toward one destination — (num_shards-1) producers, each
+        # allowed _RELAY_MAX_BYTES unacked — or a slow-but-still-draining
+        # worker at high shard counts would be killed by design-legal
+        # traffic; HUB_MAX_BUFFER is the headroom for the unbudgeted
+        # broadcast deltas on top of that
+        self._hub_buffer_cap = HUB_MAX_BUFFER + \
+            max(0, num_shards - 1) * ShardRuntime._RELAY_MAX_BYTES
 
     # -- control hub ---------------------------------------------------------
+
+    def _hub_send(self, writers: Dict[int, asyncio.StreamWriter],
+                  dst: int, frame: bytes) -> None:
+        """Forward one control frame with a bounded write buffer. The hub
+        never awaits drain (one slow worker must not stall the whole
+        control plane), so the bound is enforced by disconnect: a worker
+        whose buffered control traffic exceeds the cap has stopped
+        draining its socket — cut the link so it fails fast (its
+        SocketBus reader exits the worker) and the reaper notices."""
+        w = writers.get(dst)
+        if w is None:
+            return
+        transport = w.transport
+        if transport is not None and \
+                transport.get_write_buffer_size() + len(frame) \
+                > self._hub_buffer_cap:
+            self.hub_disconnects += 1
+            logger.error(
+                "control hub buffer to shard %d exceeded %d B; dropping "
+                "the link so the wedged worker fails fast",
+                dst, self._hub_buffer_cap)
+            writers.pop(dst, None)
+            try:
+                # abort, not close(): close() flushes buffered data
+                # first, i.e. waits for the very drain that will never
+                # happen — the peer must see the connection DIE now
+                transport.abort()
+            except Exception:
+                pass
+            return
+        w.write(frame)
 
     async def _hub_loop(self, handle: _WorkerHandle,
                         writers: Dict[int, asyncio.StreamWriter]) -> None:
@@ -830,13 +999,11 @@ class ShardSupervisor:
                                    protocol=pickle.HIGHEST_PROTOCOL)
                 frame = _FRAME_LEN.pack(len(out)) + out
                 if event[0] in ("relay", "relay_ack"):
-                    target = writers.get(int(event[1]))
-                    if target is not None:
-                        target.write(frame)
+                    self._hub_send(writers, int(event[1]), frame)
                     continue
-                for shard, w in writers.items():
+                for shard in list(writers):
                     if shard != handle.shard:
-                        w.write(frame)
+                        self._hub_send(writers, shard, frame)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass  # worker exited; the reaper notices
 
@@ -879,6 +1046,23 @@ class ShardSupervisor:
             parts.append(f"# HELP cdn_shard_workers worker shard count\n"
                          f"# TYPE cdn_shard_workers gauge\n"
                          f"cdn_shard_workers {len(self.handles)}\n")
+            parts.append(
+                f"# HELP cdn_shard_hub_disconnects workers dropped for "
+                f"control-hub write-buffer overflow\n"
+                f"# TYPE cdn_shard_hub_disconnects counter\n"
+                f"cdn_shard_hub_disconnects {self.hub_disconnects}\n")
+            if self._acceptor is not None:
+                parts.append(
+                    f"# HELP cdn_shard_accept_drops accepted connections "
+                    f"dropped because no worker took the fd handoff\n"
+                    f"# TYPE cdn_shard_accept_drops counter\n"
+                    f"cdn_shard_accept_drops "
+                    f"{self._acceptor.handoff_drops}\n"
+                    f"# HELP cdn_shard_accept_retries fd handoffs retried "
+                    f"on another worker\n"
+                    f"# TYPE cdn_shard_accept_retries counter\n"
+                    f"cdn_shard_accept_retries "
+                    f"{self._acceptor.handoff_retries}\n")
             return 200, "text/plain; version=0.0.4; charset=utf-8", \
                 "".join(parts)
         if path.startswith("/healthz") or path.startswith("/readyz"):
@@ -1006,7 +1190,6 @@ class ShardSupervisor:
         if self.metrics_endpoint:
             self._server = await asyncio.start_server(
                 self._serve, mhost, mport)
-        self._hub_writers: Dict[int, asyncio.StreamWriter] = {}
         self._hub_tasks = [
             asyncio.create_task(self._hub_loop(h, self._hub_writers),
                                 name=f"shard-hub-{h.shard}")
@@ -1049,7 +1232,7 @@ class ShardSupervisor:
                                  return_exceptions=True)
 
     async def stop(self) -> None:
-        for t in getattr(self, "_hub_tasks", []):
+        for t in self._hub_tasks:
             t.cancel()
         if self._hub_tasks:
             await asyncio.gather(*self._hub_tasks, return_exceptions=True)

@@ -20,7 +20,9 @@ Layout (offsets in bytes, all integers little-endian):
 
 - header (64 B): ``u64 head`` (producer cursor, absolute, monotonic),
   ``u64 tail`` (consumer cursor), ``u64 dropped`` (producer-side
-  ring-full fallbacks), ``u64 seq`` (next record sequence number);
+  ring-full fallbacks), ``u64 seq`` (next record sequence number),
+  ``u64 poisoned`` (consumer abandoned the ring — a record never
+  committed; the producer must stop pushing and use the relay);
 - data region: records are contiguous (never wrap mid-record — a record
   that would cross the end is preceded by a PAD record covering the
   remainder).
@@ -70,9 +72,9 @@ class RingRecord:
 
     ``peers`` is ``[(kind, ident, idx_list)]``; :meth:`stream_for` builds
     the wire stream for one peer — a zero-copy memoryview of the shm
-    payload when the peer's frame indices form a contiguous run (frames
-    are stored back-to-back in table order, so contiguous indices ARE
-    contiguous bytes), else one gather copy.
+    payload when the peer's frame indices form a consecutive increasing
+    run (frames are stored back-to-back in table order, so consecutive
+    indices ARE contiguous bytes), else one gather copy in idx order.
     """
 
     __slots__ = ("peers", "payload", "frame_offs", "frame_lens", "_lease")
@@ -86,7 +88,18 @@ class RingRecord:
 
     def stream_for(self, idx: Sequence[int]):
         first, last = idx[0], idx[-1]
-        if last - first + 1 == len(idx):
+        n = len(idx)
+        # zero-copy only for a STRICTLY consecutive run (first, first+1,
+        # ..., last): frames sit back-to-back in table order, so such a
+        # run is one byte span. The O(1) span test alone is NOT enough —
+        # a same-span permutation like [0, 2, 1, 3] (emitted when a peer
+        # shares frames first indexed by an earlier peer in the batch)
+        # must gather in idx order, or the slice would silently reorder
+        # this peer's frames. n <= 2 needs no scan (span == n pins both
+        # elements); longer runs confirm with one C-level range compare
+        # instead of a per-frame Python loop.
+        if last - first + 1 == n and (
+                n <= 2 or list(idx) == list(range(first, last + 1))):
             return self.payload[self.frame_offs[first]:
                                 self.frame_offs[last] + self.frame_lens[last]]
         return b"".join(
@@ -196,6 +209,17 @@ class _RingBase:
     def dropped(self) -> int:
         return self._get(16)
 
+    @property
+    def poisoned(self) -> bool:
+        return self._get(32) != 0
+
+    def poison(self) -> None:
+        """Consumer-side: mark the ring abandoned so the producer's next
+        ``try_push`` fails over to the relay instead of silently feeding
+        a ring nobody drains (a stalled-then-resumed producer would
+        otherwise count path=ring deliveries that vanish)."""
+        self._set(32, 1)
+
     def close(self) -> None:
         self.buf = None
         try:
@@ -264,6 +288,9 @@ class RingWriter(_RingBase):
         ``peers[i] = (kind, ident_bytes, frame_index_list)``. Returns
         False (and counts the drop) when the ring lacks space — the
         caller falls back to the control-plane relay."""
+        if self.poisoned:
+            self.note_dropped()
+            return False
         n_frames = len(frames)
         n_peers = len(peers)
         flens = [len(f) + (0 if prefixed else 4) for f in frames]
@@ -331,6 +358,13 @@ class RingWriter(_RingBase):
                                         4, "little")
         self._set(24, seq + 1)
         self._set(0, head + total)
+        if self.poisoned:
+            # the consumer abandoned the ring while we were mid-push:
+            # the record just committed will never be drained (orphaned
+            # but harmless) — report failure so the caller relays
+            # instead of counting a path=ring delivery that vanishes
+            self.note_dropped()
+            return False
         self.records_pushed += 1
         self.frames_pushed += n_frames
         self.bytes_pushed += payload_len

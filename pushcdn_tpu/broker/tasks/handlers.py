@@ -288,25 +288,33 @@ def route_direct(broker: "Broker", recipient: bytes, raw: Bytes,
         # identity. A sibling's user rides the handoff ring (allowed even
         # for broker-origin frames — the sibling IS this broker); a mesh
         # owner reachable only via shard 0's links rides the ring too.
+        # Precedence mirrors the unsharded path (and the cut-through
+        # plan's dmap): the DirectMap owner wins, so a user the mesh
+        # already re-homed elsewhere is forwarded even while the local
+        # eviction delta is still in flight.
         from pushcdn_tpu.broker import shardring
+        owner = conns.get_broker_identifier_of_user(recipient)
+        if owner is not None and owner != conns.identity:
+            if to_user_only:
+                return  # one-hop rule: never re-forward
+            if owner in conns.brokers:
+                egress.to_broker(owner, raw)
+            else:
+                link_shard = conns.remote_broker_shard.get(owner)
+                if link_shard is not None:
+                    egress.to_shard(link_shard, shardring.KIND_BROKER,
+                                    owner, raw)
+            return
+        # owner is this box — or absent from this worker's replica
+        # (sibling users are mirrored into the DirectMap on shard 0
+        # only): deliver locally, else hand off to the owning shard
         if recipient in conns.users:
             egress.to_user(recipient, raw)
             return
         shard = conns.remote_user_shard.get(recipient)
         if shard is not None:
             egress.to_shard(shard, shardring.KIND_USER, recipient, raw)
-            return
-        owner = conns.get_broker_identifier_of_user(recipient)
-        if owner is None or owner == conns.identity or to_user_only:
-            return  # unknown/stale user, or one-hop rule: drop
-        if owner in conns.brokers:
-            egress.to_broker(owner, raw)
-        else:
-            link_shard = conns.remote_broker_shard.get(owner)
-            if link_shard is not None:
-                egress.to_shard(link_shard, shardring.KIND_BROKER, owner,
-                                raw)
-        return
+        return  # unknown/stale user: drop
     owner = conns.get_broker_identifier_of_user(recipient)
     if owner is None:
         return  # unknown user: drop

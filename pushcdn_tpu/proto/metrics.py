@@ -315,6 +315,10 @@ SHARD_HANDOFF_FRAMES_SHED = SHARD_HANDOFF_FRAMES.labels(path="shed")
 SHARD_RING_TORN = Counter(
     "cdn_shard_ring_torn_reads",
     "Cross-shard ring drains that backed off on a torn/uncommitted record")
+SHARD_RING_POISONED = Counter(
+    "cdn_shard_ring_poisoned",
+    "Inbound rings abandoned because a record never committed (producer "
+    "died mid-push or slot corruption); traffic falls back to the relay")
 SHARD_DELTAS_APPLIED = Counter(
     "cdn_shard_deltas_applied",
     "Control-plane interest deltas applied from sibling shards")

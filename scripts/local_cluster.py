@@ -45,8 +45,15 @@ from pushcdn_tpu.bin.common import spawn_binary  # noqa: E402
 DRAIN_GRACE_S = 2.0
 
 
-def spawn(name: str, *args: str, env_extra=None) -> subprocess.Popen:
-    proc = spawn_binary(name, *args, env_extra=env_extra)
+def spawn(name: str, *args: str, env_extra=None,
+          log_path=None) -> subprocess.Popen:
+    """Brokers and the marshal pass ``log_path``: nothing drains their
+    pipes while they run (only the client's stdout is read live), and a
+    chatty ``--shards`` broker — parent plus workers sharing one fd —
+    wedges once the 64 KiB pipe buffer fills; a log file avoids the
+    wedge while keeping crash output for the died-early diagnostic."""
+    proc = spawn_binary(name, *args, env_extra=env_extra,
+                        log_path=log_path)
     print(f"[cluster] {name} up (pid {proc.pid})")
     return proc
 
@@ -378,7 +385,8 @@ def main() -> int:
         return {"PUSHCDN_TRACE_LOG":
                 os.path.join(args.trace_log, f"{name}.jsonl")}
 
-    db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-cluster-"), "cdn.sqlite")
+    logdir = tempfile.mkdtemp(prefix="pushcdn-cluster-")
+    db = os.path.join(logdir, "cdn.sqlite")
     bp = args.base_port
     if bp == 0:
         # bind one free port and take the following ~200 as the range —
@@ -434,7 +442,8 @@ def main() -> int:
                 f"127.0.0.1:{metrics_ports[f'broker{i}']}",
                 *shard_flags,
                 *(["--device-plane"] if args.device_plane else []),
-                env_extra=env)))
+                env_extra=env,
+                log_path=os.path.join(logdir, f"broker{i}.log"))))
             if i == 0:
                 ok = check_readiness_before_bind(metrics_ports["broker0"]) \
                     and ok
@@ -445,7 +454,8 @@ def main() -> int:
             "--bind-endpoint", f"127.0.0.1:{bp + 50}",
             "--metrics-bind-endpoint", f"127.0.0.1:{metrics_ports['marshal']}",
             "--user-transport", "tcp",
-            env_extra=trace_env("marshal"))))
+            env_extra=trace_env("marshal"),
+            log_path=os.path.join(logdir, "marshal.log"))))
         time.sleep(1.0)
         procs.append(("client", spawn(
             "client",
@@ -474,7 +484,13 @@ def main() -> int:
             for name, proc in others:
                 if proc.poll() is not None:
                     print(f"[cluster] FAIL: {name} died early")
-                    print(proc.stdout.read()[-2000:])
+                    if proc.stdout is not None:
+                        print(proc.stdout.read()[-2000:])
+                    else:
+                        log = os.path.join(logdir, f"{name}.log")
+                        if os.path.exists(log):
+                            with open(log, errors="replace") as f:
+                                print(f.read()[-2000:])
                     return 1
             line = client.stdout.readline()
             if line:

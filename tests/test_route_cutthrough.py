@@ -410,3 +410,77 @@ async def test_depth1_singles_equivalence():
     assert alive_n == alive_p
     assert d_native == d_python
     assert bal_n and bal_p
+
+
+def test_sharded_route_direct_directmap_precedence():
+    """The sharded scalar route_direct must give the DirectMap owner the
+    same precedence the unsharded path (and the cut-through plan's dmap)
+    does: a user the mesh already re-homed to another broker is FORWARDED
+    even while the local eviction delta is still in flight — delivering
+    to the stale local connection would diverge from the N==1 decision."""
+    from pushcdn_tpu.broker.tasks.handlers import EgressBatch, route_direct
+
+    class _Raw:
+        def clone(self):
+            return self
+
+        def release(self):
+            pass
+
+    class _Conns:
+        num_shards = 2
+        identity = "pub:me/priv:me"
+
+        def __init__(self):
+            self.users = {}
+            self.remote_user_shard = {}
+            self.brokers = {}
+            self.remote_broker_shard = {}
+            self.direct = {}
+
+        def get_broker_identifier_of_user(self, key):
+            return self.direct.get(key)
+
+    class _Broker:
+        def __init__(self):
+            self.connections = _Conns()
+
+    other = "pub:other/priv:other"
+
+    # re-homed user with a stale local connection: forward to the owner
+    broker = _Broker()
+    broker.connections.users[b"u"] = object()
+    broker.connections.brokers[other] = object()
+    broker.connections.direct[b"u"] = other
+    egress = EgressBatch(broker)
+    route_direct(broker, b"u", _Raw(), to_user_only=False, egress=egress)
+    assert list(egress.brokers) == [other]
+    assert not egress.users and not egress.shards
+
+    # same state, broker-origin frame: one-hop rule drops it
+    egress = EgressBatch(broker)
+    route_direct(broker, b"u", _Raw(), to_user_only=True, egress=egress)
+    assert not egress.brokers and not egress.users and not egress.shards
+
+    # owner is this box: local connection delivers
+    broker = _Broker()
+    broker.connections.users[b"u"] = object()
+    broker.connections.direct[b"u"] = _Conns.identity
+    egress = EgressBatch(broker)
+    route_direct(broker, b"u", _Raw(), to_user_only=False, egress=egress)
+    assert list(egress.users) == [b"u"] and not egress.shards
+
+    # sibling-shard user (no DirectMap entry off shard 0): ride the ring
+    broker = _Broker()
+    broker.connections.remote_user_shard[b"u"] = 1
+    egress = EgressBatch(broker)
+    route_direct(broker, b"u", _Raw(), to_user_only=False, egress=egress)
+    assert list(egress.shards) == [1] and not egress.users
+
+    # re-homed user whose mesh link lives on shard 0: ring to the link
+    broker = _Broker()
+    broker.connections.direct[b"u"] = other
+    broker.connections.remote_broker_shard[other] = 0
+    egress = EgressBatch(broker)
+    route_direct(broker, b"u", _Raw(), to_user_only=False, egress=egress)
+    assert list(egress.shards) == [0] and not egress.brokers

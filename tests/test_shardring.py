@@ -64,6 +64,61 @@ def test_non_contiguous_index_gathers(ring):
     rec.release()
 
 
+def test_permuted_index_span_gathers_in_idx_order(ring):
+    """REGRESSION: an index list that is a same-span PERMUTATION — the
+    shape _flush_shards produces when a peer shares frames first indexed
+    by an earlier peer in the batch — must gather in idx order. A
+    span-length-only contiguity check took the zero-copy path and
+    silently delivered the frames in table order instead."""
+    w, r = ring
+    assert w.try_push([b"f0", b"f1", b"f2", b"f3"],
+                      [(sr.KIND_USER, b"a", [0, 1]),
+                       (sr.KIND_USER, b"b", [0, 2, 1, 3])])
+    rec = r.drain()[0]
+    data = rec.stream_for([0, 2, 1, 3])
+    assert not isinstance(data, memoryview)
+    assert bytes(data) == (b"\x00\x00\x00\x02f0\x00\x00\x00\x02f2"
+                           b"\x00\x00\x00\x02f1\x00\x00\x00\x02f3")
+    # strictly consecutive runs still ride zero-copy
+    assert isinstance(rec.stream_for([0, 1]), memoryview)
+    rec.release()
+
+
+def test_poisoned_ring_rejects_pushes(ring):
+    """Once the consumer abandons a ring (a record that never commits),
+    the header poison flag makes every further push fail over to the
+    counted relay — a stalled-then-resumed producer must not keep
+    feeding a ring nobody drains."""
+    w, r = ring
+    assert w.try_push([b"a"], [(sr.KIND_USER, b"u", [0])])
+    r.poison()
+    assert w.poisoned
+    dropped = w.dropped
+    assert not w.try_push([b"b"], [(sr.KIND_USER, b"u", [0])])
+    assert w.dropped == dropped + 1
+
+
+def test_poison_landing_mid_push_reports_failure(ring):
+    """The producer re-checks the poison flag AFTER committing: a stall
+    spanning the consumer's abandon window must not count a path=ring
+    delivery for a record nobody will ever drain."""
+    w, r = ring
+    checks = []
+
+    class _MidPushPoisoned(type(w)):
+        @property
+        def poisoned(self):
+            checks.append(1)
+            # clean at the entry check, poisoned by the post-commit
+            # re-check — the consumer abandoned while we were writing
+            return len(checks) > 1
+
+    w.__class__ = _MidPushPoisoned
+    assert not w.try_push([b"x"], [(sr.KIND_USER, b"u", [0])])
+    assert w.dropped == 1
+    assert w.records_pushed == 0
+
+
 def test_wraparound_many_records(ring):
     """Thousands of pushes through a small ring: every record survives the
     wrap (PAD records at the boundary), sequences stay intact, and the
@@ -256,3 +311,64 @@ async def test_runtime_ring_full_falls_back_to_relay():
         rx.close()
         tx.close()
         sr.unlink_ring(name)
+
+
+# ---------------------------------------------------------------------------
+# supervisor helpers: shard-label injection, hub write-buffer bound
+# ---------------------------------------------------------------------------
+
+def test_inject_shard_label_handles_spaced_label_values():
+    """Label values may legally contain spaces; the injector must find
+    the sample-name boundary at the closing '}', not the first space."""
+    from pushcdn_tpu.broker.sharding import _inject_shard_label
+    text = ("# HELP cdn_x help text\n"
+            'cdn_x{path="GET /metrics",code="200"} 3\n'
+            "cdn_plain 1\n"
+            "cdn_empty{} 2")
+    out = _inject_shard_label(text, 1).splitlines()
+    assert out[0] == "# HELP cdn_x help text"
+    assert out[1] == 'cdn_x{shard="1",path="GET /metrics",code="200"} 3'
+    assert out[2] == 'cdn_plain{shard="1"} 1'
+    assert out[3] == 'cdn_empty{shard="1"} 2'
+
+
+def test_hub_send_disconnects_wedged_worker():
+    """A worker that stops draining its control socket is cut loose once
+    its buffered hub traffic passes HUB_MAX_BUFFER — bounded parent
+    memory instead of unbounded broadcast-delta accumulation."""
+    from pushcdn_tpu.broker import sharding
+
+    class _Transport:
+        def __init__(self, size):
+            self._size = size
+            self.aborted = False
+
+        def get_write_buffer_size(self):
+            return self._size
+
+        def abort(self):
+            self.aborted = True
+
+    class _Writer:
+        def __init__(self, buffered):
+            self.transport = _Transport(buffered)
+            self.frames = []
+
+        def write(self, frame):
+            self.frames.append(frame)
+
+    sup = sharding.ShardSupervisor.__new__(sharding.ShardSupervisor)
+    sup.hub_disconnects = 0
+    sup._hub_buffer_cap = sharding.HUB_MAX_BUFFER
+    healthy = _Writer(0)
+    wedged = _Writer(sharding.HUB_MAX_BUFFER)
+    writers = {0: healthy, 1: wedged}
+    sup._hub_send(writers, 0, b"delta")
+    sup._hub_send(writers, 1, b"delta")
+    assert healthy.frames == [b"delta"]
+    # abort, not close: close() would flush-wait on the very peer that
+    # isn't draining, so the disconnect would never actually land
+    assert wedged.frames == [] and wedged.transport.aborted
+    assert 1 not in writers and 0 in writers
+    assert sup.hub_disconnects == 1
+    sup._hub_send(writers, 1, b"delta")  # gone: a no-op, not a crash
