@@ -446,6 +446,35 @@ async def bench_route_cutthrough(msgs: int):
              trials=[round(r, 1) for r in res["trials"]])
 
 
+async def bench_route_churn(msgs: int, parked_users: int):
+    """Forwarding under sustained subscribe churn (ISSUE 7): the same
+    8-receiver loop with ``parked_users`` extra subscriptions inflating
+    the interest table and a churner connection flooding
+    Subscribe/Unsubscribe throughout — one row per route-state
+    maintenance mode (incremental in-place deltas vs the pre-ISSUE-7
+    rebuild-guard baseline), so the headline tracks the control-plane
+    regression surface the same way route_cutthrough tracks the data
+    plane."""
+    from pushcdn_tpu.testing.routebench import forward_rate
+
+    for mode, inc in (("incremental", True), ("rebuild", False)):
+        res = await forward_rate(
+            "native", receivers=8, msgs=msgs, trials=3,
+            parked_users=parked_users, churn=True, incremental=inc)
+        if res is None:
+            emit("configs1/route_churn", 0, "skipped", mode=mode,
+                 reason="native route-plan kernel unavailable")
+            return
+        summary = res.get("route_summary") or {}
+        emit("configs1/route_churn", res["median"], "msgs/s",
+             impl="native", mode=mode, receivers=8, msgs=res["msgs"],
+             parked_users=parked_users,
+             churn_ops_s=round(res["churn_ops_s"], 1),
+             deltas_applied=summary.get("deltas_applied"),
+             rebuilds=summary.get("rebuilds"),
+             trials=[round(r, 1) for r in res["trials"]])
+
+
 async def amain(quick: bool):
     from pushcdn_tpu.bin.common import tune_gc
     tune_gc()  # the binaries' server GC tuning; see bin/common.py
@@ -458,6 +487,8 @@ async def amain(quick: bool):
     prev_window = Memory.set_duplex_window(256 * 1024)
     try:
         await bench_route_cutthrough(msgs=2_000 if quick else 10_000)
+        await bench_route_churn(msgs=1_500 if quick else 6_000,
+                                parked_users=1_500 if quick else 8_000)
         await bench_two_broker_fanout(msgs=100 if quick else 500)
         await bench_topic_pubsub(per_topic=16 if quick else 64,
                                  rounds=20 if quick else 100)
@@ -489,6 +520,10 @@ def main():
             if row["bench"] == "configs1/route_cutthrough" \
                     and row.get("unit") == "msgs/s":
                 headline["route_cutthrough_msgs_s"] = row["value"]
+            if row["bench"] == "configs1/route_churn" \
+                    and row.get("unit") == "msgs/s" \
+                    and row.get("mode") == "incremental":
+                headline["route_churn_msgs_s"] = row["value"]
             if row["bench"] == "configs1/auth_handshake_warm":
                 headline["auth_handshake_warm_ms"] = row["value"]
         write_bench_json(args.out_json, "configs_bench", headline, RESULTS)

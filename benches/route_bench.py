@@ -467,6 +467,351 @@ async def bench_shard_scaling(shard_counts, receivers: int, msgs: int,
 
 
 # ---------------------------------------------------------------------------
+# tier 5 (ISSUE 7): forwarding under sustained subscribe churn —
+# incremental deltas vs the rebuild-guard baseline, same churn machinery
+# ---------------------------------------------------------------------------
+
+async def bench_churn_forward(receivers: int, msgs: int,
+                              parked_users: int, trials: int,
+                              sample: int = 64) -> dict:
+    """The ISSUE 7 acceptance A/B: one broker carrying ``parked_users``
+    extra subscriptions (a big interest table) forwards broadcasts while
+    a churner floods Subscribe/Unsubscribe. mode=incremental applies
+    typed deltas in place; mode=rebuild is the pre-ISSUE-7 baseline
+    (full O(users) rebuild behind the churn guard's scalar backoff).
+    Also records publish→delivery latency of traced frames under churn
+    (aggregated through scripts/trace_report.py --json)."""
+    import tempfile
+
+    from pushcdn_tpu.proto import trace as trace_lib
+    from pushcdn_tpu.testing.routebench import forward_rate
+    out: dict = {}
+    results: dict = {}
+    spans_dir = tempfile.mkdtemp(prefix="pushcdn-churnspans-")
+    for mode, inc in (("incremental", True), ("rebuild", False)):
+        spans_path = os.path.join(spans_dir, f"{mode}.jsonl")
+        trace_lib._LOG_PATH, trace_lib._log_file = spans_path, None
+        try:
+            res = await forward_rate(
+                "native", receivers=receivers, msgs=msgs, trials=trials,
+                parked_users=parked_users, churn=True, incremental=inc,
+                trace_every=sample, deliver_spans=True)
+        finally:
+            if trace_lib._log_file is not None:
+                try:
+                    trace_lib._log_file.close()
+                except Exception:
+                    pass
+            trace_lib._LOG_PATH, trace_lib._log_file = None, None
+        gc.collect()
+        if res is None:
+            emit("route/churn_forward", 0, "skipped", mode=mode,
+                 reason="native route-plan kernel unavailable")
+            return out
+        results[mode] = res
+        summary = res.get("route_summary") or {}
+        emit("route/churn_forward", res["median"], "msgs/s",
+             impl="native", mode=mode, receivers=receivers,
+             msgs=res["msgs"], parked_users=parked_users,
+             churn_ops_s=round(res["churn_ops_s"], 1),
+             deltas_applied=summary.get("deltas_applied"),
+             rebuilds=summary.get("rebuilds"),
+             last_delta_apply_s=summary.get("last_delta_apply_s"),
+             trials=[round(r, 1) for r in res["trials"]])
+        # publish→delivery percentiles under churn, aggregated by the
+        # REAL scripts/trace_report.py over the run's span log (the
+        # traced frames' delivery-hop latency is measured from the
+        # carried publish-time origin)
+        report = await run_trace_report_on(spans_path)
+        delivery = ((report or {}).get("per_hop") or {}).get("delivery")
+        if delivery:
+            emit("route/churn_e2e", delivery["p50_ms"], "ms", mode=mode,
+                 tier="p50", samples=delivery.get("count"),
+                 source="trace_report")
+            emit("route/churn_e2e", delivery["p99_ms"], "ms", mode=mode,
+                 tier="p99", samples=delivery.get("count"),
+                 source="trace_report")
+            out[f"churn_e2e_p99_ms_{mode}"] = delivery["p99_ms"]
+    inc_med = results["incremental"]["median"]
+    reb_med = results["rebuild"]["median"]
+    if reb_med:
+        ratio = inc_med / reb_med
+        emit("route/churn_forward", ratio, "x",
+             tier="incremental-vs-rebuild", parked_users=parked_users,
+             note="acceptance: >= 2x at the same churn rate")
+        out["churn_forward_ratio"] = round(ratio, 2)
+    out["churn_forward_msgs_s"] = round(inc_med, 1)
+    return out
+
+
+async def run_trace_report_on(spans_path: str) -> Optional[dict]:
+    """Aggregate a spans JSONL through the REAL scripts/trace_report.py
+    (the claim 'p99 via trace_report' must run the actual tool)."""
+    import subprocess
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "trace_report.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--json", spans_path],
+        capture_output=True, text=True, timeout=120)
+    # rc 1 just means "no chain carried every hop" (this harness's
+    # receivers emit delivery spans only) — the per-hop stats still hold
+    try:
+        return json.loads(proc.stdout)
+    except ValueError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# tier 6 (ISSUE 7): the synthetic 1M-subscription control-plane harness —
+# no sockets, the Connections + RouteState pair driven directly so the
+# measured object is route-state maintenance itself
+# ---------------------------------------------------------------------------
+
+async def bench_million_subs(quick: bool) -> dict:
+    """Scale check for the incremental control plane: ``n_users`` users x
+    ~``topics_per_user`` Zipf-skewed topics (~1M subscriptions at full
+    size), then (a) subscribe/unsubscribe churn, (b) a reconnect storm
+    (2% of users drop + re-add; auth itself is excluded — in production
+    those reconnects ride the warm BLS pk cache, see BASELINE round 6),
+    (c) a DirectMap merge wave — measuring per-batch delta-apply latency
+    (p50/p99), snapshot staleness (mutation -> snapshot current), the
+    memory ceiling under the admission limiter (the connection budget
+    refuses users past the cap), and event-loop health (max scheduling
+    lag of a concurrent ticker must stay under the /healthz budget)."""
+    from pushcdn_tpu.broker import connections as connections_mod
+    from pushcdn_tpu.broker.admission import AdmissionControl
+    from pushcdn_tpu.broker.tasks import cutthrough
+    from pushcdn_tpu.native import routeplan
+    from pushcdn_tpu.proto import def_ as def_mod
+    from pushcdn_tpu.proto import flightrec
+
+    if not routeplan.available():
+        emit("route/million", 0, "skipped",
+             reason="native route-plan kernel unavailable")
+        return {}
+
+    # Zipf sampling WITH replacement dedups to ~15.2 unique topics/user,
+    # so 68K users is what actually crosses 1M live subscriptions in the
+    # native table (asserted below) — 50K would peak at ~760K
+    n_users = 8_000 if quick else 68_000
+    topics_per_user = 20
+    churn_ops = 2_000 if quick else 20_000
+    storm_users = max(n_users // 50, 100)
+
+    class _Conn:
+        def __init__(self, rec):
+            self.flightrec = rec
+
+        def close(self):
+            pass
+
+    class _Broker:
+        pass
+
+    rng = np.random.default_rng(7)
+    # Zipf-skewed topic popularity over the u8 space (hot topics get the
+    # bulk of the 1M subscriptions, like a consensus deployment's vote/
+    # proposal topics)
+    zipf = 1.0 / np.arange(1, 257)
+    zipf /= zipf.sum()
+    topic_choices = rng.choice(256, size=(n_users, topics_per_user),
+                               p=zipf)
+
+    from pushcdn_tpu.proto.topic import TopicSpace
+    broker = _Broker()
+    broker.connections = connections_mod.Connections("pub:m/priv:m")
+    broker.run_def = def_mod.testing_run_def(
+        topics=TopicSpace(valid=frozenset(range(256))))
+    broker.device_plane = None
+    broker.admission = None
+    conns = broker.connections
+    rec = flightrec.FlightRecorder("million-harness")  # one shared seat
+    conn = _Conn(rec)
+
+    def rss_kib() -> int:
+        # current VmRSS, not ru_maxrss: the high-water mark reflects
+        # whatever earlier bench tier peaked highest, not this harness
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+        return 0
+
+    gc.collect()
+    rss0 = rss_kib()
+    # peak-tracked: the allocator reuses pages freed by earlier bench
+    # tiers, so an end-of-run point sample can under-report (even go
+    # negative); the ceiling is judged against the harness's own peak
+    rss_peak = {"kib": rss0}
+
+    def rss_note() -> None:
+        now = rss_kib()
+        if now > rss_peak["kib"]:
+            rss_peak["kib"] = now
+    # the STATED ceiling the run must fit in (admission budget times a
+    # generous per-subscription allowance + fixed slack) — asserted, so
+    # a memory regression fails the bench rather than drifting silently
+    ceiling_mib = 256 + n_users * topics_per_user * 600 / (1 << 20)
+    adm = AdmissionControl(broker)
+    adm.max_user_conns = n_users  # the limiter IS the memory ceiling
+    loop_lag = {"max": 0.0}
+    ticker_stop = False
+
+    async def ticker():
+        # the /healthz loop-lag proxy: a sleep(0.01) wakeup that should
+        # never be late by more than the health budget (2.0 s default)
+        while not ticker_stop:
+            t0 = time.perf_counter()
+            await asyncio.sleep(0.01)
+            late = time.perf_counter() - t0 - 0.01
+            if late > loop_lag["max"]:
+                loop_lag["max"] = late
+
+    tick_task = asyncio.create_task(ticker())
+    try:
+        # ---- phase 1: connect the herd (admission-gated) ----
+        t0 = time.perf_counter()
+        shed = 0
+        for i in range(n_users + 200):  # 200 over budget: must be shed
+            if adm.admit_user() is not None:
+                shed += 1
+                continue
+            key = b"mu%06d" % i
+            conns.add_user(key, conn,
+                           [int(t) for t in topic_choices[i % n_users]])
+            if i % 2048 == 2047:
+                await asyncio.sleep(0)
+        connect_s = time.perf_counter() - t0
+        total_subs = sum(len(conns.user_topics.get_values_of_key(k))
+                         for k in list(conns.users)[:64])  # sample only
+        state = cutthrough.RouteState(broker,
+                                      routeplan.RoutePlanner.create())
+        t0 = time.perf_counter()
+        assert state._refresh()
+        build_s = time.perf_counter() - t0
+        stats = state.planner.stats()
+        emit("route/million", stats["live_subs"], "subscriptions",
+             tier="build", users=conns.num_users, shed_over_budget=shed,
+             connect_s=round(connect_s, 3),
+             first_build_s=round(build_s, 3),
+             avg_topics_sampled=round(total_subs / 64, 1))
+        assert shed == 200, "admission budget must have refused the rest"
+        if not quick:
+            assert stats["live_subs"] >= 1_000_000, \
+                f"full-size harness must cross 1M live subscriptions " \
+                f"(got {stats['live_subs']})"
+        rss_note()
+
+        # ---- phase 2: subscribe/unsubscribe churn, batched applies ----
+        apply_lat: list = []
+        # snapshot staleness: oldest unreflected mutation -> snapshot
+        # current again (the batch window PLUS the apply, i.e. what a
+        # plan call could observe at worst)
+        staleness: list = []
+        users = list(conns.users.keys())
+        t0 = time.perf_counter()
+        batch_first_mut = None
+        for op in range(churn_ops):
+            key = users[int(rng.integers(0, len(users)))]
+            t = int(rng.integers(0, 256))
+            if batch_first_mut is None:
+                batch_first_mut = time.perf_counter()
+            if op % 2 == 0:
+                conns.subscribe_user_to(key, [t])
+            else:
+                conns.unsubscribe_user_from(key, [t])
+            if op % 16 == 15:  # batched per plan call, like the drain
+                ta = time.perf_counter()
+                assert state._refresh()
+                done = time.perf_counter()
+                apply_lat.append(done - ta)
+                staleness.append(done - batch_first_mut)
+                batch_first_mut = None
+                if op % 1024 == 1023:
+                    await asyncio.sleep(0)
+        churn_s = time.perf_counter() - t0
+        rss_note()
+        lat = sorted(apply_lat)
+
+        def pct(arr, q):
+            return arr[min(int(q * len(arr)), len(arr) - 1)]
+
+        stale = sorted(staleness)
+        emit("route/million", round(churn_ops / churn_s, 1), "ops/s",
+             tier="churn", batches=len(apply_lat),
+             apply_p50_us=round(pct(lat, 0.5) * 1e6, 1),
+             apply_p99_us=round(pct(lat, 0.99) * 1e6, 1),
+             staleness_p50_us=round(pct(stale, 0.5) * 1e6, 1),
+             staleness_p99_us=round(pct(stale, 0.99) * 1e6, 1),
+             deltas_applied=state.deltas_applied,
+             rebuilds=dict(state.rebuild_counts))
+
+        # ---- phase 3: reconnect storm (drop + re-add 2% of users) ----
+        storm = [users[int(i)] for i in
+                 rng.integers(0, len(users), size=storm_users)]
+        t0 = time.perf_counter()
+        for key in storm:
+            conns.remove_user(key)
+        for j, key in enumerate(storm):
+            conns.add_user(key, conn,
+                           [int(t) for t in topic_choices[j % n_users]])
+            if j % 64 == 63:
+                ta = time.perf_counter()
+                assert state._refresh()
+                apply_lat.append(time.perf_counter() - ta)
+        ta = time.perf_counter()
+        assert state._refresh()
+        storm_catchup_s = time.perf_counter() - ta
+        storm_s = time.perf_counter() - t0
+        rss_note()
+        emit("route/million", round(len(storm) * 2 / storm_s, 1), "ops/s",
+             tier="reconnect_storm", storm_users=len(storm),
+             catchup_s=round(storm_catchup_s, 4),
+             rebuilds=dict(state.rebuild_counts),
+             note="auth excluded: production reconnects ride the warm "
+                  "BLS pk cache (BASELINE r6)")
+
+        # ---- wrap-up: memory ceiling + loop health ----
+        gc.collect()
+        rss_note()
+        rss_mib = (rss_peak["kib"] - rss0) / 1024
+        stats = state.planner.stats()
+        ticker_stop = True
+        await tick_task
+        lag_budget = float(os.environ.get("PUSHCDN_HEALTH_LAG_MAX", "")
+                           or 2.0)
+        green = loop_lag["max"] < lag_budget
+        emit("route/million", round(rss_mib, 1), "MiB",
+             tier="memory", users=conns.num_users,
+             ceiling_mib=round(ceiling_mib, 1),
+             rss_abs_mib=round(rss_peak["kib"] / 1024, 1),
+             live_subs=stats["live_subs"],
+             index_entries=stats["list_entries"],
+             dmap_live=stats["dmap_live"],
+             max_loop_lag_ms=round(loop_lag["max"] * 1e3, 2),
+             loop_lag_green=green, lag_budget_s=lag_budget)
+        assert green, (f"event loop lag {loop_lag['max']:.3f}s breached "
+                       f"the {lag_budget}s health budget")
+        assert rss_mib < ceiling_mib, \
+            f"RSS +{rss_mib:.1f} MiB breached the {ceiling_mib:.0f} MiB " \
+            f"stated ceiling"
+        return {
+            "million_users": conns.num_users,
+            "million_subs": stats["live_subs"],
+            "million_apply_p99_us": round(pct(lat, 0.99) * 1e6, 1),
+            "million_staleness_p99_us": round(pct(stale, 0.99) * 1e6, 1),
+            "million_storm_catchup_s": round(storm_catchup_s, 4),
+            "million_rss_mib": round(rss_mib, 1),
+            "million_rss_ceiling_mib": round(ceiling_mib, 1),
+            "million_max_loop_lag_ms": round(loop_lag["max"] * 1e3, 2),
+        }
+    finally:
+        ticker_stop = True
+        if not tick_task.done():
+            tick_task.cancel()
+
+
+# ---------------------------------------------------------------------------
 # tier 2: end-to-end broker forwarding through the wire
 # ---------------------------------------------------------------------------
 
@@ -492,10 +837,20 @@ async def bench_forward(impl: str, receivers: int, msgs: int,
 
 async def amain(quick: bool, impl_arg: str,
                 out_json: Optional[str] = None,
-                shard_rows: Optional[str] = None) -> None:
+                shard_rows: Optional[str] = None,
+                churn_rows: bool = False) -> None:
     from pushcdn_tpu.bin.common import tune_gc
     tune_gc()
     impls = ("native", "python") if impl_arg == "auto" else (impl_arg,)
+
+    # ISSUE 7: the synthetic 1M-subscription control-plane harness runs
+    # FIRST — its memory-ceiling row is an RSS delta, and the forwarding
+    # tiers below leave gigabytes of freed-but-resident pool pages that
+    # allocator reuse would silently absorb the harness's footprint into
+    stats: dict = {}
+    if churn_rows:
+        stats.update(await bench_million_subs(quick))
+        gc.collect()
 
     plan_medians = await bench_plan(
         impls, n_users=64, n_frames=2048 if quick else 8192,
@@ -527,9 +882,19 @@ async def amain(quick: bool, impl_arg: str,
 
     # ISSUE 5: whole-observability-plane overhead (profiler + tracing +
     # e2e histogram) under the same ≤2% budget, plus e2e percentiles
-    stats = await bench_profiler_overhead(
+    stats.update(await bench_profiler_overhead(
         trace_impl, receivers=8, msgs=2_000 if quick else 10_000,
-        trials=2 if quick else 3)
+        trials=2 if quick else 3))
+
+    # ISSUE 7: forwarding under sustained subscribe churn (incremental
+    # deltas vs the rebuild-guard baseline; the 1M harness ran first,
+    # see above)
+    if churn_rows:
+        stats.update(await bench_churn_forward(
+            receivers=8, msgs=1_500 if quick else 6_000,
+            parked_users=1_500 if quick else 8_000,
+            trials=2 if quick else 3))
+        gc.collect()
 
     # ISSUE 6: multi-core shard scaling rows (real OS processes over TCP)
     if shard_rows != "none":
@@ -556,7 +921,7 @@ def write_bench_json(path: str, section: str, headline: dict,
                 doc = json.load(fh)
         except (OSError, ValueError):
             doc = {}
-    doc.setdefault("round", 10)
+    doc.setdefault("round", 11)
     doc[section] = {"headline": headline, "rows": rows}
     with open(path, "w") as fh:
         json.dump(doc, fh, indent=1)
@@ -577,9 +942,14 @@ def main() -> None:
     ap.add_argument("--shard-rows", default=None, metavar="N,N,...",
                     help="shard counts for the route/shard_forward tier "
                          "(default 1,2,4; 1,2 with --quick; 'none' skips)")
+    ap.add_argument("--churn-rows", action="store_true",
+                    help="ISSUE 7 tiers: forwarding-under-churn A/B "
+                         "(incremental deltas vs the rebuild-guard "
+                         "baseline) + the synthetic 1M-subscription "
+                         "control-plane harness")
     args = ap.parse_args()
     asyncio.run(amain(args.quick, args.route_impl, args.out_json,
-                      args.shard_rows))
+                      args.shard_rows, args.churn_rows))
 
 
 if __name__ == "__main__":

@@ -114,6 +114,7 @@ class Broker:
         self.certificate: Optional[Certificate] = None
         self.user_listener = None
         self.broker_listener = None
+        self.admission = None  # AdmissionControl, set in new()
         self._tasks: list[asyncio.Task] = []
         self._stopped = asyncio.Event()
         # set by the device plane when overflow traffic needs host links
@@ -150,6 +151,10 @@ class Broker:
 
         self.limiter = Limiter(global_pool_bytes=c.global_memory_pool_size)
         self.connections = Connections(str(self.identity))
+        # admission control (ISSUE 7): connection budgets + subscribe-rate
+        # shedding; env-configured, disabled by default
+        from pushcdn_tpu.broker.admission import AdmissionControl
+        self.admission = AdmissionControl(self)
 
         # The observability endpoint comes up BEFORE the listeners bind:
         # /readyz must be probe-able (and false) during startup, so an
@@ -226,11 +231,12 @@ class Broker:
         health_mod.register_readiness("listeners", self._check_listeners)
         health_mod.register_readiness("discovery", self._check_discovery)
         health_mod.register_readiness("mesh", self._check_mesh)
+        health_mod.register_readiness("admission", self._check_admission)
         metrics_mod.register_debug_route("/debug/topology",
                                          self._topology_route)
 
     def unregister_observability(self) -> None:
-        for name in ("listeners", "discovery", "mesh"):
+        for name in ("listeners", "discovery", "mesh", "admission"):
             health_mod.unregister(name)
         metrics_mod.unregister_debug_route("/debug/topology")
 
@@ -238,6 +244,14 @@ class Broker:
         if not self.listeners_bound:
             return False, "listeners not bound yet"
         return True, "user + broker listeners bound"
+
+    def _check_admission(self):
+        """Not ready while the admission plane is actively shedding —
+        the load balancer steers new connections away until the box has
+        gone PUSHCDN_SHED_READY_S without refusing work."""
+        if self.admission is None:
+            return True, "admission control not configured"
+        return self.admission.readiness_check()
 
     def note_discovery_probe(self, ok: bool, detail: str) -> None:
         """Cache a discovery-store contact outcome (the heartbeat task
@@ -339,6 +353,8 @@ class Broker:
                 "direct_map_size": len(conns.direct_map),
             },
             "cutthrough": state.summary() if state is not None else None,
+            "admission": (self.admission.summary()
+                          if self.admission is not None else None),
         }
 
     # -- supervision --------------------------------------------------------

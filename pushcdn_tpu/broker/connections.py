@@ -26,8 +26,10 @@ from __future__ import annotations
 
 import enum
 import logging
+import os
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Deque, Dict, List, Optional, Set, Tuple
 
 from pushcdn_tpu.broker.relational_map import RelationalMap
 from pushcdn_tpu.broker.versioned_map import VersionedMap
@@ -60,6 +62,13 @@ class BrokerHandle:
     # That peer's advertised topic set, as a CRDT we merge TopicSync into
     # (per-broker TopicSyncMap, connections/mod.rs:40-53).
     topic_sync_map: VersionedMap = None
+
+
+# Bound on the typed route-delta log (ISSUE 7). A consumer that falls
+# further behind than this rebuilds from scratch (version gap) instead of
+# the log growing without bound; sized so steady churn never trims a
+# snapshot that refreshes once per plan call.
+ROUTE_LOG_MAX = int(os.environ.get("PUSHCDN_ROUTE_LOG_MAX", "8192") or 8192)
 
 
 class Connections:
@@ -101,10 +110,33 @@ class Connections:
         # local routing-state mutation is mirrored to sibling shards as a
         # versioned delta via the parent hub
         self.shard_notifier = None
+        # ---- typed route-delta log (ISSUE 7) ----
+        # Every interest/DirectMap mutation appends a typed record naming
+        # the entity whose routing contribution may have changed:
+        #   ("user", key)     membership / shard residency / topic set
+        #   ("broker", ident) link / shard residency / advertised topics
+        #   ("dmap", key)     DirectMap ownership entry
+        # Consumers (cutthrough.RouteState) re-resolve each named entity
+        # against CURRENT state, so application is order-insensitive and
+        # O(dirty entities) — the incremental alternative to the
+        # O(users + brokers + DirectMap) snapshot rebuild. Records are
+        # sequence-numbered; a consumer whose cursor predates
+        # ``route_log_start`` has a version gap and must rebuild.
+        self.route_log: Deque[tuple] = deque()
+        self.route_log_start = 0     # seq of route_log[0]
+        self.route_log_next = 0      # seq the next record gets
 
     def _notify_shards(self, event: tuple) -> None:
         if self.shard_notifier is not None:
             self.shard_notifier(event)
+
+    def _log_route(self, kind: str, ident) -> None:
+        """Append one typed route delta (and trim the log to its bound)."""
+        self.route_log.append((kind, ident))
+        self.route_log_next += 1
+        if len(self.route_log) > ROUTE_LOG_MAX:
+            self.route_log.popleft()
+            self.route_log_start += 1
 
     # ---- users ------------------------------------------------------------
 
@@ -130,6 +162,8 @@ class Connections:
         if topics:
             self.user_topics.associate_key_with_values(public_key, topics)
         self.direct_map.insert(public_key, self.identity)
+        self._log_route("user", public_key)
+        self._log_route("dmap", public_key)
         if self.observer is not None:
             self.observer.on_user_added(public_key, topics)
         self._notify_shards(("user", public_key, list(topics)))
@@ -146,6 +180,8 @@ class Connections:
         # Release our DirectMap claim only if we still hold it — a newer
         # claim by another broker must not be clobbered.
         self.direct_map.remove_if_equals(public_key, self.identity)
+        self._log_route("user", public_key)
+        self._log_route("dmap", public_key)
         if self.observer is not None:
             self.observer.on_user_removed(public_key)
         self._notify_shards(("user_del", public_key))
@@ -176,6 +212,10 @@ class Connections:
         self.brokers[identifier] = BrokerHandle(
             connection, abort_handle,
             topic_sync_map=VersionedMap(local_identity=identifier))
+        # the new link also makes DirectMap entries owned by this peer
+        # resolvable — RouteState's owner index re-resolves them off this
+        # one record
+        self._log_route("broker", identifier)
         self._notify_shards(("mesh_topics", identifier, []))
         logger.info("broker %s connected", identifier)
 
@@ -190,6 +230,11 @@ class Connections:
         # owned — they will re-appear when they reconnect elsewhere
         # (remove_by_value_no_modify, versioned_map.rs).
         dropped = self.direct_map.remove_by_value_no_modify(identifier)
+        self._log_route("broker", identifier)
+        # per-dropped-key records, proportional to the actual forget work
+        # (a mass drop that outruns the log bound falls back to a rebuild)
+        for key in dropped:
+            self._log_route("dmap", key)
         self._notify_shards(("mesh_broker_del", identifier))
         logger.info("broker %s removed (%s); forgot %d routed users",
                     identifier, reason, len(dropped))
@@ -217,6 +262,7 @@ class Connections:
             self.users[public_key].connection.flightrec.record(
                 "subscribe", topics)
             self.user_topics.associate_key_with_values(public_key, topics)
+            self._log_route("user", public_key)
             if self.observer is not None:
                 self.observer.on_subscription_changed(
                     public_key, self.user_topics.get_values_of_key(public_key))
@@ -232,6 +278,7 @@ class Connections:
             if handle is not None:
                 handle.connection.flightrec.record("unsubscribe", topics)
             self.user_topics.dissociate_key_from_values(public_key, topics)
+            self._log_route("user", public_key)
             if self.observer is not None:
                 self.observer.on_subscription_changed(
                     public_key, self.user_topics.get_values_of_key(public_key))
@@ -244,12 +291,14 @@ class Connections:
         if identifier in self.brokers and topics:
             self.interest_version += 1
             self.broker_topics.associate_key_with_values(identifier, topics)
+            self._log_route("broker", identifier)
 
     def unsubscribe_broker_from(self, identifier: str,
                                 topics: List[Topic]) -> None:
         if topics:
             self.interest_version += 1
             self.broker_topics.dissociate_key_from_values(identifier, topics)
+            self._log_route("broker", identifier)
 
     # ---- sibling-shard delta application (ISSUE 6) -------------------------
     # Called by ShardRuntime.apply_event with state relayed from sibling
@@ -277,6 +326,8 @@ class Connections:
             # shard 0 fronts the mesh: its DirectMap replica must claim
             # every shard's users so UserSync advertises the whole box
             self.direct_map.insert(public_key, self.identity)
+        self._log_route("user", public_key)
+        self._log_route("dmap", public_key)
 
     def remove_remote_user(self, public_key: UserPublicKey,
                            shard: int) -> None:
@@ -290,6 +341,8 @@ class Connections:
         self.user_topics.remove_key(public_key)
         if self.shard_id == 0:
             self.direct_map.remove_if_equals(public_key, self.identity)
+        self._log_route("user", public_key)
+        self._log_route("dmap", public_key)
 
     def set_remote_broker(self, identifier: str, shard: int,
                           topics: List[Topic]) -> None:
@@ -304,14 +357,20 @@ class Connections:
         if topics:
             self.broker_topics.associate_key_with_values(identifier,
                                                          list(topics))
+        self._log_route("broker", identifier)
 
     def remove_remote_broker(self, identifier: str) -> None:
         self.interest_version += 1
         self.remote_broker_shard.pop(identifier, None)
         self.broker_topics.remove_key(identifier)
         # same local forget as remove_broker: users the dead peer owned
-        # reappear when they reconnect elsewhere
-        self.direct_map.remove_by_value_no_modify(identifier)
+        # reappear when they reconnect elsewhere. The dropped claims get
+        # per-key records — the peer may ALSO hold a live local link (no
+        # slot transition for the owner index to re-resolve through)
+        dropped = self.direct_map.remove_by_value_no_modify(identifier)
+        self._log_route("broker", identifier)
+        for key in dropped:
+            self._log_route("dmap", key)
 
     @property
     def num_users_global(self) -> int:
@@ -392,6 +451,7 @@ class Connections:
                 self._notify_shards(("usersync", bytes(payload)))
         evict: List[UserPublicKey] = []
         for key, _old, new in changed:
+            self._log_route("dmap", key)
             if new is not None and new != self.identity and key in self.users:
                 evict.append(key)
             if new is not None and new != self.identity:
@@ -400,6 +460,7 @@ class Connections:
                 # routing stops ring-forwarding to a shard that lost it
                 if self.remote_user_shard.pop(key, None) is not None:
                     self.user_topics.remove_key(key)
+                    self._log_route("user", key)
         for key in evict:
             logger.info("user %s connected elsewhere (%s); evicting",
                         mnemonic(key), self.direct_map.get(key))

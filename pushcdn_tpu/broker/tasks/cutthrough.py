@@ -38,6 +38,7 @@ scalar). Observability: ``cdn_route_batch_*`` counters via ``/metrics``.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 import os
 import time
@@ -84,14 +85,32 @@ ROUTE_IMPL = {"1": "native", "0": "python", "true": "native",
 _MODE_USER = 0    # user-origin: Direct anywhere, Broadcast users+brokers
 _MODE_BROKER = 1  # broker-origin: local users only (loop prevention)
 
-# Rebuild churn guard: a snapshot rebuild is O(users + brokers + DirectMap
-# entries). When the previous snapshot amortized over fewer than
-# _REBUILD_MIN_FRAMES planned frames (a client interleaving control frames
-# with traffic, gossip-heavy DirectMap churn), the next _REBUILD_BACKOFF
-# invalidations route scalar instead of paying another full rebuild — the
-# scalar path is always correct, so the guard only trades speed.
+# Incremental route-state maintenance (ISSUE 7): Connections mutations
+# append typed deltas to a bounded log, and _refresh applies the suffix
+# IN PLACE to the native table (stored masks diffed, lazy-deleted index
+# entries, tombstoned dmap) — O(delta), never O(users). "0" forces the
+# pre-ISSUE-7 rebuild-per-invalidation behavior (the churn bench's
+# baseline twin; the churn guard below is only live in that mode).
+_env_inc = os.environ.get("PUSHCDN_ROUTE_INCREMENTAL", "1").strip().lower()
+ROUTE_INCREMENTAL = _env_inc not in ("0", "false", "off")
+
+# Rebuild churn guard — DEMOTED to last resort (ISSUE 7): with incremental
+# deltas an invalidation costs O(delta), so the guard only arms on the
+# rebuild-per-invalidation baseline path (ROUTE_INCREMENTAL off), where a
+# snapshot rebuild is still O(users + brokers + DirectMap entries). When
+# the previous snapshot amortized over fewer than _REBUILD_MIN_FRAMES
+# planned frames, the next _REBUILD_BACKOFF invalidations route scalar
+# instead of paying another full rebuild — the scalar path is always
+# correct, so the guard only trades speed.
 _REBUILD_MIN_FRAMES = 64
 _REBUILD_BACKOFF = 16
+
+# Compaction policy (checked every _COMPACT_CHECK_EVERY delta batches):
+# lazy deletion and blob appends accrue garbage the plan loop must skip;
+# a full rebuild purges it once it outweighs the live state.
+_COMPACT_CHECK_EVERY = 64
+
+_ZERO_MASK = np.zeros(routeplan.MASK_WORDS, np.uint64)  # reused, read-only
 
 _warned_unavailable = False
 
@@ -131,27 +150,62 @@ class RouteState:
     (egress awaits can park the drain while another task mutates routing
     state), so a stale snapshot can never route a frame the scalar
     path's per-message version check would have routed differently.
+
+    Maintenance is INCREMENTAL (ISSUE 7): peers occupy stable SLOTS
+    (free-listed; ``n_users``/``n_brokers`` passed to the native build are
+    capacities), and ``_refresh`` applies the ``Connections.route_log``
+    suffix in place — each typed record names an entity (user / broker /
+    DirectMap key) that is re-resolved against CURRENT Connections state,
+    so application is order-insensitive and O(dirty entities). Full
+    rebuilds remain only as the fallback: first build, version gap (log
+    trimmed past our cursor), delta overflow (suffix longer than a
+    rebuild costs), slot-capacity growth, and periodic compaction (lazy
+    deletions / dmap tombstones / blob garbage crossed the purge
+    threshold) — each counted under
+    ``cdn_route_table_rebuilds{reason=...}``.
     """
 
-    __slots__ = ("broker", "planner", "version", "user_keys", "broker_ids",
-                 "usable", "_frames_since_rebuild", "_skip_rebuilds",
-                 "built_at", "n_local_users", "n_local_brokers",
-                 "remote_user_shards", "remote_broker_shards")
+    __slots__ = ("broker", "planner", "version", "usable",
+                 "user_cap", "user_slot", "slot_user", "user_free",
+                 "user_shard",
+                 "broker_cap", "broker_slot", "slot_broker", "broker_free",
+                 "broker_shard",
+                 "dmap_mirror", "owner_keys", "log_seq",
+                 "deltas_applied", "rebuild_counts", "last_delta_apply_s",
+                 "_applies_since_compact_check", "_rebuild_reason",
+                 "_frames_since_rebuild", "_skip_rebuilds",
+                 "built_at")
 
     def __init__(self, broker: "Broker", planner):
         self.broker = broker
         self.planner = planner
         self.version = -1
-        # peer index space: [local users][sibling-shard users][local
-        # broker links][mesh brokers held by another shard]. The planner
-        # only distinguishes users (< n_users) from brokers — sibling
-        # users count as users so broker-origin frames still reach them.
-        self.user_keys: List[bytes] = []
-        self.broker_ids: List[str] = []
-        self.n_local_users = 0
-        self.n_local_brokers = 0
-        self.remote_user_shards: List[int] = []
-        self.remote_broker_shards: List[int] = []
+        # peer slot space: users [0, user_cap), brokers [user_cap,
+        # user_cap + broker_cap). The planner only distinguishes users
+        # (< n_users == user_cap) from brokers — sibling-shard users count
+        # as users so broker-origin frames still reach them; per-slot
+        # shard arrays say whether egress is local or rides the ring.
+        self.user_cap = 0
+        self.user_slot: dict = {}          # key -> slot
+        self.slot_user: List[Optional[bytes]] = []
+        self.user_free: List[int] = []
+        self.user_shard: List[int] = []    # == conns.shard_id -> local
+        self.broker_cap = 0
+        self.broker_slot: dict = {}        # ident -> slot (0-based)
+        self.slot_broker: List[Optional[str]] = []
+        self.broker_free: List[int] = []
+        self.broker_shard: List[Optional[int]] = []  # None -> local link
+        # DirectMap mirror + owner inverse index: which snapshot keys an
+        # owner's entries resolve through, so a mesh link flap re-resolves
+        # exactly its own keys (never a full-map scan)
+        self.dmap_mirror: dict = {}        # key bytes -> owner str
+        self.owner_keys: dict = {}         # owner str -> set of key bytes
+        self.log_seq = 0                   # route_log cursor (next unseen)
+        self.deltas_applied = 0
+        self.rebuild_counts: dict = {}
+        self.last_delta_apply_s: Optional[float] = None
+        self._applies_since_compact_check = 0
+        self._rebuild_reason: Optional[str] = None
         self.usable = True
         # cold start counts as amortized: the first build must not arm
         # the churn backoff
@@ -161,16 +215,27 @@ class RouteState:
 
     def summary(self) -> dict:
         """Operator-facing snapshot state for ``/debug/topology``."""
+        conns = self.broker.connections
         return {
             "usable": self.usable,
+            "incremental": ROUTE_INCREMENTAL,
             "snapshot_version": self.version,
-            "interest_version": self.broker.connections.interest_version,
+            "interest_version": conns.interest_version,
             "snapshot_age_s": (round(time.monotonic() - self.built_at, 3)
                                if self.built_at is not None else None),
             "churn_guard_skips_left": self._skip_rebuilds,
             "frames_since_rebuild": min(self._frames_since_rebuild, 1 << 30),
-            "snapshot_users": len(self.user_keys),
-            "snapshot_brokers": len(self.broker_ids),
+            "snapshot_users": len(self.user_slot),
+            "snapshot_brokers": len(self.broker_slot),
+            "slot_capacity": {"users": self.user_cap,
+                              "brokers": self.broker_cap},
+            "deltas_applied": self.deltas_applied,
+            "last_delta_apply_s": self.last_delta_apply_s,
+            "delta_log": {"start": conns.route_log_start,
+                          "next": conns.route_log_next,
+                          "cursor": self.log_seq},
+            "rebuilds": dict(self.rebuild_counts),
+            "index": self.planner.stats() if self.usable else None,
         }
 
     # -- snapshot ------------------------------------------------------------
@@ -179,11 +244,75 @@ class RouteState:
         conns = self.broker.connections
         if self.version == conns.interest_version and self.usable:
             return True
+        if ROUTE_INCREMENTAL and self.usable and self.version >= 0:
+            # incremental path: apply the route-log suffix in place
+            if self.log_seq < conns.route_log_start:
+                return self._storm_rebuild("version_gap")
+            pending = list(itertools.islice(
+                conns.route_log, self.log_seq - conns.route_log_start,
+                None))
+            # past this many dirty entities a rebuild is the cheaper
+            # O(users) operation (and resets slot packing for free)
+            threshold = max(256, (len(self.user_slot)
+                                  + len(self.broker_slot)) // 2)
+            if len(pending) > threshold:
+                return self._storm_rebuild("delta_overflow")
+            if self._apply_deltas(pending):
+                self.version = conns.interest_version
+                self.log_seq = conns.route_log_next
+                self._applies_since_compact_check += 1
+                if self._applies_since_compact_check \
+                        >= _COMPACT_CHECK_EVERY:
+                    self._applies_since_compact_check = 0
+                    if self._needs_compaction():
+                        return self._rebuild("compaction")
+                return True
+            return self._rebuild(self._rebuild_reason or "growth")
+        # full-rebuild path: first build, incremental disabled, or the
+        # previous build failed. Only HERE does the (demoted) churn guard
+        # apply — the rebuild-per-invalidation baseline's backoff.
         if self._skip_rebuilds > 0:
-            # churn backoff: the last snapshot didn't amortize — route
-            # scalar for this invalidation instead of rebuilding again
             self._skip_rebuilds -= 1
             return False
+        if self.version < 0:
+            reason = "first_build"
+        elif not self.usable:
+            reason = "retry"
+        else:
+            reason = "incremental_disabled"
+        return self._rebuild(reason)
+
+    def _storm_rebuild(self, reason: str) -> bool:
+        """Fallback rebuild for the two EXTERNALLY-DRIVEN reasons
+        (version gap / delta overflow): unlike growth or compaction —
+        which are self-limiting by construction (capacity headroom grows
+        25% per rebuild; a rebuild zeroes the garbage counters) — these
+        recur at whatever rate the outside churn sustains, so the
+        demoted churn guard still throttles them as the last resort: a
+        rebuild that never amortized (< _REBUILD_MIN_FRAMES planned
+        since) sends the next _REBUILD_BACKOFF invalidations to the
+        always-correct scalar path instead of paying back-to-back
+        O(users) rebuilds that would stall the loop."""
+        if self._skip_rebuilds > 0:
+            self._skip_rebuilds -= 1
+            return False
+        return self._rebuild(reason)
+
+    def _needs_compaction(self) -> bool:
+        """Garbage-vs-live thresholds over the native occupancy counters:
+        lazy-deleted / duplicated index entries, dmap tombstones, and
+        key-blob garbage are all purged by one rebuild."""
+        s = self.planner.stats()
+        return (s["list_entries"] > 2 * s["live_subs"] + 1024
+                or s["dmap_tombstones"] > s["dmap_live"] + 64
+                or s["keys_blob_garbage"]
+                > s["keys_blob_bytes"] // 2 + 4096)
+
+    def _rebuild(self, reason: str) -> bool:
+        """Full snapshot rebuild (the fallback + compactor). Slots are
+        re-packed densely with free-list headroom so steady growth does
+        not rebuild per connection."""
+        conns = self.broker.connections
         local_users = list(conns.users.keys())
         remote_users = list(conns.remote_user_shard.keys())
         users = local_users + remote_users
@@ -192,26 +321,49 @@ class RouteState:
                           if ident not in conns.brokers]
         brokers = local_brokers + remote_brokers
         n_u, n_b = len(users), len(brokers)
-        peer_masks = np.zeros((max(n_u + n_b, 1), routeplan.MASK_WORDS),
+        user_cap = max(16, n_u + max(n_u // 4, 64))
+        broker_cap = max(8, n_b + max(n_b // 4, 16))
+        peer_masks = np.zeros((user_cap + broker_cap, routeplan.MASK_WORDS),
                               np.uint64)
+        local_shard = conns.shard_id
+        slot_user: List[Optional[bytes]] = [None] * user_cap
+        user_shard = [local_shard] * user_cap
+        user_slot: dict = {}
         for i, key in enumerate(users):
             topics = conns.user_topics.get_values_of_key(key)
             if topics:
                 peer_masks[i] = routeplan.topic_mask(topics)
+            slot_user[i] = key
+            user_slot[key] = i
+            if i >= len(local_users):
+                user_shard[i] = conns.remote_user_shard[key]
+        slot_broker: List[Optional[str]] = [None] * broker_cap
+        broker_shard: List[Optional[int]] = [None] * broker_cap
+        broker_slot: dict = {}
         for j, ident in enumerate(brokers):
             topics = conns.broker_topics.get_values_of_key(ident)
             if topics:
-                peer_masks[n_u + j] = routeplan.topic_mask(topics)
+                peer_masks[user_cap + j] = routeplan.topic_mask(topics)
+            slot_broker[j] = ident
+            broker_slot[ident] = j
+            if j >= len(local_brokers):
+                broker_shard[j] = conns.remote_broker_shard[ident]
         valid = routeplan.topic_mask(self.broker.run_def.topics.valid)
-        user_index = {key: i for i, key in enumerate(users)}
-        broker_index = {ident: n_u + j for j, ident in enumerate(brokers)}
         identity = conns.identity
         dmap: dict = {}
+        mirror: dict = {}
+        owner_keys: dict = {}
         for key, owner in conns.direct_map.items():
-            peer = user_index.get(key) if owner == identity \
-                else broker_index.get(owner)
+            bkey = bytes(key)
+            mirror[bkey] = owner
+            if owner == identity:
+                peer = user_slot.get(key)
+            else:
+                owner_keys.setdefault(owner, set()).add(bkey)
+                b = broker_slot.get(owner)
+                peer = None if b is None else user_cap + b
             if peer is not None:
-                dmap[bytes(key)] = peer
+                dmap[bkey] = peer
             # unresolvable owner (user/broker not connected): omitted — a
             # plan miss drops the frame, exactly like the scalar flush
             # finding no connection
@@ -219,28 +371,177 @@ class RouteState:
         # (only shard 0 mirrors the claims for the mesh) — add them so
         # Direct frames plan straight onto the ring
         for key in remote_users:
-            dmap.setdefault(bytes(key), user_index[key])
+            dmap.setdefault(bytes(key), user_slot[key])
         dkeys = list(dmap.keys())
         owners = list(dmap.values())
         self.usable = self.planner.build(
-            n_u, n_b, valid, peer_masks, dkeys,
+            user_cap, broker_cap, valid, peer_masks, dkeys,
             np.asarray(owners, np.int32))
         if self.usable:
             self.version = conns.interest_version
-            self.user_keys = users
-            self.broker_ids = brokers
-            self.n_local_users = len(local_users)
-            self.n_local_brokers = len(local_brokers)
-            self.remote_user_shards = [conns.remote_user_shard[k]
-                                       for k in remote_users]
-            self.remote_broker_shards = [conns.remote_broker_shard[i]
-                                         for i in remote_brokers]
+            self.log_seq = conns.route_log_next
+            self.user_cap = user_cap
+            self.user_slot = user_slot
+            self.slot_user = slot_user
+            self.user_free = list(range(user_cap - 1, n_u - 1, -1))
+            self.user_shard = user_shard
+            self.broker_cap = broker_cap
+            self.broker_slot = broker_slot
+            self.slot_broker = slot_broker
+            self.broker_free = list(range(broker_cap - 1, n_b - 1, -1))
+            self.broker_shard = broker_shard
+            self.dmap_mirror = mirror
+            self.owner_keys = owner_keys
+            self._rebuild_reason = None
+            self._applies_since_compact_check = 0
             self.built_at = time.monotonic()
-            metrics_mod.ROUTE_TABLE_REBUILDS.inc()
-            if self._frames_since_rebuild < _REBUILD_MIN_FRAMES:
+            self.rebuild_counts[reason] = \
+                self.rebuild_counts.get(reason, 0) + 1
+            metrics_mod.ROUTE_TABLE_REBUILDS.labels(reason=reason).inc()
+            if self._frames_since_rebuild < _REBUILD_MIN_FRAMES \
+                    and (not ROUTE_INCREMENTAL
+                         or reason in ("version_gap", "delta_overflow")):
                 self._skip_rebuilds = _REBUILD_BACKOFF
             self._frames_since_rebuild = 0
         return self.usable
+
+    def _resolve_dmap_peer(self, bkey: bytes, owner: Optional[str],
+                           local_shard: int) -> Optional[int]:
+        """Current peer slot a DirectMap key routes to, mirroring the
+        rebuild's resolution rules exactly: the owner wins when resolvable
+        (self -> the user's own slot, remote -> the owning broker's link
+        slot), and a sibling-shard RESIDENT without a resolvable owner
+        gets the membership-implied entry straight onto the ring."""
+        if owner == self.broker.connections.identity:
+            return self.user_slot.get(bkey)
+        if owner is not None:
+            b = self.broker_slot.get(owner)
+            if b is not None:
+                return self.user_cap + b
+        slot = self.user_slot.get(bkey)
+        if slot is not None and self.user_shard[slot] != local_shard:
+            return slot
+        return None
+
+    def _apply_deltas(self, records: list) -> bool:
+        """Apply one route-log suffix IN PLACE. Re-resolves every named
+        entity against current Connections state (order-insensitive), then
+        ships the whole batch to the native table in ONE call. Returns
+        False when a rebuild is required (slot growth, native alloc
+        failure) — ``_rebuild_reason`` says why."""
+        conns = self.broker.connections
+        t0 = time.perf_counter()
+        dirty_users: set = set()
+        dirty_brokers: set = set()
+        dirty_keys: set = set()
+        for kind, ident in records:
+            if kind == "user":
+                dirty_users.add(ident)
+            elif kind == "broker":
+                dirty_brokers.add(ident)
+            else:
+                dirty_keys.add(ident)
+        upd_peers: List[int] = []
+        upd_masks: List[np.ndarray] = []
+        # brokers first: a link transition re-resolves exactly the keys
+        # its DirectMap entries own (the owner inverse index)
+        for ident in dirty_brokers:
+            slot = self.broker_slot.get(ident)
+            if ident in conns.brokers:
+                shard: Optional[int] = None
+            else:
+                shard = conns.remote_broker_shard.get(ident)
+                if shard is None:  # link gone everywhere: free the slot
+                    if slot is not None:
+                        del self.broker_slot[ident]
+                        self.slot_broker[slot] = None
+                        self.broker_shard[slot] = None
+                        self.broker_free.append(slot)
+                        upd_peers.append(self.user_cap + slot)
+                        upd_masks.append(_ZERO_MASK)
+                        dirty_keys.update(self.owner_keys.get(ident, ()))
+                    continue
+            if slot is None:
+                if not self.broker_free:
+                    self._rebuild_reason = "growth"
+                    return False
+                slot = self.broker_free.pop()
+                self.broker_slot[ident] = slot
+                self.slot_broker[slot] = ident
+                dirty_keys.update(self.owner_keys.get(ident, ()))
+            self.broker_shard[slot] = shard
+            upd_peers.append(self.user_cap + slot)
+            upd_masks.append(routeplan.topic_mask(
+                conns.broker_topics.get_values_of_key(ident)))
+        local_shard = conns.shard_id
+        for key in dirty_users:
+            slot = self.user_slot.get(key)
+            if key in conns.users:
+                shard = local_shard
+            else:
+                shard = conns.remote_user_shard.get(key)
+            if shard is None:  # gone from every shard: free the slot
+                if slot is not None:
+                    del self.user_slot[key]
+                    self.slot_user[slot] = None
+                    self.user_free.append(slot)
+                    upd_peers.append(slot)
+                    upd_masks.append(_ZERO_MASK)
+                    dirty_keys.add(key)
+                continue
+            if slot is None:
+                if not self.user_free:
+                    self._rebuild_reason = "growth"
+                    return False
+                slot = self.user_free.pop()
+                self.user_slot[key] = slot
+                self.slot_user[slot] = key
+                dirty_keys.add(key)
+            elif self.user_shard[slot] != shard:
+                # residency flip: the membership-implied dmap entry may
+                # appear/disappear with it
+                dirty_keys.add(key)
+            self.user_shard[slot] = shard
+            upd_peers.append(slot)
+            upd_masks.append(routeplan.topic_mask(
+                conns.user_topics.get_values_of_key(key)))
+        dkeys: List[bytes] = []
+        downers: List[int] = []
+        identity = conns.identity
+        for key in dirty_keys:
+            bkey = bytes(key)
+            new_owner = conns.direct_map.get(key)
+            old_owner = self.dmap_mirror.get(bkey)
+            if old_owner != new_owner:
+                if old_owner is not None and old_owner != identity:
+                    keyset = self.owner_keys.get(old_owner)
+                    if keyset is not None:
+                        keyset.discard(bkey)
+                        if not keyset:
+                            del self.owner_keys[old_owner]
+                if new_owner is None:
+                    self.dmap_mirror.pop(bkey, None)
+                else:
+                    self.dmap_mirror[bkey] = new_owner
+                    if new_owner != identity:
+                        self.owner_keys.setdefault(new_owner,
+                                                   set()).add(bkey)
+            peer = self._resolve_dmap_peer(bkey, new_owner, local_shard)
+            dkeys.append(bkey)
+            downers.append(-1 if peer is None else peer)
+        if upd_peers or dkeys:
+            if not self.planner.apply(upd_peers, upd_masks, dkeys,
+                                      downers):
+                self._rebuild_reason = "retry"
+                return False
+        n = len(records)
+        self.deltas_applied += n
+        dt = time.perf_counter() - t0
+        self.last_delta_apply_s = round(dt, 6)
+        if n:
+            metrics_mod.ROUTE_DELTAS_APPLIED.inc(n)
+        metrics_mod.ROUTE_DELTA_APPLY_SECONDS.observe(dt)
+        return True
 
     # -- egress --------------------------------------------------------------
 
@@ -261,9 +562,12 @@ class RouteState:
         # concurrent drain (another connection's receive loop running
         # during a send await) may re-plan or rebuild, so nothing below
         # the first await may touch planner scratch or snapshot state.
-        n_users = self.planner.n_users
-        n_local_u = self.n_local_users
-        n_local_b = self.n_local_brokers
+        user_cap = self.user_cap
+        local_shard = broker.connections.shard_id
+        slot_user = self.slot_user
+        slot_broker = self.slot_broker
+        user_shard = self.user_shard
+        broker_shard = self.broker_shard
         order = np.argsort(peers, kind="stable")
         speers = peers[order]
         sframes = frames[order]
@@ -277,29 +581,35 @@ class RouteState:
         for s, e in zip(starts.tolist(), ends.tolist()):
             peer = int(speers[s])
             idx = sframes[s:e]
-            if peer < n_users:
-                if peer >= n_local_u:
+            if peer < user_cap:
+                key = slot_user[peer]
+                if key is None:
+                    continue  # freed slot raced the plan: drop (defensive)
+                shard = user_shard[peer]
+                if shard != local_shard:
                     # sibling-shard user: cross-shard handoff (collected
                     # per shard; written to the ring below, still inside
                     # the synchronous phase — idx is COPIED because the
                     # pair arrays are reusable planner scratch)
-                    shard = self.remote_user_shards[peer - n_local_u]
                     if ring is None:
                         ring = {}
                     ring.setdefault(shard, []).append(
-                        (0, bytes(self.user_keys[peer]), idx.copy()))
+                        (0, bytes(key), idx.copy()))
                     continue
-                target = (True, self.user_keys[peer])
+                target = (True, key)
             else:
-                b = peer - n_users
-                if b >= n_local_b:
-                    shard = self.remote_broker_shards[b - n_local_b]
+                b = peer - user_cap
+                ident = slot_broker[b]
+                if ident is None:
+                    continue  # freed slot: drop (defensive)
+                shard = broker_shard[b]
+                if shard is not None:
                     if ring is None:
                         ring = {}
                     ring.setdefault(shard, []).append(
-                        (1, self.broker_ids[b].encode(), idx.copy()))
+                        (1, ident.encode(), idx.copy()))
                     continue
-                target = (False, self.broker_ids[b])
+                target = (False, ident)
             first, last = int(idx[0]), int(idx[-1])
             if last - first + 1 == len(idx):
                 # contiguous run: the chunk's own bytes ARE the wire
@@ -354,10 +664,11 @@ class RouteState:
 
     def _route_one_scalar(self, sender_id, message, raw: Bytes,
                           is_user: bool, egress: EgressBatch,
-                          interest_cache: dict) -> bool:
+                          interest_cache: dict, conn=None) -> bool:
         """Route ONE already-deserialized message with the scalar rules
         (no device plane, no-op hook — both guaranteed by ``acquire``).
-        Returns False when the sender must be disconnected."""
+        Returns False when the sender must be disconnected. ``conn`` is
+        the sender's own connection (the admission token bucket's seat)."""
         broker = self.broker
         topics_space = broker.run_def.topics
         if isinstance(message, Direct):
@@ -397,10 +708,18 @@ class RouteState:
             pruned, bad = topics_space.prune(message.topics)
             if bad:
                 return False  # unknown topic ⇒ disconnect (scalar parity)
-            broker.connections.subscribe_user_to(sender_id, pruned)
+            adm = broker.admission
+            if adm is not None and not adm.allow_subscribe(conn):
+                adm.shed_subscribe(sender_id, conn, egress)  # ISSUE 7
+            else:
+                broker.connections.subscribe_user_to(sender_id, pruned)
         elif is_user and isinstance(message, Unsubscribe):
-            pruned, _bad = topics_space.prune(message.topics)
-            broker.connections.unsubscribe_user_from(sender_id, pruned)
+            adm = broker.admission
+            if adm is not None and not adm.allow_subscribe(conn):
+                adm.shed_subscribe(sender_id, conn, egress)
+            else:
+                pruned, _bad = topics_space.prune(message.topics)
+                broker.connections.unsubscribe_user_from(sender_id, pruned)
         elif not is_user and isinstance(message, UserSync):
             broker.connections.apply_user_sync(message.payload)
             broker.update_metrics()
@@ -464,7 +783,7 @@ class RouteState:
                         else:
                             alive = self._route_one_scalar(
                                 sender_id, message, item, is_user, egress,
-                                interest_cache)
+                                interest_cache, conn)
                     finally:
                         item.release()
                     if not alive:
@@ -572,12 +891,12 @@ class RouteState:
                     frame = Bytes(buf[o:o + ln])
                     alive = self._route_one_scalar(sender_id, message,
                                                    frame, is_user, egress,
-                                                   interest_cache)
+                                                   interest_cache, conn)
                     frame.release()
                 else:
                     alive = self._route_one_scalar(sender_id, message,
                                                    None, is_user, egress,
-                                                   interest_cache)
+                                                   interest_cache, conn)
                 if not alive:
                     return False
                 # A residual hot frame (traced, or the defensive case)
@@ -621,11 +940,13 @@ class RouteState:
             if isinstance(message, (Direct, Broadcast)):
                 frame = Bytes(buf[o:o + ln])
                 ok = self._route_one_scalar(sender_id, message, frame,
-                                            is_user, egress, interest_cache)
+                                            is_user, egress,
+                                            interest_cache, conn)
                 frame.release()
             else:
                 ok = self._route_one_scalar(sender_id, message, None,
-                                            is_user, egress, interest_cache)
+                                            is_user, egress,
+                                            interest_cache, conn)
             if not ok:
                 return False
         return True

@@ -514,9 +514,23 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                             # behavior: invalid-topic subscriptions kick)
                             alive = False
                             break
+                        adm = broker.admission
+                        if adm is not None and \
+                                not adm.allow_subscribe(connection):
+                            # over-rate: drop the mutation, notify typed
+                            # through the ordered egress path (ISSUE 7)
+                            adm.shed_subscribe(public_key, connection,
+                                               egress)
+                            continue
                         broker.connections.subscribe_user_to(public_key,
                                                              pruned)
                     elif isinstance(message, Unsubscribe):
+                        adm = broker.admission
+                        if adm is not None and \
+                                not adm.allow_subscribe(connection):
+                            adm.shed_subscribe(public_key, connection,
+                                               egress)
+                            continue
                         pruned, _bad = topics.prune(message.topics)
                         broker.connections.unsubscribe_user_from(public_key,
                                                                  pruned)

@@ -18,6 +18,7 @@ from pushcdn_tpu.broker.tasks import sync as sync_task
 from pushcdn_tpu.broker.tasks.handlers import broker_receive_loop, user_receive_loop
 from pushcdn_tpu.proto.auth import broker as broker_auth
 from pushcdn_tpu.proto.error import Error
+from pushcdn_tpu.proto.message import AuthenticateResponse
 from pushcdn_tpu.proto.util import AbortOnDropHandle, mnemonic
 
 if TYPE_CHECKING:
@@ -42,6 +43,23 @@ async def handle_user_connection(broker: "Broker", unfinalized) -> None:
     connection = None
     try:
         connection = await unfinalized.finalize(broker.limiter)
+        # admission control (ISSUE 7): an over-budget connection is shed
+        # BEFORE the auth handshake — no signature verify or discovery
+        # round-trip spent on a connection we won't keep. The typed
+        # refusal (permit=0 + reason) is what the client library surfaces
+        # as Error(AUTHENTICATION) and re-load-balances on.
+        adm = broker.admission
+        shed = adm.admit_user() if adm is not None else None
+        if shed is not None:
+            connection.flightrec.record("load-shed", shed, abnormal=True)
+            try:
+                await connection.send_message(
+                    AuthenticateResponse(permit=0, context=shed),
+                    flush=True)
+            except Exception:
+                pass
+            connection.close()
+            return
         async with asyncio.timeout(broker.config.auth_timeout_s):
             public_key, topics = await broker_auth.verify_user(
                 connection, broker.discovery, broker.identity)
@@ -103,6 +121,17 @@ async def handle_broker_connection(broker: "Broker", connection_or_unfinalized,
             connection = connection_or_unfinalized
         else:
             connection = await connection_or_unfinalized.finalize(broker.limiter)
+            # broker-tier budget (inbound only — a link WE dialed was a
+            # deliberate mesh decision): over budget, the link is closed
+            # pre-auth; the dialer's next heartbeat retries
+            adm = broker.admission
+            shed = adm.admit_broker() if adm is not None else None
+            if shed is not None:
+                connection.flightrec.record("load-shed", shed,
+                                            abnormal=True)
+                logger.warning("inbound broker link refused: %s", shed)
+                connection.close()
+                return
         async with asyncio.timeout(broker.config.auth_timeout_s):
             if outbound:
                 peer = await broker_auth.authenticate_as_dialer(

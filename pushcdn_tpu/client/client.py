@@ -31,6 +31,7 @@ from pushcdn_tpu.proto.error import Error, ErrorKind, bail
 from pushcdn_tpu.proto.limiter import Limiter, NO_LIMIT
 from pushcdn_tpu.proto.auth import user as user_auth
 from pushcdn_tpu.proto.message import (
+    AuthenticateResponse,
     Broadcast,
     Direct,
     Message,
@@ -73,6 +74,26 @@ class Client:
         # first publish after a (re)connect reuses the connection's trace
         # id so the marshal-auth span chains to a message lifecycle
         self._sampler = trace_mod.Sampler()
+        # a broker load-shed notice that arrived in the same batch as
+        # real deliveries: the deliveries are returned first, the typed
+        # Error(SHED) raises on the next receive call (ISSUE 7)
+        self._pending_shed: Optional[Error] = None
+        # once the broker has shed ANY mutation on this connection, the
+        # optimistic local topic mirror can no longer be trusted (the
+        # notice doesn't say which mutation was dropped) — until the next
+        # reconnect replays the full set, subscribe/unsubscribe send the
+        # requested topics verbatim instead of the delta
+        self._topics_dirty = False
+
+    def _shed_error(self, message: AuthenticateResponse) -> Error:
+        """A post-handshake ``permit=0`` response is the broker's typed
+        load-shed notice (ISSUE 7): the request (e.g. a subscribe) was
+        REFUSED but the connection is still live — surface it as
+        ``Error(SHED)`` without tearing the connection down (reconnecting
+        into an overloaded broker would make the overload worse)."""
+        self._topics_dirty = True
+        return Error(ErrorKind.SHED,
+                     message.context or "server shed the request")
 
     # -- connection management ---------------------------------------------
 
@@ -130,6 +151,9 @@ class Client:
             # with a second latency population and let the chain check
             # pass even when the marshal path is broken
             self._sampler.pending = conn_trace[0]
+        # the handshake replayed the FULL desired topic set, so the
+        # broker mirror is authoritative again (post-shed staleness gone)
+        self._topics_dirty = False
         logger.info("connected to broker at %s", broker_endpoint)
         return broker_conn
 
@@ -195,6 +219,9 @@ class Client:
                                        message=payload))
 
     async def receive_message(self) -> Message:
+        if self._pending_shed is not None:
+            err, self._pending_shed = self._pending_shed, None
+            raise err
         conn = self._connection  # fast path: live connection, no coroutine
         if conn is None or conn.is_closed:
             conn = await self._get_connection()
@@ -203,6 +230,8 @@ class Client:
         except Exception as exc:
             self._disconnect_on_error()
             bail(ErrorKind.CONNECTION, "receive failed; connection reset", exc)
+        if isinstance(message, AuthenticateResponse) and message.permit == 0:
+            raise self._shed_error(message)
         if trace_mod.ENABLED:
             tr = getattr(message, "trace", None)
             if tr is not None:
@@ -223,6 +252,9 @@ class Client:
         parse batches, so one call may return more than asked (never
         fewer than 1)."""
         from pushcdn_tpu.proto.transport.base import FrameChunk
+        if self._pending_shed is not None:
+            err, self._pending_shed = self._pending_shed, None
+            raise err
         conn = self._connection
         if conn is None or conn.is_closed:
             conn = await self._get_connection()
@@ -247,6 +279,20 @@ class Client:
         finally:
             for item in items:
                 item.release()
+        # load-shed notices (permit=0 post-handshake) surface as typed
+        # Error(SHED): immediately when nothing else arrived, otherwise
+        # after the real deliveries are handed over (next receive call) —
+        # a shed is never a silent drop and never loses deliveries
+        shed = [m for m in out
+                if isinstance(m, AuthenticateResponse) and m.permit == 0]
+        if shed:
+            out = [m for m in out
+                   if not (isinstance(m, AuthenticateResponse)
+                           and m.permit == 0)]
+            err = self._shed_error(shed[-1])
+            if not out:
+                raise err
+            self._pending_shed = err
         if trace_mod.ENABLED:
             for m in out:
                 tr = getattr(m, "trace", None)
@@ -258,8 +304,14 @@ class Client:
 
     async def subscribe(self, topics: List[int]) -> None:
         """Send only the delta; update local state on success (lib.rs
-        subscribe semantics)."""
-        new = [t for t in topics if t not in self._topics]
+        subscribe semantics). After a load shed the local mirror may be
+        stale (a shed mutation was never applied), so the delta filter is
+        suspended and the requested topics go out verbatim — the broker's
+        subscribe is an idempotent set-union, so convergence is safe."""
+        if self._topics_dirty:
+            new = list(dict.fromkeys(topics))
+        else:
+            new = [t for t in topics if t not in self._topics]
         if not new:
             return
         conn = await self._get_connection()
@@ -271,7 +323,10 @@ class Client:
         self._topics.update(new)
 
     async def unsubscribe(self, topics: List[int]) -> None:
-        gone = [t for t in topics if t in self._topics]
+        if self._topics_dirty:
+            gone = list(dict.fromkeys(topics))
+        else:
+            gone = [t for t in topics if t in self._topics]
         if not gone:
             return
         conn = await self._get_connection()

@@ -56,6 +56,12 @@ def _compile():
     lib.pushcdn_route_table_build.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
         u64p, u64p, u8p, i64p, i32p, i32p, ctypes.c_int32]
+    lib.pushcdn_route_table_apply.restype = ctypes.c_int32
+    lib.pushcdn_route_table_apply.argtypes = [
+        ctypes.c_void_p, i32p, u64p, ctypes.c_int32,
+        u8p, i64p, i32p, i32p, ctypes.c_int32]
+    lib.pushcdn_route_table_stats.restype = None
+    lib.pushcdn_route_table_stats.argtypes = [ctypes.c_void_p, i64p]
     lib.pushcdn_route_plan.restype = ctypes.c_int64
     lib.pushcdn_route_plan.argtypes = [
         ctypes.c_void_p, u8p, ctypes.c_int64, i64p, i64p,
@@ -163,6 +169,50 @@ class RoutePlanner:
             _ptr(blob_arr, ctypes.c_uint8), _ptr(offs, ctypes.c_int64),
             _ptr(lens, ctypes.c_int32), _ptr(owners, ctypes.c_int32), n)
         return rc == 0
+
+    def apply(self, upd_peers, upd_masks, direct_keys: List[bytes],
+              direct_owners) -> bool:
+        """Apply one delta batch IN PLACE (ISSUE 7): ``upd_peers[i]`` gets
+        the absolute interest mask ``upd_masks[i]`` (u64[4]; zeros free the
+        slot), and ``direct_keys[i]`` is upserted to peer
+        ``direct_owners[i]`` (or tombstoned when the owner is ``-1``).
+        O(delta) — the stored masks are the diff base. Returns False on
+        allocation failure / out-of-range slot (the caller must fall back
+        to a full rebuild)."""
+        n_upd = len(upd_peers)
+        peers = np.ascontiguousarray(upd_peers, np.int32) if n_upd \
+            else np.zeros(1, np.int32)
+        masks = np.ascontiguousarray(upd_masks, np.uint64) if n_upd \
+            else np.zeros(MASK_WORDS, np.uint64)
+        n = len(direct_keys)
+        lens = np.fromiter(map(len, direct_keys), np.int32, count=n) \
+            if n else np.zeros(1, np.int32)
+        offs = np.zeros(max(n, 1), np.int64)
+        if n:
+            np.cumsum(lens[:-1], dtype=np.int64, out=offs[1:n])
+        blob = b"".join(direct_keys)
+        blob_arr = np.frombuffer(blob, np.uint8) if blob \
+            else np.zeros(1, np.uint8)
+        owners = np.ascontiguousarray(direct_owners, np.int32) \
+            if n else np.zeros(1, np.int32)
+        rc = self._lib.pushcdn_route_table_apply(
+            self._handle,
+            _ptr(peers, ctypes.c_int32), _ptr(masks, ctypes.c_uint64),
+            n_upd,
+            _ptr(blob_arr, ctypes.c_uint8), _ptr(offs, ctypes.c_int64),
+            _ptr(lens, ctypes.c_int32), _ptr(owners, ctypes.c_int32), n)
+        return rc == 0
+
+    def stats(self) -> dict:
+        """Occupancy/garbage counters (the compaction-policy inputs)."""
+        out = np.zeros(8, np.int64)
+        self._lib.pushcdn_route_table_stats(self._handle,
+                                            _ptr(out, ctypes.c_int64))
+        return {"n_users": int(out[0]), "n_brokers": int(out[1]),
+                "live_subs": int(out[2]), "list_entries": int(out[3]),
+                "dmap_live": int(out[4]), "dmap_tombstones": int(out[5]),
+                "keys_blob_bytes": int(out[6]),
+                "keys_blob_garbage": int(out[7])}
 
     def _ensure_pairs(self, need: int) -> None:
         if len(self._pair_peer) < need:

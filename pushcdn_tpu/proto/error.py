@@ -28,6 +28,8 @@ class ErrorKind(enum.Enum):
     FILE = "file"                  # file I/O (CA certs, embedded DB path)
     EXCEEDED_SIZE = "exceeded_size"  # frame larger than MAX_MESSAGE_SIZE
     TIMEOUT = "timeout"            # I/O deadline elapsed
+    SHED = "shed"                  # server load-shed a request (back off;
+    #                                the connection itself is still live)
 
 
 class Error(Exception):

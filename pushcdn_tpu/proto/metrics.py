@@ -292,7 +292,38 @@ ROUTE_RESIDUAL_FRAMES = ROUTE_FRAMES.labels(path="residual")
 ROUTE_SCALAR_FRAMES = ROUTE_FRAMES.labels(path="scalar")
 ROUTE_TABLE_REBUILDS = Counter(
     "cdn_route_table_rebuilds",
-    "Cut-through snapshot rebuilds (routing state changed)")
+    "Cut-through snapshot FULL rebuilds, by reason: first_build = cold "
+    "start, version_gap = the delta log was trimmed past this snapshot's "
+    "cursor, delta_overflow = more pending deltas than a rebuild costs, "
+    "compaction = lazy-deletion garbage crossed the purge threshold, "
+    "growth = peer slot capacity exhausted, retry = previous build "
+    "failed allocation, incremental_disabled = the rebuild-per-"
+    "invalidation baseline (PUSHCDN_ROUTE_INCREMENTAL=0)",
+    labels=("reason",))
+ROUTE_DELTAS_APPLIED = Counter(
+    "cdn_route_deltas_applied",
+    "Typed route deltas applied IN PLACE to the cut-through snapshot "
+    "(the incremental alternative to a full rebuild, ISSUE 7)")
+ROUTE_DELTA_APPLY_SECONDS = Histogram(
+    "cdn_route_delta_apply_seconds",
+    "Latency of one batched in-place delta application (Connections "
+    "route-log suffix -> native table), O(delta) by construction",
+    buckets=(1e-6, 5e-6, 1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.05, 0.5))
+
+# Admission control / overload shedding (ISSUE 7): work REFUSED to keep
+# the event loop alive, by tier. Every shed also records a flight-recorder
+# event and flips the broker's /readyz "admission" check for
+# PUSHCDN_SHED_READY_S so the load balancer steers away.
+ROUTE_SHED = Counter(
+    "cdn_route_shed_total",
+    "Load-shed decisions by tier: user_conn / broker_conn = connection "
+    "budget exceeded (PUSHCDN_MAX_CONNS_*), subscribe = per-connection "
+    "subscribe/unsubscribe token bucket exhausted "
+    "(PUSHCDN_SUBSCRIBE_RATE)",
+    labels=("tier",))
+ROUTE_SHED_USER_CONN = ROUTE_SHED.labels(tier="user_conn")
+ROUTE_SHED_BROKER_CONN = ROUTE_SHED.labels(tier="broker_conn")
+ROUTE_SHED_SUBSCRIBE = ROUTE_SHED.labels(tier="subscribe")
 
 # Sharded data plane (broker/sharding.py): cross-shard handoff accounting.
 # path=ring is the zero-copy shared-memory fast path; path=fallback is the

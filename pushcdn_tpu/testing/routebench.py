@@ -23,7 +23,11 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
                        trials: int = 3, payload: int = 512,
                        batch: int = 64,
                        trace_every: int = 0,
-                       deliver_spans: bool = False) -> Optional[dict]:
+                       deliver_spans: bool = False,
+                       parked_users: int = 0,
+                       churn: bool = False,
+                       incremental: Optional[bool] = None
+                       ) -> Optional[dict]:
     """Measure broker forwarding msgs/s with the routing plane forced to
     ``impl`` (``auto``/``native``/``python``). Returns ``None`` when
     ``impl == "native"`` but the kernel is unavailable (callers emit a
@@ -39,7 +43,17 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
     ``e2e_lat_s``, the raw publish→delivery latencies, for bench-side
     p50/p99. Kept opt-in because these receivers skip frame decode (a
     real client pays it anyway), so the flag-scan is bench-side cost that
-    must not pollute the broker-side trace-overhead A/B."""
+    must not pollute the broker-side trace-overhead A/B.
+
+    ISSUE 7 knobs — the sustained-churn A/B: ``parked_users`` injects
+    that many extra users subscribed to an untrafficked topic (a big
+    interest table, so a snapshot rebuild has a real O(users) cost);
+    ``churn=True`` runs a concurrent churner connection flooding
+    Subscribe/Unsubscribe during the measurement (every mutation
+    invalidates the snapshot mid-traffic; the result carries
+    ``churn_ops_s``); ``incremental`` forces the native maintenance mode
+    (True = in-place deltas, False = the rebuild-guard baseline,
+    None = leave as configured)."""
     from pushcdn_tpu.broker.tasks import cutthrough
     from pushcdn_tpu.broker.test_harness import TestDefinition
     from pushcdn_tpu.native import routeplan
@@ -54,15 +68,47 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
     # failing shutdown: callers swallow exceptions, and a leaked forced
     # impl / widened duplex window would distort every later row (and
     # cross-contaminate tests) in the same process
+    from pushcdn_tpu.proto.message import Subscribe, Unsubscribe
+
     prev_impl = cutthrough.ROUTE_IMPL
+    prev_inc = cutthrough.ROUTE_INCREMENTAL
     prev_win = Memory.set_duplex_window(256 * 1024)
     try:
         cutthrough.ROUTE_IMPL = impl
+        if incremental is not None:
+            cutthrough.ROUTE_INCREMENTAL = incremental
+        # user 0 = sender, 1..receivers = receivers on topic 0, then the
+        # churner (topicless), then the parked herd on topic 1 (table
+        # size without fan-out traffic)
         run = await TestDefinition(
-            connected_users=[[]] + [[0]] * receivers).run()
+            connected_users=[[]] + [[0]] * receivers + [[]]
+            + [[1]] * parked_users).run()
         try:
             frame = serialize(Broadcast([0], os.urandom(payload)))
             sender = run.user(0).remote
+            churner = run.user(1 + receivers).remote
+            sub_frame = serialize(Subscribe([1]))
+            unsub_frame = serialize(Unsubscribe([1]))
+            churn_ops = 0
+            churn_stop = False
+
+            churn_batch = [sub_frame, unsub_frame] * 4
+
+            async def churn_loop():
+                # sustained subscribe/unsubscribe churn riding the same
+                # broker while forwarding is measured: each op bumps
+                # interest_version, so every following plan call pays the
+                # maintenance cost under test (delta vs rebuild)
+                nonlocal churn_ops
+                while not churn_stop:
+                    try:
+                        await churner.send_raw_many(churn_batch,
+                                                    flush=True)
+                    except Exception:
+                        return
+                    churn_ops += len(churn_batch)
+                    await asyncio.sleep(0)
+
             msgs = max(batch, (msgs // batch) * batch)
             e2e_lat_s: list = []
 
@@ -108,6 +154,9 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
 
             rates = []
             sent = 0
+            churn_task = asyncio.create_task(churn_loop()) if churn \
+                else None
+            churn_t0 = time.perf_counter()
             for _ in range(trials):
                 t0 = time.perf_counter()
                 drains = [asyncio.create_task(
@@ -132,13 +181,26 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
                     await asyncio.sleep(0)
                 await asyncio.gather(*drains)
                 rates.append(msgs / (time.perf_counter() - t0))
+            churn_dt = time.perf_counter() - churn_t0
+            if churn_task is not None:
+                churn_stop = True
+                await churn_task
             med = statistics.median(rates)
-            return {"median": med, "trials": rates, "msgs": msgs,
-                    "receivers": receivers, "payload": payload,
-                    "delivered": med * receivers,
-                    "e2e_lat_s": e2e_lat_s}
+            out = {"median": med, "trials": rates, "msgs": msgs,
+                   "receivers": receivers, "payload": payload,
+                   "delivered": med * receivers,
+                   "e2e_lat_s": e2e_lat_s}
+            if churn:
+                out["churn_ops"] = churn_ops
+                out["churn_ops_s"] = churn_ops / churn_dt if churn_dt \
+                    else 0.0
+                state = getattr(run.broker, "_route_state", None)
+                if state is not None:
+                    out["route_summary"] = state.summary()
+            return out
         finally:
             await run.shutdown()
     finally:
         cutthrough.ROUTE_IMPL = prev_impl
+        cutthrough.ROUTE_INCREMENTAL = prev_inc
         Memory.set_duplex_window(prev_win)

@@ -258,6 +258,115 @@ def render_merged_topology(broker_ports: dict) -> None:
     print("[cluster] ---- end topology ----")
 
 
+# readiness stays 503 this long after the last shed (the window the
+# --churn check polls; generous so the observation can't race the flip)
+SHED_READY_S = 6.0
+
+
+def check_load_shed(marshal_port: int, broker_ports: dict) -> bool:
+    """--churn (ISSUE 7): force subscribe-rate overload through a real
+    broker via the REAL client library and verify the whole shed surface
+    — the client's typed ``Error(SHED)``, ``/readyz`` flipping 503 with
+    the ``admission`` check failing, the ``load-shed`` flight-recorder
+    event, then recovery back to 200 once the storm stops. The churn
+    client stays CONNECTED until the flight-recorder check passes (the
+    trail lives on its connection's recorder)."""
+    import asyncio
+
+    from pushcdn_tpu.bin.common import keypair_from_seed
+    from pushcdn_tpu.client import Client, ClientConfig
+    from pushcdn_tpu.proto.error import Error, ErrorKind
+    from pushcdn_tpu.proto.transport.tcp import Tcp
+
+    def admission_failing(body: str) -> bool:
+        try:
+            doc = json.loads(body)
+            return any(name.rsplit(":", 1)[-1] == "admission"
+                       and not c["ok"]
+                       for name, c in doc.get("checks", {}).items())
+        except (ValueError, KeyError, TypeError):
+            return False
+
+    async def drive() -> bool:
+        client = Client(ClientConfig(
+            marshal_endpoint=f"127.0.0.1:{marshal_port}",
+            keypair=keypair_from_seed(99),
+            protocol=Tcp, subscribed_topics=set()))
+        try:
+            async with asyncio.timeout(20):
+                await client.ensure_initialized()
+            shed = False
+            for _ in range(60):
+                await client.subscribe([1])
+                await client.unsubscribe([1])
+                try:  # drain any pending shed notice quickly
+                    async with asyncio.timeout(0.02):
+                        await client.receive_message()
+                except (TimeoutError, asyncio.TimeoutError):
+                    continue
+                except Error as exc:
+                    if exc.kind != ErrorKind.SHED:
+                        raise
+                    shed = True
+                    break
+            if not shed:
+                try:  # notices may still be in flight: one longer read
+                    async with asyncio.timeout(3.0):
+                        await client.receive_message()
+                except (TimeoutError, asyncio.TimeoutError):
+                    pass
+                except Error as exc:
+                    shed = exc.kind == ErrorKind.SHED
+            if not shed:
+                print("[cluster] FAIL: churn client never received the "
+                      "typed Error(shed)")
+                return False
+            print("[cluster] typed shed Error observed by the client "
+                  "(Error kind=shed for over-rate subscribe)")
+
+            shed_broker = None
+            deadline = time.time() + SHED_READY_S
+            while time.time() < deadline and shed_broker is None:
+                for name, port in broker_ports.items():
+                    res = http_get(port, "/readyz")
+                    if res is not None and res[0] == 503 \
+                            and admission_failing(res[1]):
+                        shed_broker = (name, port)
+                        break
+                await asyncio.sleep(0.1)
+            if shed_broker is None:
+                print("[cluster] FAIL: no broker flipped /readyz on the "
+                      "shed")
+                return False
+            name, port = shed_broker
+            print(f"[cluster] load shed observed: {name} /readyz 503 "
+                  "(admission check failing)")
+
+            res = http_get(port, "/debug/flightrec?limit=400")
+            if res is None or res[0] != 200 or "load-shed" not in res[1]:
+                print(f"[cluster] FAIL: {name} /debug/flightrec has no "
+                      f"load-shed event ({(res or ('?', ''))[1][:300]})")
+                return False
+            print(f"[cluster] shed flight-recorder event recorded on "
+                  f"{name}")
+
+            deadline = time.time() + SHED_READY_S + 8.0
+            while time.time() < deadline:
+                res = http_get(port, "/readyz")
+                if res is not None and res[0] == 200:
+                    print(f"[cluster] load shed recovered: {name} "
+                          "/readyz 200 after the storm stopped")
+                    return True
+                await asyncio.sleep(0.2)
+            print(f"[cluster] FAIL: {name} never recovered /readyz 200 "
+                  "after the churn stopped")
+            return False
+        finally:
+            client.close()
+
+    return asyncio.run(drive())
+
+
 def check_drain(name: str, proc: subprocess.Popen, port: int) -> bool:
     """SIGINT the process and verify /readyz flips to 503 (draining)
     BEFORE the listeners close — the process keeps answering through the
@@ -369,6 +478,13 @@ def main() -> int:
                     help="write per-process lifecycle-trace span JSONL "
                          "under DIR, verify one complete span chain, and "
                          "run scripts/trace_report.py --strict over it")
+    ap.add_argument("--churn", action="store_true",
+                    help="force subscribe-rate overload (ISSUE 7): brokers "
+                         "run with a tiny PUSHCDN_SUBSCRIBE_RATE, a churn "
+                         "client drives an over-rate storm, and the run "
+                         "verifies the typed shed Error, the /readyz "
+                         "admission flip + flight-recorder event, and "
+                         "recovery")
     ap.add_argument("--shards", type=int, default=1,
                     help="run broker0 with a sharded data plane (N worker "
                          "processes); spawns a second client so directs "
@@ -417,6 +533,13 @@ def main() -> int:
         for i in range(2):
             env = {**trace_env(f"broker{i}"),
                    "PUSHCDN_DRAIN_GRACE_S": str(DRAIN_GRACE_S)}
+            if args.churn:
+                # tiny per-connection subscribe budget so the churn driver
+                # forces shedding quickly; the ready window is generous so
+                # the /readyz flip is externally observable
+                env.update({"PUSHCDN_SUBSCRIBE_RATE": "2",
+                            "PUSHCDN_SUBSCRIBE_BURST": "3",
+                            "PUSHCDN_SHED_READY_S": str(SHED_READY_S)})
             shard_flags = []
             if i == 0:
                 # hold broker0's listener binds open so the not-ready-
@@ -512,6 +635,10 @@ def main() -> int:
             # cross-shard directs carried by the handoff rings
             ok = check_shard_plane(metrics_ports["broker0"],
                                    args.shards) and ok
+        if args.churn:
+            # ---- admission control (ISSUE 7): forced overload sheds,
+            # surfaces typed + /readyz + flightrec, then recovers
+            ok = check_load_shed(bp + 50, broker_ports) and ok
         if args.topology:
             render_merged_topology(broker_ports)
         if args.trace_log:

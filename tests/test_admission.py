@@ -1,0 +1,325 @@
+"""Admission control & load shedding (ISSUE 7).
+
+Covers the three tiers end to end against a real in-process broker:
+per-tier connection budgets (typed pre-auth refusal), the per-connection
+subscribe-rate token bucket (drop + typed notice through the ordered
+egress path, identical on the cut-through and scalar impls), and the
+surfacing contract — ``cdn_route_shed_total{tier}``, the ``load-shed``
+flight-recorder event, and the ``/readyz`` ``admission`` check flipping
+false for the shed window then recovering. Plus the client library's
+typed ``Error(SHED)`` surfacing (never a silent drop, never a teardown).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from pushcdn_tpu.broker.admission import AdmissionControl
+from pushcdn_tpu.broker.tasks import cutthrough, listeners
+from pushcdn_tpu.broker.test_harness import TestDefinition
+from pushcdn_tpu.client import Client, ClientConfig
+from pushcdn_tpu.proto import metrics as metrics_mod
+from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME
+from pushcdn_tpu.proto.error import Error, ErrorKind
+from pushcdn_tpu.proto.message import (
+    AuthenticateResponse,
+    Broadcast,
+    Subscribe,
+    Unsubscribe,
+    deserialize,
+    serialize,
+)
+from pushcdn_tpu.proto.transport.base import FrameChunk
+from pushcdn_tpu.proto.transport.memory import gen_testing_connection_pair
+from pushcdn_tpu.proto.transport.tcp import Tcp
+
+
+class _FakeConn:
+    """Just enough surface for the token bucket + flight recorder."""
+
+    def __init__(self):
+        from pushcdn_tpu.proto import flightrec
+        self.flightrec = flightrec.FlightRecorder("fake")
+
+
+class _FakeBroker:
+    def __init__(self, num_users=0, num_brokers=0):
+        class _C:
+            pass
+        self.connections = _C()
+        self.connections.num_users = num_users
+        self.connections.num_brokers = num_brokers
+
+
+def _adm(broker=None, **kw) -> AdmissionControl:
+    adm = AdmissionControl(broker or _FakeBroker())
+    for k, v in kw.items():
+        setattr(adm, k, v)
+    return adm
+
+
+# ---------------------------------------------------------------------------
+# unit: token bucket + budgets
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_burst_then_refuse_then_refill():
+    adm = _adm(subscribe_rate=1.0, subscribe_burst=3.0)
+    conn = _FakeConn()
+    assert all(adm.allow_subscribe(conn) for _ in range(3))
+    assert not adm.allow_subscribe(conn)
+    # refill: pretend the last update was 2.5 s ago -> 2 whole tokens
+    conn._sub_bucket[1] -= 2.5
+    assert adm.allow_subscribe(conn)
+    assert adm.allow_subscribe(conn)
+    assert not adm.allow_subscribe(conn)
+
+
+def test_token_bucket_disabled_and_connless_always_allow():
+    adm = _adm(subscribe_rate=0.0)
+    assert adm.allow_subscribe(_FakeConn())
+    adm = _adm(subscribe_rate=1.0, subscribe_burst=1.0)
+    assert adm.allow_subscribe(None)
+    assert adm.allow_subscribe(None)  # no seat to meter: never refuse
+
+
+def test_connection_budgets_and_ready_window():
+    adm = _adm(_FakeBroker(num_users=2, num_brokers=1),
+               max_user_conns=2, max_broker_conns=2, ready_window_s=0.2)
+    ok, detail = adm.readiness_check()
+    assert ok, detail
+    reason = adm.admit_user()
+    assert reason is not None and "shed" in reason
+    assert adm.admit_broker() is None  # broker tier under budget
+    ok, detail = adm.readiness_check()
+    assert not ok and "user_conn" in detail
+    time.sleep(0.25)
+    ok, _ = adm.readiness_check()
+    assert ok  # window elapsed: back in rotation
+    assert adm.summary()["shed_counts"] == {"user_conn": 1}
+
+
+def test_unconfigured_admission_is_always_ready():
+    adm = _adm(max_user_conns=0, max_broker_conns=0, subscribe_rate=0.0)
+    assert adm.admit_user() is None
+    assert adm.admit_broker() is None
+    ok, detail = adm.readiness_check()
+    assert ok and "disabled" in detail
+
+
+# ---------------------------------------------------------------------------
+# end to end: subscribe-rate shed through a real broker, both impls
+# ---------------------------------------------------------------------------
+
+async def _drain_frames(conn, settle_s=0.1):
+    got = []
+    while True:
+        try:
+            items = await asyncio.wait_for(conn.recv_frames(), settle_s)
+        except (asyncio.TimeoutError, Exception):
+            return got
+        for item in items:
+            if type(item) is FrameChunk:
+                got.extend(bytes(mv) for mv in item.views())
+            else:
+                got.append(bytes(item.data))
+            item.release()
+
+
+@pytest.mark.parametrize("impl", ["native", "python"])
+async def test_subscribe_shed_end_to_end(impl):
+    if impl == "native" and not cutthrough.routeplan.available():
+        pytest.skip("native route-plan kernel unavailable")
+    prev = cutthrough.ROUTE_IMPL
+    cutthrough.ROUTE_IMPL = impl
+    try:
+        run = await TestDefinition(connected_users=[[], [1]]).run()
+        adm = run.broker.admission
+        adm.subscribe_rate = 0.001  # effectively no refill in-test
+        adm.subscribe_burst = 2.0
+        adm.ready_window_s = 5.0
+        shed0 = metrics_mod.ROUTE_SHED_SUBSCRIBE.value
+        try:
+            sender = run.user(0).remote
+            # 2 allowed (burst), 3 shed; the broadcast AFTER the storm
+            # must still deliver — shedding degrades, never disconnects
+            frames = [serialize(Subscribe([0]))] * 2 \
+                + [serialize(Subscribe([1])), serialize(Unsubscribe([0])),
+                   serialize(Subscribe([1]))] \
+                + [serialize(Broadcast([1], b"still-alive"))]
+            await sender.send_raw_many(frames, flush=True)
+            await asyncio.sleep(0.2)
+
+            assert run.broker.connections.has_user(b"user-0")
+            # the sheds were NOT applied: user-0 holds only the 2
+            # admitted subscriptions (topic 0), never topic 1
+            topics = run.broker.connections.user_topics.get_values_of_key(
+                b"user-0")
+            assert topics == {0}, topics
+            # exactly 3 typed notices back to the sender, none silent
+            got = [deserialize(f) for f in await _drain_frames(sender)]
+            notices = [m for m in got
+                       if isinstance(m, AuthenticateResponse)]
+            assert len(notices) == 3, got
+            assert all(m.permit == 0 and "shed" in m.context
+                       for m in notices)
+            assert metrics_mod.ROUTE_SHED_SUBSCRIBE.value - shed0 == 3
+            ok, detail = adm.readiness_check()
+            assert not ok and "subscribe" in detail
+            # user-1 (subscribed to 1) still got the broadcast
+            got1 = [deserialize(f)
+                    for f in await _drain_frames(run.user(1).remote)]
+            assert any(isinstance(m, Broadcast)
+                       and bytes(m.message) == b"still-alive"
+                       for m in got1), got1
+            # recovery: age the shed stamps past the window (no sleeps)
+            adm.last_shed = {tier: ts - 10.0
+                             for tier, ts in adm.last_shed.items()}
+            ok, _ = adm.readiness_check()
+            assert ok  # recovered once the window passed
+        finally:
+            await run.shutdown()
+    finally:
+        cutthrough.ROUTE_IMPL = prev
+
+
+# ---------------------------------------------------------------------------
+# end to end: user connection budget refused pre-auth with a typed reply
+# ---------------------------------------------------------------------------
+
+class _FakeUnfinalized:
+    def __init__(self, conn):
+        self._conn = conn
+
+    async def finalize(self, limiter):
+        return self._conn
+
+
+async def test_user_connection_budget_typed_refusal():
+    run = await TestDefinition(connected_users=[[0]]).run()
+    adm = run.broker.admission
+    adm.max_user_conns = 1  # already at capacity with the injected user
+    try:
+        local, remote = await gen_testing_connection_pair(
+            run.broker.limiter)
+        await listeners.handle_user_connection(
+            run.broker, _FakeUnfinalized(local))
+        # the refusal is typed: permit=0 + the shed reason, pre-auth
+        raw = await asyncio.wait_for(remote.recv_raw(), 2.0)
+        msg = deserialize(raw.data)
+        raw.release()
+        assert isinstance(msg, AuthenticateResponse)
+        assert msg.permit == 0 and "shed" in msg.context
+        assert "PUSHCDN_MAX_CONNS_USER" in msg.context
+        ok, detail = adm.readiness_check()
+        assert not ok and "user_conn" in detail
+        # no second user was registered
+        assert run.broker.connections.num_users == 1
+    finally:
+        await run.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# client library: the typed Error(SHED) surface
+# ---------------------------------------------------------------------------
+
+class _StubConn:
+    """Minimal Connection stand-in for the client receive paths."""
+
+    is_closed = False
+
+    def __init__(self, messages=None, items=None):
+        self._messages = list(messages or [])
+        self._items = items
+
+    async def recv_message(self):
+        return self._messages.pop(0)
+
+    async def recv_frames(self, n=1024):
+        items, self._items = self._items, []
+        return items
+
+    def close(self):
+        self.is_closed = True
+
+
+class _StubItem:
+    def __init__(self, frame: bytes):
+        self.data = frame
+
+    def release(self):
+        pass
+
+
+def _client() -> Client:
+    return Client(ClientConfig(
+        marshal_endpoint="127.0.0.1:1", protocol=Tcp,
+        keypair=DEFAULT_SCHEME.generate_keypair(seed=1)))
+
+
+async def test_client_receive_message_raises_typed_shed():
+    client = _client()
+    client._connection = _StubConn(messages=[
+        AuthenticateResponse(permit=0, context="shed: subscribe rate")])
+    with pytest.raises(Error) as ei:
+        await client.receive_message()
+    assert ei.value.kind == ErrorKind.SHED
+    assert "shed" in str(ei.value)
+    # NOT reconnectable, and the connection was NOT torn down (hammering
+    # an overloaded broker with re-dials would worsen the overload)
+    assert not ei.value.is_reconnectable
+    assert client._connection is not None
+
+
+async def test_client_receive_messages_never_loses_deliveries():
+    notice = serialize(AuthenticateResponse(permit=0, context="shed: x"))
+    payload = serialize(Broadcast([0], b"real"))
+    client = _client()
+    client._connection = _StubConn(
+        items=[_StubItem(payload), _StubItem(notice)])
+    out = await client.receive_messages()
+    # the real delivery is returned first...
+    assert len(out) == 1 and isinstance(out[0], Broadcast)
+    # ...and the shed surfaces as the typed Error on the NEXT call
+    with pytest.raises(Error) as ei:
+        await client.receive_messages()
+    assert ei.value.kind == ErrorKind.SHED
+
+
+async def test_client_receive_messages_only_notices_raises_immediately():
+    notice = serialize(AuthenticateResponse(permit=0, context="shed: y"))
+    client = _client()
+    client._connection = _StubConn(items=[_StubItem(notice)])
+    with pytest.raises(Error) as ei:
+        await client.receive_messages()
+    assert ei.value.kind == ErrorKind.SHED
+
+
+async def test_client_resends_verbatim_after_shed():
+    """Review fix: a shed may have dropped any recent mutation, so the
+    optimistic local topic mirror is untrustworthy afterwards — the
+    delta filter must be suspended (requested topics sent verbatim)
+    until a reconnect replays the full set, or a retried subscribe
+    becomes a permanent silent no-op."""
+    client = _client()
+    stub = _StubConn(messages=[
+        AuthenticateResponse(permit=0, context="shed: subscribe rate")])
+    sent = []
+
+    async def send_message(msg, flush=False):
+        sent.append(msg)
+
+    stub.send_message = send_message
+    client._connection = stub
+    # optimistic mirror says topic 5 is subscribed (the broker shed it)
+    client._topics.add(5)
+    await client.subscribe([5])
+    assert sent == []  # pre-shed: the delta filter suppresses the resend
+    with pytest.raises(Error) as ei:
+        await client.receive_message()
+    assert ei.value.kind == ErrorKind.SHED
+    # post-shed: the retry goes out verbatim despite the stale mirror
+    await client.subscribe([5])
+    assert len(sent) == 1 and tuple(sent[0].topics) == (5,), sent
+    await client.unsubscribe([7])  # not in the mirror either: still sent
+    assert len(sent) == 2 and tuple(sent[1].topics) == (7,), sent

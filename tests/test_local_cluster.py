@@ -74,6 +74,36 @@ def test_local_cluster_end_to_end_echo_and_clean_shutdown(tmp_path):
                     reason="PUSHCDN_SKIP_CLUSTER_TEST=1")
 @pytest.mark.skipif(not _loopback_available(),
                     reason="no loopback TCP in this sandbox")
+def test_local_cluster_load_shed():
+    """ISSUE 7: forced subscribe-rate overload against a REAL broker —
+    the shed reaches the client as a typed Error (never a silent drop),
+    the broker flips /readyz 503 with the ``admission`` check failing and
+    records the ``load-shed`` flight-recorder event, then recovers to
+    /readyz 200 once the storm stops."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--duration", "12", "--base-port", "0",
+         "--churn"],
+        env=env, capture_output=True, text=True, timeout=180)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"churn local_cluster failed:\n{out[-6000:]}"
+    assert "OK: end-to-end echo through real processes" in out, out[-6000:]
+    # the shed response reached the client as a typed Error(SHED)
+    assert "typed shed Error observed by the client" in out, out[-6000:]
+    # /readyz flipped 503 with the admission check failing...
+    assert "load shed observed" in out, out[-6000:]
+    # ...the flight recorder captured the shed event...
+    assert "shed flight-recorder event recorded" in out, out[-6000:]
+    # ...and the broker re-entered rotation after the storm
+    assert "load shed recovered" in out, out[-6000:]
+    assert "FAIL" not in out, out[-6000:]
+
+
+@pytest.mark.skipif(os.environ.get("PUSHCDN_SKIP_CLUSTER_TEST") == "1",
+                    reason="PUSHCDN_SKIP_CLUSTER_TEST=1")
+@pytest.mark.skipif(not _loopback_available(),
+                    reason="no loopback TCP in this sandbox")
 def test_local_cluster_sharded_broker(tmp_path):
     """ISSUE 6: the same cluster with broker0 sharded across 2 worker OS
     processes (fd-handoff accept distribution, so the two clients land on

@@ -398,6 +398,84 @@ async def test_sharded_subscribe_propagates_to_sibling():
         Memory.set_duplex_window(prev)
 
 
+def _gen_churn_frames(rng: np.random.Generator, n: int):
+    """A control-frame-heavy mix (ISSUE 7): the regime where incremental
+    deltas vs full rebuilds could diverge — every hot frame is planned
+    against a snapshot that just absorbed a mutation."""
+    frames = []
+    for _ in range(n):
+        roll = rng.integers(0, 100)
+        payload = bytes(rng.integers(0, 256, int(rng.integers(1, 48)),
+                                     dtype=np.uint8))
+        if roll < 30:
+            topics = [int(t) for t in rng.choice(
+                [0, 1], size=int(rng.integers(1, 3)))]
+            frames.append(serialize(Broadcast(topics, payload)))
+        elif roll < 45:
+            rcpt = KNOWN_DIRECTS[int(rng.integers(0, len(KNOWN_DIRECTS)))]
+            frames.append(serialize(Direct(rcpt, payload)))
+        elif roll < 70:
+            frames.append(serialize(Subscribe(
+                [int(t) for t in rng.choice([0, 1],
+                                            size=int(rng.integers(1, 3)))])))
+        elif roll < 90:
+            frames.append(serialize(Unsubscribe([int(rng.integers(0, 2))])))
+        elif roll < 97:
+            frames.append(serialize(UserSync(_sync_payload(
+                "testbrokerpub-0:0/testbrokerpriv-0:0"))))
+        else:
+            frames.append(serialize(TopicSync(_sync_payload(
+                "testbrokerpub-0:0/testbrokerpriv-0:0"))))
+    return frames
+
+
+async def _run_mix_incremental(incremental: bool, frames, as_user: bool):
+    """_run_mix with the native impl's maintenance mode forced: True =
+    in-place deltas (ISSUE 7 default), False = the rebuild-per-
+    invalidation baseline (churn guard armed)."""
+    prev = cutthrough.ROUTE_INCREMENTAL
+    cutthrough.ROUTE_INCREMENTAL = incremental
+    try:
+        return await _run_mix("native", frames, as_user=as_user,
+                              chunked=True)
+    finally:
+        cutthrough.ROUTE_INCREMENTAL = prev
+
+
+@pytest.mark.parametrize("seed", range(4))
+async def test_churn_mix_incremental_vs_rebuild_vs_python(seed):
+    """ISSUE 7: under subscribe-churn-heavy mixes, the incremental delta
+    path, the full-rebuild baseline, and the scalar loops must produce
+    identical per-peer delivery SEQUENCES and disconnect decisions."""
+    rng = np.random.default_rng(8000 + seed)
+    frames = _gen_churn_frames(rng, 70)
+    d_inc, alive_i, bal_i = await _run_mix_incremental(True, frames,
+                                                       as_user=True)
+    d_reb, alive_r, bal_r = await _run_mix_incremental(False, frames,
+                                                       as_user=True)
+    d_py, alive_p, bal_p = await _run_mix("python", frames,
+                                          as_user=True, chunked=True)
+    assert alive_i == alive_r == alive_p, f"seed {seed}: disconnects differ"
+    assert d_inc == d_reb == d_py, f"seed {seed}: delivery sequences differ"
+    assert bal_i and bal_r and bal_p, f"seed {seed}: pool permits leaked"
+
+
+@pytest.mark.parametrize("seed", range(2))
+async def test_churn_mix_sharded_incremental(seed):
+    """The 2-shard flavor: sibling-shard deltas (shard_notifier stream)
+    keep every worker's incremental snapshot converged — same sequences
+    as the 1-shard rebuild baseline."""
+    rng = np.random.default_rng(8500 + seed)
+    frames = _gen_churn_frames(rng, 50)
+    d_shard, alive_s, bal_s = await _run_sharded_mix("native", frames,
+                                                     as_user=True)
+    d_single, alive_1, bal_1 = await _run_mix_incremental(False, frames,
+                                                          as_user=True)
+    assert alive_s == alive_1, f"seed {seed}: disconnect decisions differ"
+    assert d_shard == d_single, f"seed {seed}: delivery sequences differ"
+    assert bal_s and bal_1, f"seed {seed}: pool permits leaked"
+
+
 async def test_depth1_singles_equivalence():
     """Flushed singles ride the depth-1 Bytes path through the cut-through
     drain; decisions must still match the scalar loops."""
