@@ -81,14 +81,18 @@ def main() -> None:
                          "into DIR (view with TensorBoard / xprof) — the "
                          "flamegraph analog of the reference's pprof-in-"
                          "criterion integration")
-    ap.add_argument("--delivery-impl", choices=["auto", "pallas", "jnp"],
+    ap.add_argument("--delivery-impl",
+                    choices=["auto", "pallas", "jnp", "ragged"],
                     default="auto",
-                    help="delivery-matrix implementation: 'pallas' forces "
-                         "the Pallas kernel (interpreter off-TPU), 'jnp' "
-                         "forces the XLA reference — the one-command "
-                         "Pallas-vs-XLA A/B for the moment the TPU tunnel "
-                         "returns; 'auto' (default) picks Pallas on real "
-                         "TPU only")
+                    help="delivery implementation: 'pallas' forces the "
+                         "dense Pallas kernel (interpreter off-TPU), "
+                         "'jnp' forces the dense XLA reference, 'ragged' "
+                         "routes through the paged walk "
+                         "(ops.ragged_delivery — per-step work scales "
+                         "with fan-out, not U x N) — the one-command "
+                         "delivery A/B for the moment the TPU tunnel "
+                         "returns; 'auto' (default) picks the dense "
+                         "Pallas kernel on real TPU only")
     ap.add_argument("--route-impl", choices=["auto", "native", "python"],
                     default="auto",
                     help="routing plane for the host_route_msgs_s "
@@ -103,8 +107,7 @@ def main() -> None:
     # flip the router's module-level switch BEFORE any routing_step jit
     # trace reads it (trace-time capture, one value per bench process)
     from pushcdn_tpu.parallel import router as _router
-    _router.USE_PALLAS_DELIVERY = {
-        "auto": None, "pallas": True, "jnp": False}[args.delivery_impl]
+    _router.set_delivery_impl(args.delivery_impl)
 
     # A wedged accelerator tunnel hangs jax init in-process where no
     # timeout can reach it: probe device init + a real transfer in a
@@ -117,6 +120,27 @@ def main() -> None:
         "TPU measurement")
 
     state, batch = build_inputs()
+
+    ragged = args.delivery_impl == "ragged"
+    if ragged:
+        # the paged-walk inputs: a steady-state interest index over the
+        # same uniform 8-topic masks, packed once (the batch is identical
+        # every step, exactly like the dense scan's reuse)
+        from pushcdn_tpu.ops.ragged_delivery import RaggedInterest
+        from pushcdn_tpu.parallel.router import (
+            routing_step_ragged,
+            routing_step_ragged_single,
+        )
+        ri = RaggedInterest(TOPICS, max_pages=8192)
+        host_masks = np.asarray(state.topic_masks)
+        for u in range(U):
+            ri.set_mask(u, int(host_masks[u]))
+        walk = ri.pack(np.asarray(batch.kind), np.asarray(batch.topic_mask),
+                       np.asarray(batch.dest), np.asarray(batch.valid))
+        assert not walk.spilled, "bench page pool must hold the batch"
+        pages_d = jnp.asarray(walk.pages)
+        wp_d = jnp.asarray(walk.walk_page)
+        wf_d = jnp.asarray(walk.walk_frame)
 
     # warmup / compile one plain step, then carry the merged CRDT so the
     # timed steps run at the converged steady state
@@ -144,6 +168,18 @@ def main() -> None:
     per_step_bytes = int(jnp.where(delivered[:, None], batch.frame_bytes,
                                    0).sum(dtype=jnp.int32)) % M32
     state = result.state
+    if ragged:
+        # equivalence-as-honesty: the ragged walk's counted decisions must
+        # equal the dense reference's, or the timed loop below measures a
+        # different workload
+        rres = routing_step_ragged_single(state, batch, pages_d, wp_d,
+                                          wf_d)
+        ragged_count = int(rres.counts.sum(dtype=jnp.int32)) % M32
+        if ragged_count != per_step_count:
+            raise SystemExit(
+                f"ragged delivery count {ragged_count} != dense "
+                f"{per_step_count} — the paged walk dropped pairs")
+        state = rres.state
 
     # Many steps per jit call via lax.scan: intermediates (the [S, U]
     # delivery matrix, gathered bytes) stay on device across the whole
@@ -156,29 +192,58 @@ def main() -> None:
                     # round trip, measured below and reported separately)
     repeats = 5     # best-of: the tunneled chip is noisy
 
-    @jax.jit
-    def scan_decision(state, batch, acc):
-        def body(carry, _):
-            st, a = carry
-            r = routing_step(st, batch, jnp.int32(0), axis_name=None)
-            return (r.state, a + r.deliver.sum(dtype=jnp.int32)), None
-        (st, a), _ = jax.lax.scan(body, (state, acc), None, length=K)
-        return st, a
+    if ragged:
+        # the same scan harness over the paged walk: counted decisions
+        # replace the delivery-matrix sum (same modular honesty asserts),
+        # and the byte pass scatters per-frame counts to rebuild the
+        # delivered-frame mask for the byte forcing
+        @jax.jit
+        def scan_decision(state, batch, acc):
+            def body(carry, _):
+                st, a = carry
+                r = routing_step_ragged(st, batch, pages_d, wp_d, wf_d,
+                                        jnp.int32(0))
+                return (r.state, a + r.counts.sum(dtype=jnp.int32)), None
+            (st, a), _ = jax.lax.scan(body, (state, acc), None, length=K)
+            return st, a
 
-    @jax.jit
-    def scan_bytes(state, batch, acc):
-        def body(carry, _):
-            st, a = carry
-            r = routing_step(st, batch, jnp.int32(0), axis_name=None)
-            d = r.deliver.any(axis=0)                       # [S]
-            masked = jnp.where(d[:, None], batch.frame_bytes, 0)
-            # BYTE-TRUE forcing: every delivered frame's payload bytes
-            # enter the accumulator's dependency cone
-            a = a + r.deliver.sum(dtype=jnp.int32) \
-                + masked.sum(dtype=jnp.int32)
-            return (r.state, a), None
-        (st, a), _ = jax.lax.scan(body, (state, acc), None, length=K)
-        return st, a
+        @jax.jit
+        def scan_bytes(state, batch, acc):
+            def body(carry, _):
+                st, a = carry
+                r = routing_step_ragged(st, batch, pages_d, wp_d, wf_d,
+                                        jnp.int32(0))
+                d = jnp.zeros(S, jnp.int32).at[wf_d].add(r.counts) > 0
+                masked = jnp.where(d[:, None], batch.frame_bytes, 0)
+                a = a + r.counts.sum(dtype=jnp.int32) \
+                    + masked.sum(dtype=jnp.int32)
+                return (r.state, a), None
+            (st, a), _ = jax.lax.scan(body, (state, acc), None, length=K)
+            return st, a
+    else:
+        @jax.jit
+        def scan_decision(state, batch, acc):
+            def body(carry, _):
+                st, a = carry
+                r = routing_step(st, batch, jnp.int32(0), axis_name=None)
+                return (r.state, a + r.deliver.sum(dtype=jnp.int32)), None
+            (st, a), _ = jax.lax.scan(body, (state, acc), None, length=K)
+            return st, a
+
+        @jax.jit
+        def scan_bytes(state, batch, acc):
+            def body(carry, _):
+                st, a = carry
+                r = routing_step(st, batch, jnp.int32(0), axis_name=None)
+                d = r.deliver.any(axis=0)                       # [S]
+                masked = jnp.where(d[:, None], batch.frame_bytes, 0)
+                # BYTE-TRUE forcing: every delivered frame's payload bytes
+                # enter the accumulator's dependency cone
+                a = a + r.deliver.sum(dtype=jnp.int32) \
+                    + masked.sum(dtype=jnp.int32)
+                return (r.state, a), None
+            (st, a), _ = jax.lax.scan(body, (state, acc), None, length=K)
+            return st, a
 
     # calibrate the per-call overhead with a trivial scan of the same
     # length: on the tunneled backend one eager jit call costs ~70-80 ms

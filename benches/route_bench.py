@@ -812,6 +812,277 @@ async def bench_million_subs(quick: bool) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# tier 7 (ISSUE 8): the device data plane — dense-vs-ragged delivery A/B
+# (CPU twin) + the one-collective fused mesh tick (8-device dryrun)
+# ---------------------------------------------------------------------------
+
+
+def bench_device_delivery(quick: bool) -> dict:
+    """Dense delivery-matrix sweep vs ragged paged walk, uniform and
+    zipf topic popularity, on the CPU twin (jnp reference kernels — the
+    real TPU tunnel is dead, TPU_PROBES_r12.md; rows honestly labeled).
+
+    The timed unit is what egress actually consumes per tick: dense pays
+    the U x N kernel PLUS the np.nonzero bool-matrix re-scan; ragged pays
+    pack + the page walk + the compact-pair extraction. Interest is a
+    steady-state :class:`RaggedInterest` (subscriptions don't churn
+    mid-tick), frames draw topics from the same popularity law as
+    subscriptions — the zipf rows are the ISSUE 8 acceptance shape
+    (skewed fan-out, >= 4K users on the full run)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pushcdn_tpu.ops.delivery_kernel import delivery_matrix_reference
+    from pushcdn_tpu.ops.ragged_delivery import (
+        RaggedInterest,
+        ragged_delivery_pallas,
+        ragged_delivery_reference,
+        ragged_pairs,
+        ragged_pairs_grouped,
+        ragged_to_dense,
+    )
+    from pushcdn_tpu.parallel.frames import split_mask
+    from pushcdn_tpu.proto.message import KIND_BROADCAST
+
+    U = 1024 if quick else 4096
+    N = 512 if quick else 2048
+    T, W = 256, 8
+    topics_per_user = 3
+    trials = 3 if quick else 5
+    ticks = 2 if quick else 3
+    backend = jax.default_backend()
+    out: dict = {}
+
+    dense_fn = jax.jit(delivery_matrix_reference)
+    ragged_fn = jax.jit(ragged_delivery_reference)
+
+    for popularity in ("uniform", "zipf"):
+        rng = np.random.default_rng(11)
+        if popularity == "zipf":
+            p = 1.0 / np.arange(1, T + 1)
+            p /= p.sum()
+        else:
+            p = np.full(T, 1.0 / T)
+        sub = rng.choice(T, size=(U, topics_per_user), p=p)
+        masks = np.zeros((U, W), np.uint32)
+        mask_ints = []
+        for u in range(U):
+            m = 0
+            for t in sub[u]:
+                m |= 1 << int(t)
+            mask_ints.append(m)
+            masks[u] = split_mask(m, W)
+        local = np.ones(U, bool)
+        ftopic = rng.choice(T, size=N, p=p)
+        kind = np.full(N, KIND_BROADCAST, np.int32)
+        tmask = np.zeros((N, W), np.uint32)
+        for n in range(N):
+            tmask[n] = split_mask(1 << int(ftopic[n]), W)
+        dest = np.full(N, -1, np.int32)
+        valid = np.ones(N, bool)
+
+        ri = RaggedInterest(T, max_pages=8192)
+        for u in range(U):
+            ri.set_mask(u, mask_ints[u])
+        if ri.overflowed:
+            emit("device/delivery", 0, "skipped", popularity=popularity,
+                 reason="page pool overflow at bench scale")
+            continue
+
+        masks_d, local_d = jnp.asarray(masks), jnp.asarray(local)
+        tmask_d, kind_d = jnp.asarray(tmask), jnp.asarray(kind)
+        dest_d = jnp.asarray(dest)
+
+        # one equivalence check per popularity before timing anything
+        walk = ri.pack(kind, tmask, dest, valid, page_round=64)
+        assert not walk.spilled
+        dense0 = np.asarray(dense_fn(masks_d, local_d, tmask_d, kind_d,
+                                     dest_d))
+        out_u, _cnt = ragged_fn(jnp.asarray(walk.pages),
+                                jnp.asarray(walk.walk_page),
+                                jnp.asarray(walk.walk_frame),
+                                local_d, masks_d, tmask_d, kind_d, dest_d)
+        got = ragged_to_dense(np.asarray(out_u), walk.walk_frame, U, N)
+        assert (got == dense0).all(), "ragged != dense on the bench mix"
+        pairs = int(dense0.sum())
+        ri.release_transient()
+
+        def dense_tick():
+            d = np.asarray(dense_fn(masks_d, local_d, tmask_d, kind_d,
+                                    dest_d))
+            return np.nonzero(d)  # the egress pair scan the dense path pays
+
+        def ragged_tick(grouped: bool):
+            w = ri.pack(kind, tmask, dest, valid, page_round=64)
+            ou, _ = ragged_fn(jnp.asarray(w.pages),
+                              jnp.asarray(w.walk_page),
+                              jnp.asarray(w.walk_frame),
+                              local_d, masks_d, tmask_d, kind_d, dest_d)
+            if grouped:
+                res = ragged_pairs_grouped(np.asarray(ou), w, num_users=U)
+            else:
+                res = ragged_pairs(np.asarray(ou), w.walk_frame,
+                                   num_users=U)
+            ri.release_transient()
+            return res
+
+        # two ragged rows, labeled by ordering contract: "strict" keeps
+        # per-user order identical to the dense plane (the DevicePlane
+        # default); "per-topic" is the mask-group-factorized fast path
+        # (cross-topic order within a tick relaxed — the opt-in knob)
+        meds = {}
+        variants = (("dense", None, None),
+                    ("ragged", False, "strict"),
+                    ("ragged", True, "per-topic"))
+        for impl, grouped, order in variants:
+            tick = dense_tick if impl == "dense" \
+                else (lambda g=grouped: ragged_tick(g))
+            tick()  # warm (compile + caches)
+            rates = []
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                for _ in range(ticks):
+                    tick()
+                rates.append(ticks * N / (time.perf_counter() - t0))
+            med = statistics.median(rates)
+            key = impl if order is None else f"{impl}:{order}"
+            meds[key] = med
+            extra = {} if order is None else {"order": order}
+            emit("device/delivery", med, "msgs/s", impl=impl,
+                 popularity=popularity, users=U, frames=N, topics=T,
+                 pairs=pairs, backend=backend, mode="cpu-twin",
+                 trials=[round(r, 1) for r in rates], **extra)
+        if meds.get("dense"):
+            for order in ("strict", "per-topic"):
+                ratio = meds[f"ragged:{order}"] / meds["dense"]
+                emit("device/delivery", ratio, "x",
+                     tier=f"ragged-vs-dense-{popularity}", order=order,
+                     users=U, backend=backend, mode="cpu-twin")
+                suffix = "" if order == "per-topic" else "_strict"
+                out[f"delivery_ragged_vs_dense_{popularity}{suffix}"] = \
+                    round(ratio, 2)
+
+        # interpreter-mode Pallas row (recorded so the real-chip A/B is
+        # one flag away; skipped-not-mislabeled when Pallas can't run)
+        if popularity == "zipf":
+            try:
+                small = min(8, walk.n_walk) or 8
+                t0 = time.perf_counter()
+                ragged_delivery_pallas(
+                    jnp.asarray(walk.pages), jnp.asarray(walk.walk_page[:small]),
+                    jnp.asarray(walk.walk_frame[:small]), local_d, masks_d,
+                    tmask_d, kind_d, dest_d, interpret=True)
+                emit("device/delivery", small / (time.perf_counter() - t0),
+                     "walk-entries/s", impl="ragged-pallas-interpret",
+                     popularity=popularity, backend=backend, mode="cpu-twin",
+                     note="interpreter walks the grid in Python; NOT a "
+                          "chip measurement")
+            except Exception as exc:
+                emit("device/delivery", 0, "skipped",
+                     impl="ragged-pallas-interpret",
+                     reason=f"pallas unavailable: {exc!r}")
+    return out
+
+
+def bench_mesh_tick(quick: bool) -> dict:
+    """The one-collective mesh hop, dryrun: an 8-shard virtual CPU mesh
+    runs the fused lane step (one packed all_gather per tick) against the
+    per-array schedule, with the collective count ASSERTED from the
+    lowered program — the counted one-collective-per-tick invariant.
+    Labeled mode=dryrun: virtual devices measure dispatch/fusion shape,
+    not ICI."""
+    import jax
+    import jax.numpy as jnp
+
+    from pushcdn_tpu.parallel import router as router_mod
+    from pushcdn_tpu.parallel.crdt import ABSENT, CrdtState
+    from pushcdn_tpu.parallel.frames import DirectBuckets, FrameRing
+    from pushcdn_tpu.parallel.mesh import make_broker_mesh
+    from pushcdn_tpu.parallel.router import (
+        DirectIngress,
+        IngressBatch,
+        RouterState,
+        count_collectives,
+        make_mesh_lane_step,
+    )
+
+    out: dict = {}
+    n = 8
+    if len(jax.devices()) < n:
+        emit("device/mesh_tick", 0, "skipped",
+             reason=f"need {n} devices, have {len(jax.devices())}")
+        return out
+    mesh = make_broker_mesh(n)
+    U, S, F, C = 64, 16, 256, 4
+    owners = np.full((n, U), ABSENT, np.int32)
+    versions = np.zeros((n, U), np.uint32)
+    ids = np.full((n, U), ABSENT, np.int32)
+    masks = np.zeros((n, U), np.uint32)
+    for i in range(n):
+        owners[i, i] = i
+        versions[i, i] = 1
+        ids[i, i] = i
+        masks[i, i] = 0b1
+    state = RouterState(
+        CrdtState(jnp.asarray(owners), jnp.asarray(versions),
+                  jnp.asarray(ids)), jnp.asarray(masks))
+    parts = []
+    for i in range(n):
+        ring = FrameRing(slots=S, frame_bytes=F)
+        for j in range(S // 2):
+            ring.push_broadcast(b"b%d-%d" % (i, j), 0b1)
+        parts.append(ring.take_batch())
+    batch = IngressBatch(
+        *[jnp.asarray(np.stack([getattr(x, f) for x in parts]))
+          for f in ("bytes_", "kind", "length", "topic_mask", "dest",
+                    "valid")])
+    dparts = []
+    for i in range(n):
+        d = DirectBuckets(n, capacity=C, frame_bytes=F)
+        d.push((i + 1) % n, b"d%d" % i, dest_slot=(i + 1) % n)
+        dparts.append(d.take_batch())
+    direct = DirectIngress(
+        *[jnp.asarray(np.stack([getattr(x, f) for x in dparts]))
+          for f in ("bytes_", "length", "dest", "valid")])
+    live = jnp.ones((n, n), bool)
+
+    trials = 3 if quick else 5
+    ticks = 20 if quick else 50
+    expected = None
+    for label, fused in (("fused", True), ("per-array", False)):
+        step = make_mesh_lane_step(mesh, gather_bytes=False, fused=fused)
+        lowered = jax.jit(step).lower(state, (batch,), (direct,),
+                                      live).as_text()
+        collectives = count_collectives(lowered)
+        if fused:
+            assert collectives == 1, (
+                f"fused mesh tick must be exactly ONE collective, "
+                f"lowered to {collectives}")
+        res = step(state, (batch,), (direct,), live)  # compile + warm
+        jax.block_until_ready(res.lanes[0].deliver)
+        total = int(np.asarray(res.lanes[0].deliver).sum()) \
+            + int(np.asarray(res.direct_lanes[0].deliver).sum())
+        if expected is None:
+            expected = total
+        assert total == expected, "fused and per-array ticks must agree"
+        rates = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            for _ in range(ticks):
+                res = step(state, (batch,), (direct,), live)
+            jax.block_until_ready(res.lanes[0].deliver)
+            rates.append(ticks / (time.perf_counter() - t0))
+        med = statistics.median(rates)
+        emit("device/mesh_tick", med, "ticks/s", impl=label,
+             collectives=collectives, devices=n, backend="cpu",
+             mode="dryrun", deliveries=total,
+             trials=[round(r, 1) for r in rates])
+        out[f"mesh_tick_{label.replace('-', '_')}_ticks_s"] = round(med, 1)
+        out[f"mesh_tick_{label.replace('-', '_')}_collectives"] = collectives
+    return out
+
+
+# ---------------------------------------------------------------------------
 # tier 2: end-to-end broker forwarding through the wire
 # ---------------------------------------------------------------------------
 
@@ -833,6 +1104,30 @@ async def bench_forward(impl: str, receivers: int, msgs: int,
          trials=[round(r, 1) for r in res["trials"]],
          max=round(max(res["trials"]), 1))
     return res["median"]
+
+
+async def bench_forward_decoded(impl: str, receivers: int, msgs: int,
+                                trials: int) -> dict:
+    """ISSUE 8 client-receive-residue row: the SAME forwarding loop, but
+    receivers drain through the real client batch decode (zero-copy
+    payload views) — the application-visible delivered/s, re-measured
+    through ``receive_messages``' own code path (BASELINE.md tracks how
+    the figure moves vs the transport-count row)."""
+    from pushcdn_tpu.testing.routebench import forward_rate
+    res = await forward_rate(impl, receivers=receivers, msgs=msgs,
+                             trials=trials, client_decode=True)
+    if res is None:
+        emit("route/forward_decoded", 0, "skipped", impl=impl,
+             reason="native route-plan kernel unavailable")
+        return {}
+    emit("route/forward_decoded", res["median"], "msgs/s", impl=impl,
+         receivers=receivers, msgs=res["msgs"], payload=res["payload"],
+         decode="receive_messages", zero_copy=True,
+         delivered_msgs_s=round(res["delivered"], 1),
+         trials=[round(r, 1) for r in res["trials"]],
+         max=round(max(res["trials"]), 1))
+    return {"forward_decoded_msgs_s": round(res["median"], 1),
+            "forward_decoded_delivered_s": round(res["delivered"], 1)}
 
 
 async def amain(quick: bool, impl_arg: str,
@@ -862,13 +1157,33 @@ async def amain(quick: bool, impl_arg: str,
 
     fwd: dict = {}
     for impl in impls:
+        # 5 full-mode trials: single same-process draws on this shared
+        # core range ±10% (BASELINE r12 methodology note) — the r11
+        # regression row needs the median to out-vote throttle dips
         fwd[impl] = await bench_forward(
             impl, receivers=8, msgs=2_000 if quick else 10_000,
-            trials=2 if quick else 3)
+            trials=2 if quick else 5)
         gc.collect()
     if fwd.get("native") and fwd.get("python"):
         emit("route/ratio", fwd["native"] / fwd["python"], "x",
              tier="forward")
+
+    # ISSUE 8 satellite: the 8-receiver row through the real client
+    # decode (zero-copy receive path)
+    from pushcdn_tpu.native import routeplan as _routeplan
+    dec_impl = "native" if ("native" in impls
+                            and _routeplan.available()) else "python"
+    stats.update(await bench_forward_decoded(
+        dec_impl, receivers=8, msgs=2_000 if quick else 10_000,
+        trials=2 if quick else 3))
+    gc.collect()
+
+    # ISSUE 8: the device data plane — dense-vs-ragged delivery A/B on
+    # the CPU twin + the one-collective fused mesh tick (dryrun)
+    stats.update(bench_device_delivery(quick))
+    gc.collect()
+    stats.update(bench_mesh_tick(quick))
+    gc.collect()
 
     # trace-overhead A/B on the primary deployment path (native when it
     # compiled here; otherwise the scalar loops get the same row so the
@@ -921,7 +1236,7 @@ def write_bench_json(path: str, section: str, headline: dict,
                 doc = json.load(fh)
         except (OSError, ValueError):
             doc = {}
-    doc.setdefault("round", 11)
+    doc.setdefault("round", 12)
     doc[section] = {"headline": headline, "rows": rows}
     with open(path, "w") as fh:
         json.dump(doc, fh, indent=1)
@@ -930,6 +1245,13 @@ def write_bench_json(path: str, section: str, headline: dict,
 
 
 def main() -> None:
+    # the mesh-tick dryrun tier needs 8 virtual CPU devices; the flag
+    # must land before jax first initializes (all jax imports in this
+    # bench are lazy, so here is early enough)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--route-impl", choices=["auto", "native", "python"],

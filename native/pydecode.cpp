@@ -88,10 +88,35 @@ PyObject* alloc_with_slots(PyTypeObject* type, Py_ssize_t off_a,
   return obj;
 }
 
+// A zero-copy payload: a slice of `master` (a memoryview over the chunk
+// buffer — the buffer stays alive through the view's reference chain).
+// Returns a new reference, or NULL with an exception set.
+PyObject* slice_view(PyObject* master, Py_ssize_t start, Py_ssize_t stop) {
+  PyObject* lo = PyLong_FromSsize_t(start);
+  PyObject* hi = PyLong_FromSsize_t(stop);
+  if (lo == nullptr || hi == nullptr) {
+    Py_XDECREF(lo);
+    Py_XDECREF(hi);
+    return nullptr;
+  }
+  PyObject* sl = PySlice_New(lo, hi, nullptr);
+  Py_DECREF(lo);
+  Py_DECREF(hi);
+  if (sl == nullptr) return nullptr;
+  PyObject* out = PyObject_GetItem(master, sl);
+  Py_DECREF(sl);
+  return out;
+}
+
 // Decode one frame at data[o : o+n]. Returns a new message object, or
-// NULL with an exception set.
+// NULL with an exception set. With `master` non-NULL (a memoryview of
+// the whole buffer), hot payloads of at least `zc_min` bytes come back
+// as zero-copy views; smaller ones stay owned copies (message.py
+// ZERO_COPY_MIN rationale: the copy is cheaper than the view object AND
+// a retained view pins its whole chunk after the permit returns).
 PyObject* decode_one(const uint8_t* data, Py_ssize_t o, Py_ssize_t n,
-                     PyObject* fallback) {
+                     PyObject* fallback, PyObject* master,
+                     Py_ssize_t zc_min) {
   if (n >= 3) {
     const uint8_t kind = data[o];
     if (kind == KIND_BROADCAST) {
@@ -102,8 +127,11 @@ PyObject* decode_one(const uint8_t* data, Py_ssize_t o, Py_ssize_t n,
         if (topics == nullptr) return nullptr;
         for (Py_ssize_t t = 0; t < nt; t++)
           PyTuple_SET_ITEM(topics, t, PyLong_FromLong(data[o + 3 + t]));
-        PyObject* msg = PyBytes_FromStringAndSize(
-            (const char*)data + o + 3 + nt, n - 3 - nt);
+        PyObject* msg =
+            master != nullptr && n - 3 - nt >= zc_min
+                ? slice_view(master, o + 3 + nt, o + n)
+                : PyBytes_FromStringAndSize((const char*)data + o + 3 + nt,
+                                            n - 3 - nt);
         if (msg == nullptr) {
           Py_DECREF(topics);
           return nullptr;
@@ -117,11 +145,16 @@ PyObject* decode_one(const uint8_t* data, Py_ssize_t o, Py_ssize_t n,
                               ((Py_ssize_t)data[o + 3] << 16) |
                               ((Py_ssize_t)data[o + 4] << 24);
       if (5 + rlen <= n) {
+        // the recipient stays an owned bytes copy: it is small and used
+        // as a dict key (hashable) by every consumer
         PyObject* rcpt =
             PyBytes_FromStringAndSize((const char*)data + o + 5, rlen);
         if (rcpt == nullptr) return nullptr;
-        PyObject* msg = PyBytes_FromStringAndSize(
-            (const char*)data + o + 5 + rlen, n - 5 - rlen);
+        PyObject* msg =
+            master != nullptr && n - 5 - rlen >= zc_min
+                ? slice_view(master, o + 5 + rlen, o + n)
+                : PyBytes_FromStringAndSize((const char*)data + o + 5 + rlen,
+                                            n - 5 - rlen);
         if (msg == nullptr) {
           Py_DECREF(rcpt);
           return nullptr;
@@ -145,7 +178,9 @@ PyObject* decode_one(const uint8_t* data, Py_ssize_t o, Py_ssize_t n,
 extern "C" {
 
 // Decode frames [start, len(offs)) of one chunk into a list of message
-// objects. Returns:
+// objects. With zero_copy_min > 0, Broadcast/Direct payloads of at least
+// that many bytes are memoryview slices over `buf` (one master view per
+// call; the buffer lives as long as any view). Returns:
 //   - new list on success;
 //   - Py_None (new ref) when inputs don't fit the fast path (caller falls
 //     back to the Python decoder);
@@ -154,7 +189,8 @@ PyObject* pushcdn_decode_frames_py(PyObject* buf, PyObject* offs,
                                    PyObject* lens, Py_ssize_t start,
                                    PyObject* broadcast_type,
                                    PyObject* direct_type,
-                                   PyObject* fallback) {
+                                   PyObject* fallback,
+                                   Py_ssize_t zero_copy_min) {
   // (re)resolve when first called OR when the caller's classes changed
   // (module reload): constructing stale types would silently break
   // type() checks downstream, and a GC'd old type would dangle.
@@ -171,8 +207,16 @@ PyObject* pushcdn_decode_frames_py(PyObject* buf, PyObject* offs,
   if (PyList_GET_SIZE(lens) != count || start < 0 || start > count)
     Py_RETURN_NONE;
 
+  PyObject* master = nullptr;
+  if (zero_copy_min > 0) {
+    master = PyMemoryView_FromObject(buf);
+    if (master == nullptr) return nullptr;
+  }
   PyObject* out = PyList_New(count - start);
-  if (out == nullptr) return nullptr;
+  if (out == nullptr) {
+    Py_XDECREF(master);
+    return nullptr;
+  }
 
   for (Py_ssize_t i = start; i < count; i++) {
     const Py_ssize_t o = PyLong_AsSsize_t(PyList_GET_ITEM(offs, i));
@@ -184,15 +228,19 @@ PyObject* pushcdn_decode_frames_py(PyObject* buf, PyObject* offs,
       // a third behavior here)
       PyErr_Clear();
       Py_DECREF(out);
+      Py_XDECREF(master);
       Py_RETURN_NONE;
     }
-    PyObject* item = decode_one(data, o, n, fallback);
+    PyObject* item = decode_one(data, o, n, fallback, master,
+                                zero_copy_min);
     if (item == nullptr) {
       Py_DECREF(out);
+      Py_XDECREF(master);
       return nullptr;
     }
     PyList_SET_ITEM(out, i - start, item);
   }
+  Py_XDECREF(master);
   return out;
 }
 

@@ -113,6 +113,23 @@ class DevicePlaneConfig:
     # and the single-shard plane's host path covers exactly the same
     # local users. 0 disables (tests of staging mechanics do).
     bypass_max_items: int = 2
+    # Delivery implementation: "auto" follows router.DELIVERY_IMPL (the
+    # bench.py --delivery-impl switch, PUSHCDN_DELIVERY_IMPL env);
+    # "ragged" forces the paged walk (ops.ragged_delivery — per-tick work
+    # scales with fan-out, compact pairs feed egress_delivery_rows with
+    # no bool[U,N] re-scan); "dense" forces the delivery-matrix kernels.
+    delivery_impl: str = "auto"
+    # page-pool capacity for the ragged interest index (PAGE-slot pages;
+    # exhaustion falls the plane back to the dense step, never drops)
+    ragged_max_pages: int = 1024
+    # Relaxed-order pair extraction (ragged_pairs_grouped): a multi-topic
+    # subscriber's same-tick frames arrive grouped per topic-mask instead
+    # of in frame-staging order — per-topic FIFO holds, cross-topic order
+    # within one tick does not (the same relaxation class as cross-LANE
+    # reordering, which the size-bucketed rings already accept). Off by
+    # default: the strict extractor keeps per-user order identical to the
+    # dense plane at the cost of one radix sort over the tick's pairs.
+    ragged_relaxed_order: bool = False
 
     def lane_shapes(self):
         """All lanes as (frame_bytes, ring_slots), sorted ascending by
@@ -153,6 +170,26 @@ class DevicePlane:
         # DECISION comes back, payloads egress from the host ring snapshot)
         self._idle_dev_lanes = {}
         self._byte_stubs = {}
+        # ragged paged delivery (ISSUE 8): the incremental per-topic page
+        # index is maintained from the same observer hooks as the mirrors;
+        # per tick the pump packs a walk list and the step runs the paged
+        # kernel instead of the U x N sweep. Resolved once at construction
+        # (env > config > router.DELIVERY_IMPL).
+        import os as _os
+        impl = _os.environ.get("PUSHCDN_DELIVERY_IMPL", "") or \
+            c.delivery_impl
+        if impl == "auto":
+            from pushcdn_tpu.parallel import router as _router
+            impl = _router.DELIVERY_IMPL or "dense"
+        self.delivery_impl = "ragged" if impl == "ragged" else "dense"
+        self._ragged = None
+        self._ragged_retry_below = 0  # rebuild-retry mark post-overflow
+        if self.delivery_impl == "ragged":
+            from pushcdn_tpu.ops.ragged_delivery import RaggedInterest
+            self._ragged = RaggedInterest(
+                32 * c.topic_words, max_pages=c.ragged_max_pages)
+        self.ragged_steps = 0       # ticks routed through the paged walk
+        self.ragged_fallbacks = 0   # ticks that fell back to dense
         self.disabled = False
         # single-shard planes keep inter-broker traffic on host links, so
         # they never *need* overflow dialing — the attribute exists because
@@ -166,6 +203,36 @@ class DevicePlane:
 
     # ---- user lifecycle (Connections observer; event-loop only) ----------
 
+    def _ragged_set_mask(self, slot: int, topics) -> None:
+        """Mirror a mask change into the ragged page index (O(changed
+        topics)). Pool exhaustion falls the plane back to the dense step
+        — never a dropped delivery — and once membership shrinks to half
+        the overflow-time population a ``rebuild()`` is attempted (rate-
+        limited by halving the retry mark on failure) so the plane
+        returns to the paged walk instead of staying dense forever."""
+        if self._ragged is None:
+            return
+        from pushcdn_tpu.parallel.frames import mask_of_topics
+        self._ragged.set_mask(
+            slot, mask_of_topics(topics, self.config.topic_words)
+            if topics else 0)
+        if not self._ragged.overflowed:
+            return
+        if self.delivery_impl == "ragged":
+            logger.warning(
+                "ragged page pool exhausted (%d pages); device plane "
+                "falling back to the dense delivery step",
+                self.config.ragged_max_pages)
+            self.delivery_impl = "dense"
+            self._ragged_retry_below = max(len(self._ragged) // 2, 1)
+        elif len(self._ragged) <= self._ragged_retry_below:
+            if self._ragged.rebuild():
+                logger.info("ragged page index rebuilt (%d users); "
+                            "resuming paged delivery", len(self._ragged))
+                self.delivery_impl = "ragged"
+            else:  # still too big: wait for a further halving
+                self._ragged_retry_below = max(len(self._ragged) // 2, 1)
+
     def on_user_added(self, public_key: bytes, topics) -> None:
         try:
             slot = self.slots.assign(public_key)
@@ -178,6 +245,7 @@ class DevicePlane:
             return
         self._owned[slot] = True
         self._masks[slot] = mask_row_of(topics, self.config.topic_words)
+        self._ragged_set_mask(slot, topics)
         self._state_rev += 1
 
     def on_user_removed(self, public_key: bytes) -> None:
@@ -187,6 +255,7 @@ class DevicePlane:
             return
         self._owned[slot] = False
         self._masks[slot] = 0
+        self._ragged_set_mask(slot, None)
         self._state_rev += 1
         # the slot index stays quarantined until the next step completes —
         # in-flight frames may still address it
@@ -197,6 +266,7 @@ class DevicePlane:
         if slot is None:
             return
         self._masks[slot] = mask_row_of(topics, self.config.topic_words)
+        self._ragged_set_mask(slot, topics)
         self._state_rev += 1
 
     # ---- ingress ----------------------------------------------------------
@@ -312,6 +382,25 @@ class DevicePlane:
         await asyncio.to_thread(self._warmup)
         self._task = asyncio.create_task(self._pump(), name="device-pump")
 
+    def _pack_walks(self, batches):
+        """Pack one walk list per lane (event-loop only — the index is
+        observer-mutated there). Returns None when any frame spilled
+        (transient-page exhaustion) — the dense step covers that tick."""
+        walks = []
+        spilled = False
+        for b in batches:
+            w = self._ragged.pack(b.kind, b.topic_mask, b.dest, b.valid,
+                                  page_round=64)
+            walks.append(w)
+            spilled = spilled or bool(w.spilled)
+        # pack() snapshots the pool, so transient union/direct pages
+        # recycle immediately (wraparound)
+        self._ragged.release_transient()
+        if spilled:
+            self.ragged_fallbacks += 1
+            return None
+        return walks
+
     def _warmup(self) -> None:
         from pushcdn_tpu.parallel.frames import slice_batch
         empty = [r.take_batch() for r in self.rings]
@@ -322,10 +411,15 @@ class DevicePlane:
             # at full shapes (idle lanes ride cached device empties) and
             # the latency-sliced base lane; wider user buckets compile on
             # first growth past the mark
+            walks = self._pack_walks(empty) \
+                if self.delivery_impl == "ragged" else None
             self._run_step(empty, self._owned[:u0].copy(),
-                           self._masks[:u0].copy())
+                           self._masks[:u0].copy(), walks=walks,
+                           compile_only=True)
             self._run_step(lat[:1], self._owned[:u0].copy(),
-                           self._masks[:u0].copy())
+                           self._masks[:u0].copy(),
+                           walks=None if walks is None else walks[:1],
+                           compile_only=True)
             self.steps -= 2  # warmup doesn't count
         except Exception:
             logger.exception("device-plane warmup step failed")
@@ -373,12 +467,20 @@ class DevicePlane:
             owned = self._owned[:u_eff].copy()
             masks = self._masks[:u_eff].copy()
             rev = self._state_rev
+            # pack the ragged walk in the SAME event-loop tick as the
+            # snapshot (the page index is observer-mutated on the loop;
+            # pack copies the referenced pool prefix). Overflow demotes
+            # delivery_impl to "dense" (the index stays maintained for
+            # the rebuild-retry path), so gate on the impl, not the index
+            walks = self._pack_walks(batches_np) \
+                if self.delivery_impl == "ragged" else None
             quarantined, self._quarantine = self._quarantine, []
             try:
                 self._step_inflight = True
                 try:
                     jobs = await asyncio.to_thread(
-                        self._run_step, batches_np, owned, masks, rev)
+                        self._run_step, batches_np, owned, masks, rev,
+                        walks)
                 finally:
                     self._step_inflight = False
                 gate.stepped(loop.time())
@@ -407,7 +509,7 @@ class DevicePlane:
                     self.slots.free_slot(slot)
 
     def _run_step(self, lane_batches, owned: np.ndarray, masks: np.ndarray,
-                  state_rev=None):
+                  state_rev=None, walks=None, compile_only: bool = False):
         """Blocking device step (runs in a worker thread) against the
         snapshotted mirrors. All lanes ride one jitted program; idle lanes
         reuse cached device-side empty batches (zero H2D, and the jit key
@@ -417,7 +519,14 @@ class DevicePlane:
         egress encodes payloads from the host ring snapshots via the
         native engine. Returns per-lane egress jobs: (EgressStreams, -, -,
         -) on the native path or (None, deliver, lengths, frames) for the
-        Python fallback."""
+        Python fallback.
+
+        ``walks`` (one RaggedWalk per lane) switches to the ragged paged
+        step: per-tick device work scales with fan-out and the step's
+        compact (frame, receiver-run) output feeds
+        ``senders.egress_delivery_rows`` directly — no bool[U, N] comes
+        back and Python never re-scans one. ``compile_only`` runs every
+        lane regardless of traffic (warmup) and returns no jobs."""
         import jax.numpy as jnp
         from pushcdn_tpu import native as native_mod
 
@@ -456,6 +565,47 @@ class DevicePlane:
             return dev
 
         busy = [bool(b.valid.any()) for b in lane_batches]
+
+        if walks is not None:
+            # ---- ragged paged step: one walk per lane ----
+            from pushcdn_tpu.ops.ragged_delivery import (
+                ragged_pairs,
+                ragged_pairs_grouped,
+            )
+            from pushcdn_tpu.parallel.router import \
+                routing_step_ragged_single
+            jobs = []
+            routed_ragged = False
+            for li, (b, walk) in enumerate(zip(lane_batches, walks)):
+                if not busy[li] and not compile_only:
+                    continue  # an idle lane has no walk entries
+                res = routing_step_ragged_single(
+                    state, to_dev(li, b, busy[li]),
+                    jnp.asarray(walk.pages), jnp.asarray(walk.walk_page),
+                    jnp.asarray(walk.walk_frame))
+                if compile_only:
+                    res.counts.block_until_ready()
+                    continue
+                routed_ragged = True
+                out_user = np.asarray(res.out_user)
+                if self.config.ragged_relaxed_order:
+                    # per-topic FIFO only (see the config knob's docs)
+                    users, frame_idx = ragged_pairs_grouped(
+                        out_user, walk,
+                        num_users=self.config.num_user_slots)
+                else:
+                    # strict: per-user order identical to the dense plane
+                    users, frame_idx = ragged_pairs(
+                        out_user, walk.walk_frame,
+                        num_users=self.config.num_user_slots)
+                if len(users):
+                    jobs.append((None, (users, frame_idx), b.length,
+                                 b.bytes_))
+            self.steps += 1
+            if routed_ragged:  # warmup compile runs don't count as ticks
+                self.ragged_steps += 1
+            return jobs
+
         batches = tuple(to_dev(li, b, busy[li])
                         for li, b in enumerate(lane_batches))
         result = routing_step_lanes_single(state, batches,
@@ -477,12 +627,17 @@ class DevicePlane:
         return jobs
 
     def _egress(self, deliver, lengths, frames) -> None:
-        """Walk the delivery matrix and queue the original wire frames to
-        local user connections — non-blocking and grouped per user
-        (senders.egress_delivery_rows), so one slow consumer cannot stall
-        the pump (its overflow is handled by the failure-is-removal
-        policy in the sender)."""
-        users, frame_idx = np.nonzero(deliver)
+        """Queue delivered wire frames to local user connections —
+        non-blocking and grouped per user (senders.egress_delivery_rows),
+        so one slow consumer cannot stall the pump (its overflow is
+        handled by the failure-is-removal policy in the sender).
+        ``deliver`` is either the dense bool[U, N] matrix (scanned here —
+        the Python-fallback path) or the ragged step's compact
+        ``(users, frame_idx)`` pair listing, consumed as-is."""
+        if isinstance(deliver, tuple):
+            users, frame_idx = deliver
+        else:
+            users, frame_idx = np.nonzero(deliver)
         cache: dict[int, Bytes] = {}
 
         def frame_of(f: int) -> Bytes:

@@ -109,6 +109,14 @@ class MeshGroupConfig:
     # snapshots (router.routing_step_lanes gather_bytes docs). Multi-host
     # deployments set this True.
     gather_frame_bytes: bool = False
+    # One sharding-aware collective per tick: every gathered leaf (CRDT
+    # state, lane metadata, direct buckets — frame bytes too when
+    # ``gather_frame_bytes``) is packed into one u32 buffer and moved by a
+    # single all_gather, the all_to_all folded in as gather+local-slice
+    # (router._routing_step_lanes_fused). Off restores the per-array
+    # collective schedule — the right call for byte-gathering multi-host
+    # pods where the fused form pays B-fold redundancy on direct payloads.
+    fused_collective: bool = True
 
     def lane_shapes(self):
         """All lanes as (frame_bytes, ring_slots, direct_bucket_slots),
@@ -182,7 +190,8 @@ class MeshBrokerGroup:
         c = self.config
         self.num_shards = mesh.devices.size
         self.step_fn = make_mesh_lane_step(
-            mesh, gather_bytes=self.config.gather_frame_bytes)
+            mesh, gather_bytes=self.config.gather_frame_bytes,
+            fused=self.config.fused_collective)
         # every step input is placed PRE-SHARDED over the broker axis:
         # jit would otherwise silently reshard device-0-resident arrays
         # inside every call (~0.5 ms/array on an 8-device CPU mesh)
@@ -235,6 +244,11 @@ class MeshBrokerGroup:
         self._state_dirty = False  # forces a step with no staged traffic
         self.steps = 0
         self.messages_routed = 0
+        # collectives traced by the most recently COMPILED step
+        # specialization (router.trace_collectives delta around the call):
+        # the counted one-collective-per-tick invariant, asserted by the
+        # mesh dryrun tier. None until a step has traced in this process.
+        self.collectives_last_trace: Optional[int] = None
 
     # ---- wiring ----------------------------------------------------------
 
@@ -708,7 +722,12 @@ class MeshBrokerGroup:
         lane_directs = tuple(
             lane_to_dev(("d", li, lane[0].valid.shape[1]), lane, busy_d[li])
             for li, lane in enumerate(directs))
+        from pushcdn_tpu.parallel import router as router_mod
+        before = router_mod.trace_collectives()
         result = self.step_fn(state, lane_batches, lane_directs, live_dev)
+        traced = router_mod.trace_collectives() - before
+        if traced:  # this call compiled a fresh specialization
+            self.collectives_last_trace = traced
         self.steps += 1
         # ---- egress prep: decisions from the mesh, payloads from host ----
         # (idle lanes can't deliver: skip their D2H entirely)

@@ -49,6 +49,38 @@ RETRY_INTERVAL_S = 2.0      # lib.rs reconnect cadence
 CONNECT_TIMEOUT_S = 10.0    # per-attempt timeout
 
 
+def decode_received(items) -> List[Message]:
+    """Decode a ``Connection.recv_frames`` drain into Message objects —
+    the client receive path's batch decoder, shared with the benches so
+    the measured decode IS what ``receive_messages`` runs. FrameChunks
+    batch-decode off the shared buffer with ZERO-COPY memoryview payloads
+    for Broadcast/Direct (FrameChunk.decode_remaining); bare frames take
+    the owned single-frame decoder. Every item is released here on
+    success; on failure the caller owns cleanup (the client tears the
+    connection down, which releases the rest)."""
+    from pushcdn_tpu.proto.transport.base import FrameChunk
+    out: List[Message] = []
+    i = 0
+    try:
+        for i, item in enumerate(items):
+            if type(item) is FrameChunk:
+                # whole-chunk batch decode off the shared buffer: zero
+                # payload copies, one release for the lot (the returned
+                # views keep the buffer alive)
+                out.extend(item.decode_remaining())
+            else:
+                out.append(deserialize_owned(item.data))
+                item.release()
+    except BaseException:
+        # the failing item's chunk path already released itself
+        # (decode_remaining is try/finally; release is idempotent);
+        # everything at and after the failure returns its permit here
+        for item in items[i:]:
+            item.release()
+        raise
+    return out
+
+
 @dataclass
 class ClientConfig:
     """Parity with the client Config (cdn-client/src/lib.rs)."""
@@ -251,7 +283,6 @@ class Client:
         ``max_messages`` is approximate: the transport hands over whole
         parse batches, so one call may return more than asked (never
         fewer than 1)."""
-        from pushcdn_tpu.proto.transport.base import FrameChunk
         if self._pending_shed is not None:
             err, self._pending_shed = self._pending_shed, None
             raise err
@@ -263,22 +294,15 @@ class Client:
         except Exception as exc:
             self._disconnect_on_error()
             bail(ErrorKind.CONNECTION, "receive failed; connection reset", exc)
-        out = []
         try:
-            for item in items:
-                if type(item) is FrameChunk:
-                    # whole-chunk batch decode off the shared buffer: one
-                    # payload copy per message, one release for the lot
-                    out.extend(item.decode_remaining())
-                else:
-                    out.append(deserialize_owned(item.data))
+            # batch decode with ZERO-COPY payloads (decode_received docs):
+            # the old one-copy-per-message residue is gone — Broadcast/
+            # Direct ``message`` fields are memoryviews over the chunk
+            out = decode_received(items)
         except Exception as exc:
             self._disconnect_on_error()
             bail(ErrorKind.CONNECTION,
                  "malformed frame in receive batch; connection reset", exc)
-        finally:
-            for item in items:
-                item.release()
         # load-shed notices (permit=0 post-handshake) surface as typed
         # Error(SHED): immediately when nothing else arrived, otherwise
         # after the real deliveries are handed over (next receive call) —

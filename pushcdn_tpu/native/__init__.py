@@ -131,17 +131,20 @@ def _compile_pydecode():
     fn.restype = ctypes.py_object
     fn.argtypes = [ctypes.py_object, ctypes.py_object, ctypes.py_object,
                    ctypes.c_ssize_t, ctypes.py_object, ctypes.py_object,
-                   ctypes.py_object]
+                   ctypes.py_object, ctypes.c_ssize_t]
     return fn
 
 
 def pydecode():
     """The batch frame→Message decoder, or None when unavailable.
 
-    Signature: ``fn(buf, offs, lens, start, Broadcast, Direct, fallback)``
-    → list of messages, or None when the inputs don't fit the C fast path
-    (caller must then run the Python decoder). Raises whatever ``fallback``
-    raises on malformed frames.
+    Signature: ``fn(buf, offs, lens, start, Broadcast, Direct, fallback,
+    zero_copy_min)`` → list of messages, or None when the inputs don't
+    fit the C fast path (caller must then run the Python decoder). With
+    ``zero_copy_min > 0``, hot payloads of at least that many bytes are
+    memoryview slices over ``buf`` instead of owned copies
+    (message.ZERO_COPY_MIN is the callers' threshold). Raises whatever
+    ``fallback`` raises on malformed frames.
     """
     global _pydecode_fn, _pydecode_tried
     if _pydecode_fn is None and not _pydecode_tried:

@@ -44,14 +44,79 @@ from pushcdn_tpu.parallel.crdt import (
     merge_all_gathered_with_payload,
 )
 from pushcdn_tpu.ops.delivery_kernel import delivery_matrix
+from pushcdn_tpu.ops.ragged_delivery import ragged_delivery
 
 BROKER_AXIS = "brokers"
 
 # None = auto (Pallas on TPU / interpreter elsewhere when shapes align);
 # flip to False to force the jnp reference path (bench comparisons).
-# `bench.py --delivery-impl {auto,pallas,jnp}` sets this before the first
-# routing_step trace — the one-command Pallas-vs-XLA A/B.
+# `bench.py --delivery-impl {auto,pallas,jnp,ragged}` sets this before the
+# first routing_step trace — the one-command delivery-impl A/B.
 USE_PALLAS_DELIVERY: Optional[bool] = None
+
+# The selected delivery implementation by name (None = auto). "ragged"
+# switches consumers (bench.py, DevicePlane) onto the paged walk
+# (ops.ragged_delivery) with the dense kernel kept as the in-repo twin.
+DELIVERY_IMPL: Optional[str] = None
+
+# Pallas-vs-jnp switch for the RAGGED kernel specifically (None = auto:
+# Pallas on real TPU, jnp twin elsewhere — same policy as the dense flag).
+RAGGED_USE_PALLAS: Optional[bool] = None
+
+
+def set_delivery_impl(impl: str) -> None:
+    """One switch for every delivery-impl consumer: 'auto' restores the
+    backend-keyed default, 'pallas'/'jnp' force the dense kernel's mode,
+    'ragged' selects the paged walk (jnp twin off-TPU)."""
+    global DELIVERY_IMPL, USE_PALLAS_DELIVERY
+    if impl not in ("auto", "pallas", "jnp", "ragged"):
+        raise ValueError(f"unknown delivery impl {impl!r}")
+    DELIVERY_IMPL = None if impl == "auto" else impl
+    USE_PALLAS_DELIVERY = {"pallas": True, "jnp": False}.get(impl)
+
+
+# ---------------------------------------------------------------------------
+# collective accounting (the one-collective-per-tick invariant)
+# ---------------------------------------------------------------------------
+#
+# Every collective the router's programs issue goes through the two
+# helpers below, which bump a trace-time counter — ``trace_collectives()``
+# deltas around a jit trace count a program's collectives without parsing
+# HLO. ``count_collectives`` is the lowering-level twin (counts collective
+# ops in ``jit(...).lower(...).as_text()``): the mesh dryrun test asserts
+# BOTH agree that a fused tick is exactly one collective.
+
+_TRACE_COLLECTIVES = [0]
+
+
+def trace_collectives() -> int:
+    """Collectives traced so far in this process (diff around a trace)."""
+    return _TRACE_COLLECTIVES[0]
+
+
+def _all_gather_counted(x: jax.Array, axis_name: str) -> jax.Array:
+    _TRACE_COLLECTIVES[0] += 1
+    return jax.lax.all_gather(x, axis_name)
+
+
+def _all_to_all_counted(x: jax.Array, axis_name: str) -> jax.Array:
+    _TRACE_COLLECTIVES[0] += 1
+    return jax.lax.all_to_all(x, axis_name, 0, 0)
+
+
+def count_collectives(lowered_text: str) -> int:
+    """Count collective ops in a lowered program text. Feed it
+    ``jit(step).lower(*args).as_text()`` (StableHLO — one textual op per
+    collective); compiled HLO can split one collective into start/done
+    pairs and is not a supported input."""
+    ops = ("stablehlo.all_gather", "stablehlo.all_to_all",
+           "stablehlo.all_reduce", "stablehlo.collective_permute")
+    if any(op in lowered_text for op in ops):
+        return sum(lowered_text.count(op) for op in ops)
+    # pre-stablehlo (mhlo) spelling, same one-op-per-collective property
+    return sum(lowered_text.count(op) for op in
+               ("mhlo.all_gather", "mhlo.all_to_all", "mhlo.all_reduce",
+                "mhlo.collective_permute"))
 
 
 class RouterState(NamedTuple):
@@ -106,29 +171,64 @@ def empty_router_state(num_users: int, topic_words: int = 1) -> RouterState:
     )
 
 
-def _direct_route(direct: DirectIngress, now_local: jax.Array,
-                  axis_name: Optional[str],
-                  liveness: Optional[jax.Array] = None,
-                  gather_bytes: bool = True):
-    """Exchange per-destination buckets and build the local delivery mask.
-
-    ``all_to_all`` swaps the destination-shard axis for a source-shard
-    axis: received[j] = what shard j staged for *this* shard. Delivery is
-    iff the addressed slot is locally owned — ownership moves race exactly
-    like the reference's forward-to-old-owner during CRDT convergence, and
-    resolve the same way (deliver-iff-owner, never re-forward)."""
-    if axis_name is None:
-        r_bytes, r_length, r_dest, r_valid = (
-            direct.frame_bytes, direct.length, direct.dest, direct.valid)
-    else:
-        r_bytes = (jax.lax.all_to_all(direct.frame_bytes, axis_name, 0, 0)
-                   if gather_bytes else None)
-        r_length = jax.lax.all_to_all(direct.length, axis_name, 0, 0)
-        r_dest = jax.lax.all_to_all(direct.dest, axis_name, 0, 0)
-        r_valid = jax.lax.all_to_all(direct.valid, axis_name, 0, 0)
+def _merge_gathered(state: RouterState, g_owners, g_versions, g_ids,
+                    g_masks, my_index, liveness):
+    """The shared CRDT anti-entropy fold over already-gathered state rows
+    (one copy of the merge/liveness/eviction logic for the per-array,
+    fused-packed, and ragged steps)."""
+    was_local = state.crdt.owners == my_index
+    merged, masks, _changed = merge_all_gathered_with_payload(
+        state.crdt, state.topic_masks,
+        CrdtState(g_owners, g_versions, g_ids), g_masks)
     if liveness is not None:
-        # axis 0 is the SOURCE shard post-exchange: a dead shard's stale
-        # frames (in flight when it was declared down) never deliver
+        # release every slot owned by a dead shard (owner index is a mesh
+        # coordinate; ABSENT maps to "live" so tombstones pass through)
+        owner_live = jnp.where(merged.owners == ABSENT, True,
+                               liveness[jnp.clip(merged.owners, 0)])
+        merged = CrdtState(
+            owners=jnp.where(owner_live, merged.owners, ABSENT),
+            versions=jnp.where(owner_live, merged.versions,
+                               merged.versions + 1),
+            identities=merged.identities,
+        )
+        live_b = owner_live.reshape(
+            owner_live.shape + (1,) * (masks.ndim - owner_live.ndim))
+        masks = jnp.where(live_b, masks, 0)
+    now_local = merged.owners == my_index
+    evictions = was_local & ~now_local
+    return merged, masks, now_local, evictions
+
+
+def _lane_deliver(masks, now_local, g_bytes, g_kind, g_length, g_tmask,
+                  g_dest, g_valid, liveness) -> LaneDelivery:
+    """One broadcast lane's delivery matrix from gathered frame columns."""
+    B, S = g_kind.shape
+    if liveness is not None:
+        g_valid = g_valid & liveness[:, None]  # dead shards' frames
+    valid_f = g_valid.reshape(B * S)
+    kind_f = jnp.where(valid_f, g_kind.reshape(B * S), 0)
+    # topic masks may be multi-word ([.., W]) for >32-topic spaces
+    tmask_f = g_tmask.reshape((B * S,) + g_tmask.shape[2:])
+    deliver = delivery_matrix(
+        masks, now_local, tmask_f, kind_f,
+        g_dest.reshape(B * S), use_pallas=USE_PALLAS_DELIVERY)
+    return LaneDelivery(
+        gathered_bytes=(None if g_bytes is None
+                        else g_bytes.reshape(B * S, -1)),
+        gathered_length=g_length.reshape(B * S),
+        deliver=deliver)
+
+
+def _direct_deliver(r_bytes, r_length, r_dest, r_valid, now_local,
+                    liveness) -> LaneDelivery:
+    """Build the local delivery mask from RECEIVED direct buckets (axis 0
+    = source shard post-exchange). Delivery is iff the addressed slot is
+    locally owned — ownership moves race exactly like the reference's
+    forward-to-old-owner during CRDT convergence, and resolve the same
+    way (deliver-iff-owner, never re-forward)."""
+    if liveness is not None:
+        # a dead shard's stale frames (in flight when it was declared
+        # down) never deliver
         r_valid = r_valid & liveness[:, None]
     B, C = r_dest.shape
     dest_f = r_dest.reshape(B * C)
@@ -138,8 +238,33 @@ def _direct_route(direct: DirectIngress, now_local: jax.Array,
     deliver = (valid_f[None, :]
                & (dest_f[None, :] == slots[:, None])
                & now_local[:, None])
-    return (None if r_bytes is None else r_bytes.reshape(B * C, -1),
-            r_length.reshape(B * C), deliver)
+    return LaneDelivery(
+        gathered_bytes=(None if r_bytes is None
+                        else r_bytes.reshape(B * C, -1)),
+        gathered_length=r_length.reshape(B * C),
+        deliver=deliver)
+
+
+def _direct_route(direct: DirectIngress, now_local: jax.Array,
+                  axis_name: Optional[str],
+                  liveness: Optional[jax.Array] = None,
+                  gather_bytes: bool = True):
+    """Exchange per-destination buckets and build the local delivery mask.
+
+    ``all_to_all`` swaps the destination-shard axis for a source-shard
+    axis: received[j] = what shard j staged for *this* shard."""
+    if axis_name is None:
+        r_bytes, r_length, r_dest, r_valid = (
+            direct.frame_bytes, direct.length, direct.dest, direct.valid)
+    else:
+        r_bytes = (_all_to_all_counted(direct.frame_bytes, axis_name)
+                   if gather_bytes else None)
+        r_length = _all_to_all_counted(direct.length, axis_name)
+        r_dest = _all_to_all_counted(direct.dest, axis_name)
+        r_valid = _all_to_all_counted(direct.valid, axis_name)
+    lane = _direct_deliver(r_bytes, r_length, r_dest, r_valid, now_local,
+                           liveness)
+    return lane.gathered_bytes, lane.gathered_length, lane.deliver
 
 
 def routing_step(state: RouterState, batch: IngressBatch,
@@ -204,6 +329,7 @@ def routing_step_lanes(state: RouterState,
                        directs: tuple = (),
                        liveness: Optional[jax.Array] = None,
                        gather_bytes: bool = True,
+                       fused: bool = False,
                        ) -> MultiRouteResult:
     """One routing step over any number of size-bucketed lanes.
 
@@ -234,62 +360,35 @@ def routing_step_lanes(state: RouterState,
     identical release from the identical gathered state, so the CRDT
     stays convergent, exactly like the reference aging a dead broker's
     users out of the DirectMap.
+
+    ``fused=True`` re-expresses the whole inter-broker hop as ONE
+    sharding-aware collective (see :func:`_routing_step_lanes_fused`).
     """
+    if fused and axis_name is not None:
+        return _routing_step_lanes_fused(state, batches, my_index,
+                                         axis_name, directs, liveness,
+                                         gather_bytes)
+
     def gather(x):
         if axis_name is None:
             return x[None]
-        return jax.lax.all_gather(x, axis_name)
+        return _all_gather_counted(x, axis_name)
 
     # ---- CRDT anti-entropy: once, shared by every lane -------------------
-    g_owners = gather(state.crdt.owners)
-    g_versions = gather(state.crdt.versions)
-    g_ids = gather(state.crdt.identities)
-    g_masks = gather(state.topic_masks)
-    was_local = state.crdt.owners == my_index
-    merged, masks, _changed = merge_all_gathered_with_payload(
-        state.crdt, state.topic_masks,
-        CrdtState(g_owners, g_versions, g_ids), g_masks)
-    if liveness is not None:
-        # release every slot owned by a dead shard (owner index is a mesh
-        # coordinate; ABSENT maps to "live" so tombstones pass through)
-        owner_live = jnp.where(merged.owners == ABSENT, True,
-                               liveness[jnp.clip(merged.owners, 0)])
-        merged = CrdtState(
-            owners=jnp.where(owner_live, merged.owners, ABSENT),
-            versions=jnp.where(owner_live, merged.versions,
-                               merged.versions + 1),
-            identities=merged.identities,
-        )
-        live_b = owner_live.reshape(
-            owner_live.shape + (1,) * (masks.ndim - owner_live.ndim))
-        masks = jnp.where(live_b, masks, 0)
-    now_local = merged.owners == my_index
-    evictions = was_local & ~now_local
+    merged, masks, now_local, evictions = _merge_gathered(
+        state, gather(state.crdt.owners), gather(state.crdt.versions),
+        gather(state.crdt.identities), gather(state.topic_masks),
+        my_index, liveness)
 
     # ---- per-lane inter-broker hop + delivery matrix ---------------------
     lanes = []
     for batch in batches:
-        g_bytes = gather(batch.frame_bytes) if gather_bytes else None
-        g_kind = gather(batch.kind)
-        g_length = gather(batch.length)
-        g_tmask = gather(batch.topic_mask)
-        g_dest = gather(batch.dest)
-        g_valid = gather(batch.valid)
-        B, S = g_kind.shape
-        if liveness is not None:
-            g_valid = g_valid & liveness[:, None]  # dead shards' frames
-        valid_f = g_valid.reshape(B * S)
-        kind_f = jnp.where(valid_f, g_kind.reshape(B * S), 0)
-        # topic masks may be multi-word ([.., W]) for >32-topic spaces
-        tmask_f = g_tmask.reshape((B * S,) + g_tmask.shape[2:])
-        deliver = delivery_matrix(
-            masks, now_local, tmask_f, kind_f,
-            g_dest.reshape(B * S), use_pallas=USE_PALLAS_DELIVERY)
-        lanes.append(LaneDelivery(
-            gathered_bytes=(None if g_bytes is None
-                            else g_bytes.reshape(B * S, -1)),
-            gathered_length=g_length.reshape(B * S),
-            deliver=deliver))
+        lanes.append(_lane_deliver(
+            masks, now_local,
+            gather(batch.frame_bytes) if gather_bytes else None,
+            gather(batch.kind), gather(batch.length),
+            gather(batch.topic_mask), gather(batch.dest),
+            gather(batch.valid), liveness))
 
     direct_lanes = []
     for direct in directs:
@@ -302,6 +401,200 @@ def routing_step_lanes(state: RouterState,
 
     return MultiRouteResult(
         lanes=tuple(lanes), direct_lanes=tuple(direct_lanes),
+        state=RouterState(crdt=merged, topic_masks=masks),
+        evictions=evictions)
+
+
+# ---------------------------------------------------------------------------
+# the fused one-collective tick
+# ---------------------------------------------------------------------------
+#
+# The per-array step above issues 4 state gathers + 5-6 gathers per lane +
+# 3-4 all_to_alls per direct lane — a dozen-plus collectives per tick,
+# each paying its own dispatch latency. Following the array-redistribution
+# decomposition of "Memory-efficient array redistribution through portable
+# collective communication" (PAPERS.md), the whole tick's exchange is ONE
+# redistribution over a packed ragged buffer: every gathered leaf is
+# bitcast to u32 words and concatenated (the per-shard segment layout is a
+# trace-time constant), one all_gather moves it, and the leaves are sliced
+# back out of the [B, L] result. The per-lane all_to_all of the direct
+# path folds into the same collective: an all_to_all is an all_gather
+# composed with a local slice (each shard keeps column ``my_index`` of the
+# gathered destination axis), so directs ride the one buffer too — at a
+# B-fold redundancy on direct payload bytes, which the single-host planes
+# (gather_bytes=False, metadata only) never pay; multi-host deployments
+# that gather payload can flip ``fused=False`` to get the leaner
+# two-schedule form back.
+
+
+class _WordPacker:
+    """Trace-time leaf packer: add() bitcasts each array to u32 words,
+    pack() concatenates, unpack() slices a gathered [B, L] buffer back
+    into [B, ...]-shaped leaves in add() order."""
+
+    def __init__(self):
+        self._parts = []
+        self._specs = []  # (kind, shape, pad)
+
+    def add(self, x: jax.Array) -> None:
+        shape = x.shape
+        if x.dtype == jnp.bool_:
+            words = x.astype(jnp.uint32).reshape(-1)
+            self._specs.append(("bool", shape, 0))
+        elif x.dtype == jnp.uint8:
+            flat = x.reshape(-1)
+            pad = (-flat.shape[0]) % 4
+            if pad:
+                flat = jnp.concatenate([flat, jnp.zeros(pad, jnp.uint8)])
+            words = jax.lax.bitcast_convert_type(
+                flat.reshape(-1, 4), jnp.uint32)
+            self._specs.append(("u8", shape, pad))
+        elif x.dtype == jnp.uint32:
+            words = x.reshape(-1)
+            self._specs.append(("u32", shape, 0))
+        elif x.dtype == jnp.int32:
+            words = jax.lax.bitcast_convert_type(x, jnp.uint32).reshape(-1)
+            self._specs.append(("i32", shape, 0))
+        else:  # pragma: no cover - router leaves are the four above
+            raise TypeError(f"unpackable dtype {x.dtype}")
+        self._parts.append(words)
+
+    def pack(self) -> jax.Array:
+        return jnp.concatenate(self._parts)
+
+    def unpack(self, gathered: jax.Array) -> list:
+        B = gathered.shape[0]
+        outs = []
+        off = 0
+        for (kind, shape, pad), part in zip(self._specs, self._parts):
+            n = part.shape[0]
+            words = gathered[:, off:off + n]
+            off += n
+            if kind == "bool":
+                out = (words != 0).reshape((B,) + shape)
+            elif kind == "u8":
+                u8 = jax.lax.bitcast_convert_type(
+                    words, jnp.uint8).reshape(B, -1)
+                if pad:
+                    u8 = u8[:, :-pad]
+                out = u8.reshape((B,) + shape)
+            elif kind == "u32":
+                out = words.reshape((B,) + shape)
+            else:
+                out = jax.lax.bitcast_convert_type(
+                    words, jnp.int32).reshape((B,) + shape)
+            outs.append(out)
+        return outs
+
+
+def _routing_step_lanes_fused(state: RouterState, batches: tuple,
+                              my_index: jax.Array, axis_name: str,
+                              directs: tuple,
+                              liveness: Optional[jax.Array],
+                              gather_bytes: bool) -> MultiRouteResult:
+    """One-collective tick: pack → all_gather → unpack → the same merge
+    and delivery math as the per-array step (bit-identical outputs)."""
+    pk = _WordPacker()
+    pk.add(state.crdt.owners)
+    pk.add(state.crdt.versions)
+    pk.add(state.crdt.identities)
+    pk.add(state.topic_masks)
+    for batch in batches:
+        if gather_bytes:
+            pk.add(batch.frame_bytes)
+        pk.add(batch.kind)
+        pk.add(batch.length)
+        pk.add(batch.topic_mask)
+        pk.add(batch.dest)
+        pk.add(batch.valid)
+    for direct in directs:
+        if gather_bytes:
+            pk.add(direct.frame_bytes)
+        pk.add(direct.length)
+        pk.add(direct.dest)
+        pk.add(direct.valid)
+
+    # the tick's ONE collective
+    gathered = _all_gather_counted(pk.pack(), axis_name)
+    fields = iter(pk.unpack(gathered))
+
+    merged, masks, now_local, evictions = _merge_gathered(
+        state, next(fields), next(fields), next(fields), next(fields),
+        my_index, liveness)
+
+    lanes = []
+    for _batch in batches:
+        g_bytes = next(fields) if gather_bytes else None
+        lanes.append(_lane_deliver(
+            masks, now_local, g_bytes, next(fields), next(fields),
+            next(fields), next(fields), next(fields), liveness))
+
+    def sel(x):
+        # the all_to_all re-expressed post-gather: keep column `my_index`
+        # of the gathered destination axis (received[src] = what src
+        # staged for THIS shard)
+        if x is None:
+            return None
+        return jax.lax.dynamic_index_in_dim(x, my_index, axis=1,
+                                            keepdims=False)
+
+    direct_lanes = []
+    for _direct in directs:
+        g_bytes = next(fields) if gather_bytes else None
+        g_length = next(fields)
+        g_dest = next(fields)
+        g_valid = next(fields)
+        direct_lanes.append(_direct_deliver(
+            sel(g_bytes), sel(g_length), sel(g_dest), sel(g_valid),
+            now_local, liveness))
+
+    return MultiRouteResult(
+        lanes=tuple(lanes), direct_lanes=tuple(direct_lanes),
+        state=RouterState(crdt=merged, topic_masks=masks),
+        evictions=evictions)
+
+
+# ---------------------------------------------------------------------------
+# the ragged delivery step (single-shard planes + bench)
+# ---------------------------------------------------------------------------
+
+
+class RaggedRouteResult(NamedTuple):
+    """Compact per-candidate delivery output: row ``w`` of ``out_user``
+    is a receiver run for frame ``walk_frame[w]`` (-1 lanes empty)."""
+
+    out_user: jax.Array  # int32[Wp, PAGE]
+    counts: jax.Array    # int32[Wp]
+    state: RouterState
+    evictions: jax.Array
+
+
+def routing_step_ragged(state: RouterState, batch: IngressBatch,
+                        pages: jax.Array, walk_page: jax.Array,
+                        walk_frame: jax.Array, my_index: jax.Array,
+                        use_pallas: Optional[bool] = None,
+                        interpret: Optional[bool] = None
+                        ) -> RaggedRouteResult:
+    """One single-shard routing step through the ragged paged kernel
+    (ops.ragged_delivery): the same CRDT fold as the dense step, then a
+    page walk instead of the U x N sweep. The walk inputs come from
+    ``RaggedInterest.pack`` on the host. Single-shard by design — the
+    mesh planes keep the dense kernel (their fan-out is dominated by the
+    gathered frame set); the ragged walk is where the single-broker
+    fan-out cost lives."""
+    merged, masks, now_local, evictions = _merge_gathered(
+        state, state.crdt.owners[None], state.crdt.versions[None],
+        state.crdt.identities[None], state.topic_masks[None],
+        my_index, None)
+    kind_f = jnp.where(batch.valid, batch.kind, 0)
+    if use_pallas is None:
+        use_pallas = RAGGED_USE_PALLAS
+    out_user, counts = ragged_delivery(
+        pages, walk_page, walk_frame, now_local, masks,
+        batch.topic_mask, kind_f, batch.dest,
+        use_pallas=use_pallas, interpret=interpret)
+    return RaggedRouteResult(
+        out_user=out_user, counts=counts,
         state=RouterState(crdt=merged, topic_masks=masks),
         evictions=evictions)
 
@@ -334,14 +627,31 @@ def routing_step_lanes_single(state: RouterState, batches: tuple,
                               directs=directs, gather_bytes=gather_bytes)
 
 
-def make_mesh_lane_step(mesh: Mesh, gather_bytes: bool = True):
+@functools.partial(jax.jit, static_argnames=("use_pallas", "interpret"))
+def routing_step_ragged_single(state: RouterState, batch: IngressBatch,
+                               pages: jax.Array, walk_page: jax.Array,
+                               walk_frame: jax.Array,
+                               use_pallas: Optional[bool] = None,
+                               interpret: Optional[bool] = None
+                               ) -> RaggedRouteResult:
+    """Jitted single-chip ragged step (walk shapes key the jit cache —
+    ``RaggedInterest.pack`` pads them to WALK_ROUND granules)."""
+    return routing_step_ragged(state, batch, pages, walk_page, walk_frame,
+                               jnp.int32(0), use_pallas=use_pallas,
+                               interpret=interpret)
+
+
+def make_mesh_lane_step(mesh: Mesh, gather_bytes: bool = True,
+                        fused: bool = False):
     """Build the multi-chip lane step: every leaf of (state, batches,
     directs) is stacked on a leading broker axis and sharded over the mesh;
-    one jitted shard_map program routes all lanes (per-lane all_gather /
-    all_to_all over ICI, one shared CRDT merge). ``liveness`` is stacked
-    [B, B] (every shard carries the full membership mask).
-    ``gather_bytes=False`` builds the single-host variant whose lanes skip
-    the frame-byte collectives (see :func:`routing_step_lanes`)."""
+    one jitted shard_map program routes all lanes (one shared CRDT merge).
+    ``liveness`` is stacked [B, B] (every shard carries the full
+    membership mask). ``gather_bytes=False`` builds the single-host
+    variant whose lanes skip the frame-byte collectives (see
+    :func:`routing_step_lanes`). ``fused=True`` builds the
+    one-collective-per-tick variant: the whole exchange rides a single
+    packed all_gather (see :func:`_routing_step_lanes_fused`)."""
 
     def per_shard(state: RouterState, batches: tuple, directs: tuple,
                   liveness: jax.Array):
@@ -352,7 +662,8 @@ def make_mesh_lane_step(mesh: Mesh, gather_bytes: bool = True):
         result = routing_step_lanes(state, batches, my,
                                     axis_name=BROKER_AXIS, directs=directs,
                                     liveness=liveness[0],
-                                    gather_bytes=gather_bytes)
+                                    gather_bytes=gather_bytes,
+                                    fused=fused)
         return jax.tree.map(lambda x: x[None], result)
 
     sharded = _shard_map_compat(

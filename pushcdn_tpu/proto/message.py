@@ -512,13 +512,36 @@ _native_decode = None
 _native_decode_tried = False
 
 
-def decode_frames(buf: bytes, offs, lens, start: int = 0) -> list:
+# Zero-copy decode threshold: payloads at or above this come back as
+# memoryviews over the chunk buffer; smaller ones are owned copies. Two
+# reasons, both measured: (a) below a few hundred bytes the memcpy is
+# cheaper than constructing the slice view, so tiny payloads gain nothing
+# from views; (b) a retained view pins its WHOLE read chunk (up to
+# Connection._READ_CHUNK) after the pool permit returns — copying small
+# payloads caps that invisible amplification at chunk_size/threshold per
+# retained message instead of chunk_size/payload (an app retaining 10K
+# tiny messages would otherwise pin gigabytes the pool can't see).
+ZERO_COPY_MIN = 256
+
+
+def decode_frames(buf: bytes, offs, lens, start: int = 0,
+                  zero_copy: bool = False) -> list:
     """Decode a parse batch's frames straight off the shared chunk buffer
     (transport ``FrameChunk``) — the fan-out drain's hot loop. Inline
     little-endian field reads replace per-frame memoryview + Struct calls;
     payload/recipient slices of the ``bytes`` buffer are the single owned
     copy. Cold kinds and malformed frames take the general path (which
     raises the usual ``Error(DESERIALIZE)``).
+
+    ``zero_copy=True`` skips even that one payload copy for payloads of
+    at least ``ZERO_COPY_MIN`` bytes: Broadcast/Direct ``message`` fields
+    come back as memoryviews over ``buf`` (the views' reference chain
+    keeps the buffer alive after the chunk's pool permit is released —
+    one retained message can pin at most one read chunk, and the
+    threshold caps the pin-per-retained-byte amplification; see
+    ``ZERO_COPY_MIN``). Smaller payloads are owned copies either way
+    (cheaper than the view object). Direct ``recipient`` stays an owned
+    copy: it is small and consumed as a dict key.
 
     The loop itself runs in C when the native library is available
     (native/pydecode.cpp — same construction, same fallback semantics,
@@ -529,13 +552,16 @@ def decode_frames(buf: bytes, offs, lens, start: int = 0) -> list:
         from pushcdn_tpu import native as _native_mod
         _native_decode = _native_mod.pydecode()
         _native_decode_tried = True
+    zc_min = ZERO_COPY_MIN if zero_copy else 0
     if _native_decode is not None:
         res = _native_decode(buf, offs, lens, start,
-                             Broadcast, Direct, deserialize_owned)
+                             Broadcast, Direct, deserialize_owned,
+                             zc_min)
         if res is not None:
             return res
     out = []
     append = out.append
+    mv = memoryview(buf) if zero_copy else None
     for i in range(start, len(offs)):
         o = offs[i]
         n = lens[i]
@@ -545,14 +571,18 @@ def decode_frames(buf: bytes, offs, lens, start: int = 0) -> list:
                 nt = buf[o + 1] | (buf[o + 2] << 8)
                 p = o + 3 + nt
                 if p <= o + n:
-                    append(Broadcast(tuple(buf[o + 3:p]), buf[p:o + n]))
+                    body = mv[p:o + n] if zero_copy \
+                        and o + n - p >= zc_min else buf[p:o + n]
+                    append(Broadcast(tuple(buf[o + 3:p]), body))
                     continue
             elif kind == KIND_DIRECT and n >= 5:
                 rlen = (buf[o + 1] | (buf[o + 2] << 8)
                         | (buf[o + 3] << 16) | (buf[o + 4] << 24))
                 p = o + 5 + rlen
                 if p <= o + n:
-                    append(Direct(buf[o + 5:p], buf[p:o + n]))
+                    body = mv[p:o + n] if zero_copy \
+                        and o + n - p >= zc_min else buf[p:o + n]
+                    append(Direct(bytes(buf[o + 5:p]), body))
                     continue
         append(deserialize_owned(bytes(buf[o:o + n])))
     return out

@@ -113,12 +113,23 @@ class FrameChunk:
         return [mv[self.offs[i]:self.offs[i] + self.lens[i]]
                 for i in range(self._pos, len(self.offs))]
 
-    def decode_remaining(self) -> list:
+    def decode_remaining(self, zero_copy: bool = True) -> list:
         """Decode every remaining frame into Message objects (the batch
         decoder runs straight over the shared buffer) and release the
-        chunk. The fan-out consumer's one-call drain."""
+        chunk. The fan-out consumer's one-call drain.
+
+        By default Broadcast/Direct payloads of at least
+        ``message.ZERO_COPY_MIN`` bytes are ZERO-COPY memoryviews of the
+        chunk buffer (``message.decode_frames`` zero_copy docs): the
+        views keep the buffer alive after the release below, so the last
+        per-message copy on the client receive path is gone for the
+        payload sizes where it costs anything; smaller payloads stay
+        owned copies (bounds how much chunk memory retained messages can
+        pin after the pool permit returns). Pass ``zero_copy=False`` for
+        owned bytes payloads throughout."""
         try:
-            return decode_frames(self.buf, self.offs, self.lens, self._pos)
+            return decode_frames(self.buf, self.offs, self.lens, self._pos,
+                                 zero_copy=zero_copy)
         finally:
             self.release()
 

@@ -26,7 +26,8 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
                        deliver_spans: bool = False,
                        parked_users: int = 0,
                        churn: bool = False,
-                       incremental: Optional[bool] = None
+                       incremental: Optional[bool] = None,
+                       client_decode: bool = False
                        ) -> Optional[dict]:
     """Measure broker forwarding msgs/s with the routing plane forced to
     ``impl`` (``auto``/``native``/``python``). Returns ``None`` when
@@ -53,7 +54,14 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
     invalidates the snapshot mid-traffic; the result carries
     ``churn_ops_s``); ``incremental`` forces the native maintenance mode
     (True = in-place deltas, False = the rebuild-guard baseline,
-    None = leave as configured)."""
+    None = leave as configured).
+
+    ``client_decode=True`` drains receivers through the REAL client batch
+    decode (``client.decode_received`` — exactly what
+    ``Client.receive_messages`` runs, zero-copy payload views included)
+    instead of counting raw frames at the transport: the delivered/s
+    figure then includes full message decode, the honest application-
+    visible rate (ISSUE 8 client-receive-residue row)."""
     from pushcdn_tpu.broker.tasks import cutthrough
     from pushcdn_tpu.broker.test_harness import TestDefinition
     from pushcdn_tpu.native import routeplan
@@ -120,7 +128,20 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
                     trace_lib.emit("delivery", tr)
                     e2e_lat_s.append(max(time.time_ns() - tr[1], 0) / 1e9)
 
+            async def drain_decoded(conn, n):
+                # the client-API drain: recv_frames + the exact decode
+                # Client.receive_messages runs (zero-copy views) — every
+                # counted message is a decoded Message object
+                from pushcdn_tpu.client.client import decode_received
+                got = 0
+                async with asyncio.timeout(120):
+                    while got < n:
+                        got += len(decode_received(
+                            await conn.recv_frames(n - got)))
+
             async def drain(conn, n):
+                if client_decode:
+                    return await drain_decoded(conn, n)
                 got = 0
                 async with asyncio.timeout(120):
                     while got < n:

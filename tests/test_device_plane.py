@@ -208,3 +208,97 @@ async def test_device_plane_fail_open_to_host_path():
         b.close()
     finally:
         await cluster.stop()
+
+
+async def test_ragged_delivery_impl_end_to_end():
+    """delivery_impl="ragged": the plane routes through the paged walk
+    (compact pairs feed egress directly) and delivers byte-identically —
+    broadcasts, a multi-topic union (deduped to one copy), and directs."""
+    from pushcdn_tpu.broker.device_plane import DevicePlaneConfig
+
+    cluster = await Cluster(num_brokers=1, device_plane=DevicePlaneConfig(
+        num_user_slots=32, ring_slots=64, frame_bytes=1024,
+        batch_window_s=0.002, bypass_max_items=0,
+        delivery_impl="ragged")).start()
+    try:
+        device = cluster.brokers[0].device_plane
+        assert device.delivery_impl == "ragged"
+        stable = cluster.client(seed=520, topics=[0, 1])
+        await stable.ensure_initialized()
+        received = []
+
+        async def drain():
+            while True:
+                got = await stable.receive_message()
+                received.append(bytes(got.message))
+
+        drain_task = asyncio.create_task(drain())
+        sender = cluster.client(seed=521, topics=[])
+        await sender.ensure_initialized()
+        for i in range(4):
+            await sender.send_broadcast_message([0], b"m%d" % i)
+        await sender.send_broadcast_message([1], b"t1")
+        await sender.send_broadcast_message([0, 1], b"union")  # dedup
+        await sender.send_direct_message(stable.public_key, b"direct")
+        await wait_until(lambda: len(received) >= 7, timeout=10)
+        await asyncio.sleep(0.05)  # a dup would land right behind
+        drain_task.cancel()
+        assert sorted(received) == sorted(
+            [b"m0", b"m1", b"m2", b"m3", b"t1", b"union", b"direct"])
+        assert device.ragged_steps >= 1
+        assert not device.disabled
+        stable.close()
+        sender.close()
+    finally:
+        await cluster.stop()
+
+
+async def test_ragged_page_pool_exhaustion_falls_back_then_recovers():
+    """A too-small page pool: the plane flips to the dense step (never a
+    dropped delivery), keeps serving, and once membership shrinks the
+    rebuild-retry path restores the paged walk."""
+    from pushcdn_tpu.broker.device_plane import DevicePlaneConfig
+
+    cluster = await Cluster(num_brokers=1, device_plane=DevicePlaneConfig(
+        num_user_slots=32, ring_slots=64, frame_bytes=1024,
+        batch_window_s=0.002, bypass_max_items=0,
+        delivery_impl="ragged", ragged_max_pages=2)).start()
+    try:
+        device = cluster.brokers[0].device_plane
+        # two subscribers on different topics exhaust the 1-usable-page
+        # pool (page 0 reserved): the second add overflows
+        a = cluster.client(seed=530, topics=[0])
+        await a.ensure_initialized()
+        b = cluster.client(seed=531, topics=[1])
+        await b.ensure_initialized()
+        await wait_until(lambda: device.delivery_impl == "dense",
+                         timeout=5)
+        received = []
+
+        async def drain():
+            while True:
+                got = await a.receive_message()
+                received.append(bytes(got.message))
+
+        drain_task = asyncio.create_task(drain())
+        sender = cluster.client(seed=532, topics=[])
+        await sender.ensure_initialized()
+        await sender.send_broadcast_message([0], b"after-fallback")
+        await wait_until(lambda: received == [b"after-fallback"],
+                         timeout=10)
+        assert not device.disabled
+        # membership shrinks below the retry mark: the removal's own
+        # observer call rebuilds the index and resumes the paged walk
+        b.close()
+        await wait_until(lambda: device.delivery_impl == "ragged",
+                         timeout=10)
+        await sender.send_broadcast_message([0], b"after-recovery")
+        await wait_until(
+            lambda: received == [b"after-fallback", b"after-recovery"],
+            timeout=10)
+        drain_task.cancel()
+        assert not device.disabled
+        for c in (a, sender):
+            c.close()
+    finally:
+        await cluster.stop()

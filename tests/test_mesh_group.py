@@ -415,3 +415,24 @@ async def test_mesh_chaos_shard_death_under_load():
             c.close()
     finally:
         await cluster.stop()
+
+
+async def test_mesh_tick_is_one_collective():
+    """ISSUE 8: the group's default (fused) tick traces exactly ONE
+    collective — the counted one-collective-per-tick invariant, observed
+    at the running group (router.trace_collectives delta captured around
+    the compiled step)."""
+    cluster = await MeshCluster(num_shards=4).start(form_host_mesh=False)
+    try:
+        assert cluster.group.config.fused_collective
+        a = await cluster.place_client(seed=900, shard=0, topics=[0])
+        b = await cluster.place_client(seed=901, shard=2, topics=[0])
+        await a.send_broadcast_message([0], b"tick")
+        got = await asyncio.wait_for(b.receive_message(), 10)
+        assert bytes(got.message) == b"tick"
+        assert cluster.group.collectives_last_trace == 1, \
+            cluster.group.collectives_last_trace
+        a.close()
+        b.close()
+    finally:
+        await cluster.stop()

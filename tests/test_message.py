@@ -223,3 +223,78 @@ def test_decode_frames_native_vs_python_paths(monkeypatch):
             decode_frames(bad, [0], [len(bad)])
         assert message_mod._native_decode is not None  # native path ran
         assert py_err.value.kind == nat_err.value.kind
+
+
+def test_decode_frames_zero_copy_views(monkeypatch):
+    """ISSUE 8 client-receive residue: zero-copy decode yields memoryview
+    payloads over the shared buffer (both C and Python paths), the views
+    keep the buffer alive past the chunk's release, and recipients stay
+    owned bytes (dict keys)."""
+    import gc
+
+    from pushcdn_tpu.proto import message as message_mod
+    from pushcdn_tpu.proto.message import decode_frames
+
+    frames = [serialize(Broadcast([0, 1], b"payload-A")),
+              serialize(Direct(b"rcpt", b"payload-B" * 100)),
+              serialize(Subscribe([3]))]  # cold kind: owned decode
+    buf = bytearray()
+    offs, lens = [], []
+    for f in frames:
+        offs.append(len(buf))
+        lens.append(len(f))
+        buf += f
+    buf = bytes(buf)
+
+    for pin_python in (False, True):
+        if pin_python:
+            monkeypatch.setattr(message_mod, "_native_decode", None)
+            monkeypatch.setattr(message_mod, "_native_decode_tried", True)
+        else:
+            monkeypatch.setattr(message_mod, "_native_decode_tried", False)
+        out = decode_frames(buf, offs, lens, 0, zero_copy=True)
+        b, d, s = out
+        # sub-threshold payloads stay owned copies (ZERO_COPY_MIN: the
+        # copy is cheaper than the view AND a retained view would pin
+        # the whole chunk); at/above threshold = zero-copy views
+        assert type(b.message) is bytes
+        assert isinstance(d.message, memoryview)
+        assert bytes(b.message) == b"payload-A"
+        assert bytes(d.message) == b"payload-B" * 100
+        assert type(d.recipient) is bytes and d.recipient == b"rcpt"
+        assert s == Subscribe((3,))
+        # equality against the owned-decode twin holds across the modes
+        owned = decode_frames(buf, offs, lens, 0, zero_copy=False)
+        assert out[0] == owned[0] and out[1] == owned[1]
+        assert type(owned[1].message) is bytes
+
+    # the views' reference chain keeps the buffer alive
+    ref = decode_frames(buf, offs, lens, 0, zero_copy=True)
+    del buf
+    gc.collect()
+    assert bytes(ref[1].message) == b"payload-B" * 100
+
+
+def test_frame_chunk_decode_remaining_zero_copy():
+    """FrameChunk.decode_remaining releases the chunk's pool permit while
+    the returned views stay readable (buffer pinned by the views)."""
+    from pushcdn_tpu.proto.limiter import MemoryPool
+    from pushcdn_tpu.proto.transport.base import FrameChunk
+
+    frames = [serialize(Broadcast([0], b"zc-%d" % i + b"x" * 300))
+              for i in range(4)]
+    buf = bytearray()
+    offs, lens = [], []
+    for f in frames:
+        offs.append(len(buf))
+        lens.append(len(f))
+        buf += f
+    buf = bytes(buf)
+    pool = MemoryPool(1 << 16)
+    permit = pool.try_allocate(len(buf))
+    chunk = FrameChunk(buf, offs, lens, permit)
+    msgs = chunk.decode_remaining()
+    assert pool.available == pool.capacity  # permit returned at decode
+    assert [bytes(m.message) for m in msgs] == \
+        [b"zc-%d" % i + b"x" * 300 for i in range(4)]
+    assert all(isinstance(m.message, memoryview) for m in msgs)

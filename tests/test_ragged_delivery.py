@@ -1,0 +1,376 @@
+"""Ragged paged delivery (ISSUE 8): page-packing property tests and the
+seeded device-vs-host equivalence suite.
+
+The equivalence chain asserted here: for seeded broadcast/direct/control/
+garbage mixes (uniform AND zipf-skewed topic popularity, empty-fan-out
+edges included) the ragged kernel's delivery decisions — jnp twin AND
+Pallas kernel in interpreter mode, CPU backend — are identical to the
+dense ``delivery_matrix_reference`` and to a scalar host cut-through twin
+(the interest-set + direct-ownership routing rule the broker's host path
+implements).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from pushcdn_tpu.ops.delivery_kernel import delivery_matrix_reference  # noqa: E402
+from pushcdn_tpu.ops.ragged_delivery import (  # noqa: E402
+    PAGE,
+    RaggedInterest,
+    ragged_delivery_pallas,
+    ragged_delivery_reference,
+    ragged_pairs,
+    ragged_pairs_grouped,
+    ragged_to_dense,
+)
+from pushcdn_tpu.proto.message import KIND_BROADCAST, KIND_DIRECT  # noqa: E402
+
+
+def host_cutthrough_reference(user_masks, local, frame_tmask, kind, dest,
+                              valid):
+    """The host cut-through's routing rule as scalar Python: per frame,
+    broadcast delivery = interest-set membership (mask AND), direct
+    delivery = addressed slot iff locally owned. The executable spec the
+    device kernels must match (the broker's dict-based path implements
+    exactly this per message)."""
+    U = len(user_masks)
+    N = len(kind)
+    deliver = np.zeros((U, N), bool)
+    multiword = np.ndim(user_masks) == 2
+    for n in range(N):
+        if not valid[n]:
+            continue
+        if kind[n] == KIND_BROADCAST:
+            for u in range(U):
+                if not local[u]:
+                    continue
+                if multiword:
+                    hit = bool((user_masks[u] & frame_tmask[n]).any())
+                else:
+                    hit = bool(user_masks[u] & frame_tmask[n])
+                if hit:
+                    deliver[u, n] = True
+        elif kind[n] == KIND_DIRECT:
+            d = int(dest[n])
+            if 0 <= d < U and local[d]:
+                deliver[d, n] = True
+    return deliver
+
+
+def _mix(seed: int, U: int, N: int, T: int, popularity: str,
+         topic_words: int = 1):
+    """One seeded broadcast/direct/control/garbage mix + matching
+    interest, in both host (numpy) and index (RaggedInterest) form."""
+    from pushcdn_tpu.parallel.frames import mask_mirror_shape, split_mask
+
+    rng = np.random.default_rng(seed)
+    if popularity == "zipf":
+        p = 1.0 / np.arange(1, T + 1)
+        p /= p.sum()
+    else:
+        p = np.full(T, 1.0 / T)
+    # interest: most users subscribe to a few topics; some users idle
+    # (empty masks), some unowned (local=False)
+    masks_int = []
+    W = topic_words
+    masks = np.zeros(mask_mirror_shape(U, W), np.uint32)
+    for u in range(U):
+        k = int(rng.integers(0, 4))  # 0 topics = empty-fan-out edge
+        m = 0
+        for t in rng.choice(T, size=k, p=p):
+            m |= 1 << int(t)
+        masks_int.append(m)
+        masks[u] = m if W == 1 else split_mask(m, W)
+    local = rng.random(U) < 0.8
+    ri = RaggedInterest(T, max_pages=1024)
+    for u in range(U):
+        ri.set_mask(u, masks_int[u])
+    assert not ri.overflowed
+
+    # frames: broadcasts (single + multi topic), directs (incl. repeated
+    # and garbage dests), control kinds, garbage kinds, invalid slots
+    # with poisoned metadata
+    kind = rng.choice([0, KIND_BROADCAST, KIND_BROADCAST, KIND_DIRECT, 6,
+                       9, 77], N).astype(np.int32)
+    tmask_ints = np.zeros(N, object)
+    for n in range(N):
+        if kind[n] == KIND_BROADCAST:
+            m = 1 << int(rng.choice(T, p=p))
+            if rng.random() < 0.3:  # multi-topic (union path)
+                m |= 1 << int(rng.choice(T, p=p))
+            if rng.random() < 0.1:
+                m = 0  # no-topic broadcast: empty fan-out
+            tmask_ints[n] = m
+        else:
+            tmask_ints[n] = 0
+    tmask = np.zeros(mask_mirror_shape(N, W), np.uint32)
+    for n in range(N):
+        tmask[n] = tmask_ints[n] if W == 1 else split_mask(
+            int(tmask_ints[n]), W)
+    dest = np.where(kind == KIND_DIRECT,
+                    rng.integers(-3, U + 5, N), -1).astype(np.int32)
+    valid = rng.random(N) < 0.85
+    # poison invalid slots' metadata: must never deliver
+    inv = np.nonzero(~valid)[0]
+    if len(inv):
+        row = np.uint32(0xFFFFFFFF)
+        tmask[inv[0]] = row
+        kind[inv[0]] = KIND_BROADCAST
+    kz = np.where(valid, kind, 0).astype(np.int32)
+    return ri, masks, local, tmask, kind, kz, dest, valid
+
+
+@pytest.mark.parametrize("popularity", ["uniform", "zipf"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_equivalence_ragged_vs_dense_vs_host(seed, popularity):
+    """jnp twin == Pallas(interpret) == dense reference == scalar host
+    cut-through on seeded mixed traffic."""
+    U, N, T = 150, 64, 24
+    ri, masks, local, tmask, kind, kz, dest, valid = _mix(
+        seed, U, N, T, popularity)
+    walk = ri.pack(kz, tmask, dest, valid)
+    assert not walk.spilled
+
+    host = host_cutthrough_reference(masks, local, tmask, kind, dest,
+                                     valid)
+    dense = np.asarray(delivery_matrix_reference(
+        jnp.asarray(masks), jnp.asarray(local), jnp.asarray(tmask),
+        jnp.asarray(kz), jnp.asarray(dest)))
+    np.testing.assert_array_equal(dense, host)
+
+    args = (jnp.asarray(walk.pages), jnp.asarray(walk.walk_page),
+            jnp.asarray(walk.walk_frame), jnp.asarray(local),
+            jnp.asarray(masks), jnp.asarray(tmask), jnp.asarray(kz),
+            jnp.asarray(dest))
+    out_ref, cnt_ref = ragged_delivery_reference(*args)
+    got = ragged_to_dense(np.asarray(out_ref), walk.walk_frame, U, N)
+    np.testing.assert_array_equal(got, host)
+
+    out_pal, cnt_pal = ragged_delivery_pallas(*args, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out_pal), np.asarray(out_ref))
+    np.testing.assert_array_equal(np.asarray(cnt_pal), np.asarray(cnt_ref))
+
+    # both pair extractors produce the host pair set, dup-free, grouped
+    pu, pf = ragged_pairs(np.asarray(out_ref), walk.walk_frame,
+                          num_users=U)
+    gu, gf = ragged_pairs_grouped(np.asarray(out_ref), walk, num_users=U)
+    want = set(zip(*np.nonzero(host)))
+    assert set(zip(pu.tolist(), pf.tolist())) == want
+    assert set(zip(gu.tolist(), gf.tolist())) == want
+    assert len(gu) == len(want)  # dup-free
+    for users in (pu, gu):  # per-user contiguity (egress run shape)
+        if len(users):
+            changes = int((np.diff(users) != 0).sum())
+            assert changes + 1 == len(np.unique(users))
+    ri.release_transient()
+
+
+def test_equivalence_multiword_masks():
+    """The full 256-topic space (8xu32 masks) through the same chain."""
+    U, N, T = 80, 48, 256
+    ri, masks, local, tmask, kind, kz, dest, valid = _mix(
+        7, U, N, T, "zipf", topic_words=8)
+    walk = ri.pack(kz, tmask, dest, valid)
+    host = host_cutthrough_reference(masks, local, tmask, kind, dest,
+                                     valid)
+    args = (jnp.asarray(walk.pages), jnp.asarray(walk.walk_page),
+            jnp.asarray(walk.walk_frame), jnp.asarray(local),
+            jnp.asarray(masks), jnp.asarray(tmask), jnp.asarray(kz),
+            jnp.asarray(dest))
+    out_ref, _ = ragged_delivery_reference(*args)
+    np.testing.assert_array_equal(
+        ragged_to_dense(np.asarray(out_ref), walk.walk_frame, U, N), host)
+    out_pal, _ = ragged_delivery_pallas(*args, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out_pal), np.asarray(out_ref))
+    ri.release_transient()
+
+
+def test_ragged_routing_step_matches_dense_step():
+    """routing_step_ragged_single delivers the dense jitted step's exact
+    decisions (the bench.py --delivery-impl ragged contract)."""
+    from pushcdn_tpu.parallel.crdt import CrdtState
+    from pushcdn_tpu.parallel.frames import FrameRing
+    from pushcdn_tpu.parallel.router import (
+        IngressBatch,
+        RouterState,
+        routing_step_ragged_single,
+        routing_step_single,
+    )
+
+    U, S = 32, 16
+    rng = np.random.default_rng(5)
+    masks = rng.integers(0, 2**8, U).astype(np.uint32)
+    owners = np.where(rng.random(U) < 0.7, 0, 3).astype(np.int32)
+    state = RouterState(
+        CrdtState(jnp.asarray(owners), jnp.asarray(np.ones(U, np.uint32)),
+                  jnp.asarray(owners)), jnp.asarray(masks))
+    ri = RaggedInterest(8, max_pages=64)
+    for u in range(U):
+        ri.set_mask(u, int(masks[u]) & 0xFF)
+    ring = FrameRing(slots=S, frame_bytes=64)
+    ring.push_broadcast(b"t0", 0b1)
+    ring.push_broadcast(b"t27", 0b1000)
+    ring.push_direct(b"d", 4)
+    ring.push_direct(b"d2", 4)  # repeated dest: the shared-page dup edge
+    b = ring.take_batch()
+    kz = np.where(b.valid, b.kind, 0).astype(np.int32)
+    walk = ri.pack(kz, b.topic_mask, b.dest, b.valid)
+    batch = IngressBatch(
+        jnp.asarray(b.bytes_), jnp.asarray(b.kind), jnp.asarray(b.length),
+        jnp.asarray(b.topic_mask), jnp.asarray(b.dest),
+        jnp.asarray(b.valid))
+    res = routing_step_ragged_single(
+        state, batch, jnp.asarray(walk.pages), jnp.asarray(walk.walk_page),
+        jnp.asarray(walk.walk_frame))
+    dense = routing_step_single(state, batch)
+    np.testing.assert_array_equal(
+        ragged_to_dense(np.asarray(res.out_user), walk.walk_frame, U, S),
+        np.asarray(dense.deliver))
+    ri.release_transient()
+
+
+# ---------------------------------------------------------------------------
+# page-packing property tests
+# ---------------------------------------------------------------------------
+
+
+def test_incremental_index_matches_bruteforce_under_churn():
+    """Seeded subscribe/unsubscribe churn: after every mutation the
+    per-topic pages hold exactly the brute-force membership."""
+    rng = np.random.default_rng(42)
+    T, U = 16, 64
+    ri = RaggedInterest(T, max_pages=256)
+    truth = {u: 0 for u in range(U)}
+    for _ in range(600):
+        u = int(rng.integers(0, U))
+        m = int(rng.integers(0, 1 << T))
+        ri.set_mask(u, m)
+        truth[u] = m
+        if rng.random() < 0.05:  # occasional full check
+            for t in range(T):
+                want = sorted(u for u, mm in truth.items()
+                              if mm & (1 << t))
+                got = sorted(ri.topic_receivers(t).tolist())
+                assert got == want, (t, got, want)
+    for t in range(T):
+        want = sorted(u for u, mm in truth.items() if mm & (1 << t))
+        assert sorted(ri.topic_receivers(t).tolist()) == want
+
+
+def test_pool_wraparound_reuses_pages_without_leaks():
+    """Repeated pack/release cycles with transient unions + directs: the
+    free-page count returns to baseline every tick and recycled pages
+    never leak a previous tick's candidates."""
+    T = 8
+    ri = RaggedInterest(T, max_pages=32)
+    for u in range(40):
+        ri.set_mask(u, 0b01 if u % 2 else 0b10)
+    free0 = ri.free_pages
+    kind = np.asarray([KIND_BROADCAST, KIND_DIRECT, KIND_DIRECT],
+                      np.int32)
+    tmask = np.asarray([0b11, 0, 0], np.uint32)  # union of both topics
+    dest = np.asarray([-1, 3, 5], np.int32)
+    valid = np.ones(3, bool)
+    for tick in range(50):
+        walk = ri.pack(kind, tmask, dest, valid)
+        assert not walk.spilled
+        # union page content is exactly the dedup'd membership
+        out, _ = ragged_delivery_reference(
+            jnp.asarray(walk.pages), jnp.asarray(walk.walk_page),
+            jnp.asarray(walk.walk_frame),
+            jnp.asarray(np.ones(40, bool)),
+            jnp.asarray(np.asarray(
+                [0b01 if u % 2 else 0b10 for u in range(40)], np.uint32)),
+            jnp.asarray(tmask), jnp.asarray(kind), jnp.asarray(dest))
+        d = ragged_to_dense(np.asarray(out), walk.walk_frame, 40, 3)
+        assert d[:, 0].sum() == 40      # union reaches everyone, once
+        assert d[3, 1] and d[5, 2]
+        assert d.sum() == 42
+        ri.release_transient()
+        assert ri.free_pages == free0, f"page leak at tick {tick}"
+
+
+def test_transient_overflow_spills_frames_not_corruption():
+    """A pool too small for the tick's unions: the un-carryable frames
+    come back in ``spilled`` (the caller's dense/host fallback), nothing
+    else is disturbed, and after release the pool recovers."""
+    T = 8
+    ri = RaggedInterest(T, max_pages=4)  # page 0 + three usable
+    for u in range(6):
+        ri.set_mask(u, 0b01)  # one topic page
+    assert ri.free_pages == 2
+    kind = np.full(4, KIND_BROADCAST, np.int32)
+    tmask = np.asarray([0b01, 0b11, 0b11, 0b01], np.uint32)
+    valid = np.ones(4, bool)
+    dest = np.full(4, -1, np.int32)
+    # frame 1's union takes the last free pages? only one union is
+    # memoized; add a direct to exhaust the remaining page
+    kind[3] = KIND_DIRECT
+    dest[3] = 2
+    tmask[3] = 0
+    walk = ri.pack(kind, tmask, dest, valid)
+    # single-topic frames never spill (live pages); the union (1 page)
+    # and the direct page both fit the 2 free pages -> no spill yet
+    assert not walk.spilled
+    ri.release_transient()
+    # now ask for THREE distinct unions: only 2 free pages -> spill
+    tmask2 = np.asarray([0b011, 0b101, 0b110], np.uint32)
+    kind2 = np.full(3, KIND_BROADCAST, np.int32)
+    ri.set_mask(6, 0b100)  # third topic page? pool full ->
+    walk2 = ri.pack(kind2, tmask2, np.full(3, -1, np.int32),
+                    np.ones(3, bool))
+    assert walk2.spilled, "transient exhaustion must spill"
+    spilled = set(walk2.spilled)
+    # non-spilled frames still walked correctly
+    kept = [n for n in range(3) if n not in spilled]
+    assert all(walk2.walk_frame[:walk2.n_walk] != s for s in spilled)
+    assert len(kept) >= 1
+    ri.release_transient()
+
+
+def test_persistent_overflow_flags_and_rebuild_recovers():
+    """Subscription growth past the pool: ``overflowed`` latches (the
+    device plane's dense-fallback trigger); after churn shrinks the
+    membership, ``rebuild()`` restores a usable index."""
+    ri = RaggedInterest(4, max_pages=3)  # page 0 + two usable
+    for u in range(2 * PAGE):  # fills two pages of topic 0
+        ri.set_mask(u, 0b1)
+    assert not ri.overflowed
+    ri.set_mask(999, 0b10)  # needs a third page
+    assert ri.overflowed
+    # shrink and rebuild
+    for u in range(PAGE, 2 * PAGE):
+        ri.set_mask(u, 0)
+    assert ri.rebuild()
+    assert not ri.overflowed
+    assert sorted(ri.topic_receivers(0).tolist()) == list(range(PAGE))
+    assert ri.topic_receivers(1).tolist() == [999]
+
+
+def test_empty_frames_pack_no_walk_entries():
+    """Frames with zero fan-out (no subscribers, mask 0, invalid slots,
+    control kinds, garbage dests) contribute nothing to the walk."""
+    ri = RaggedInterest(8, max_pages=16)
+    ri.set_mask(0, 0b1)
+    kind = np.asarray([KIND_BROADCAST, KIND_BROADCAST, 6, KIND_DIRECT,
+                       KIND_BROADCAST], np.int32)
+    tmask = np.asarray([0b10, 0, 0b1, 0, 0b1], np.uint32)  # t1: nobody
+    dest = np.asarray([-1, -1, -1, -2, -1], np.int32)      # garbage dest
+    valid = np.asarray([True, True, True, True, False])    # last invalid
+    walk = ri.pack(kind, tmask, dest, valid)
+    # only the t1-broadcast frame walks (its topic page list is empty ->
+    # actually zero entries too); nothing else is eligible
+    assert walk.n_walk == 0
+    assert not walk.spilled
+    # and the walk still evaluates cleanly (padded null-page entries)
+    out, cnt = ragged_delivery_reference(
+        jnp.asarray(walk.pages), jnp.asarray(walk.walk_page),
+        jnp.asarray(walk.walk_frame), jnp.asarray(np.ones(4, bool)),
+        jnp.asarray(np.asarray([0b1, 0, 0, 0], np.uint32)),
+        jnp.asarray(tmask), jnp.asarray(np.where(valid, kind, 0)),
+        jnp.asarray(dest))
+    assert int(np.asarray(cnt).sum()) == 0
+    ri.release_transient()

@@ -458,3 +458,131 @@ def test_multiword_topic_masks():
     res = routing_step_lanes_single(state, (_batch_from_ring(ring),))
     d = np.asarray(res.lanes[0].deliver)
     assert d[0, 0] and not d[0, 1]  # subscribed to 200, not to 7
+
+
+def _seeded_mesh_inputs(n=8, seed=0, with_direct=True):
+    """Stacked state + traffic for an n-shard mesh (helper for the fused
+    one-collective tests)."""
+    from pushcdn_tpu.parallel.frames import DirectBuckets
+    from pushcdn_tpu.parallel.router import DirectIngress
+
+    rng = np.random.default_rng(seed)
+    owners = np.full((n, U), ABSENT, np.int32)
+    versions = np.zeros((n, U), np.uint32)
+    ids = np.full((n, U), ABSENT, np.int32)
+    masks = np.zeros((n, U), np.uint32)
+    for i in range(n):
+        owners[i, i] = i
+        versions[i, i] = 1
+        ids[i, i] = i
+        masks[i, i] = rng.integers(1, 8)
+    state = RouterState(
+        CrdtState(jnp.asarray(owners), jnp.asarray(versions),
+                  jnp.asarray(ids)), jnp.asarray(masks))
+    parts = []
+    for i in range(n):
+        ring = FrameRing(slots=S, frame_bytes=F)
+        for j in range(int(rng.integers(1, 4))):
+            ring.push_broadcast(b"b%d-%d" % (i, j),
+                                int(rng.integers(1, 8)))
+        parts.append(ring.take_batch())
+    batch = IngressBatch(
+        *[jnp.asarray(np.stack([getattr(p, f) for p in parts]))
+          for f in ("bytes_", "kind", "length", "topic_mask", "dest",
+                    "valid")])
+    direct = None
+    if with_direct:
+        dparts = []
+        for i in range(n):
+            d = DirectBuckets(n, capacity=4, frame_bytes=F)
+            d.push((i + 1) % n, b"d%d" % i, dest_slot=(i + 1) % n)
+            d.push((i + 3) % n, b"e%d" % i, dest_slot=(i + 3) % n)
+            dparts.append(d.take_batch())
+        direct = DirectIngress(
+            *[jnp.asarray(np.stack([getattr(p, f) for p in dparts]))
+              for f in ("bytes_", "length", "dest", "valid")])
+    return state, batch, direct
+
+
+def test_fused_tick_matches_per_array_and_counts_one_collective():
+    """ISSUE 8 tentpole: the fused mesh tick (one packed all_gather) is
+    bit-identical to the per-array collective schedule, and the lowered
+    program contains EXACTLY one collective op (vs a dozen-plus for the
+    per-array form) — the counted one-collective-per-tick invariant."""
+    import jax
+
+    from pushcdn_tpu.parallel import router as router_mod
+    from pushcdn_tpu.parallel.router import count_collectives
+
+    n = 8
+    mesh = make_broker_mesh(n)
+    state, batch, direct = _seeded_mesh_inputs(n, seed=3)
+    live = jnp.ones((n, n), bool)
+
+    step_f = make_mesh_lane_step(mesh, fused=True)
+    step_u = make_mesh_lane_step(mesh, fused=False)
+    out_f = step_f(state, (batch,), (direct,), live)
+    out_u = step_u(state, (batch,), (direct,), live)
+    for get in (lambda o: o.lanes[0].deliver,
+                lambda o: o.lanes[0].gathered_bytes,
+                lambda o: o.lanes[0].gathered_length,
+                lambda o: o.direct_lanes[0].deliver,
+                lambda o: o.direct_lanes[0].gathered_bytes,
+                lambda o: o.state.crdt.owners,
+                lambda o: o.state.topic_masks,
+                lambda o: o.evictions):
+        np.testing.assert_array_equal(np.asarray(get(out_f)),
+                                      np.asarray(get(out_u)))
+
+    # lowered-program collective count: fused == 1, per-array >> 1
+    low_f = jax.jit(step_f).lower(state, (batch,), (direct,),
+                                  live).as_text()
+    low_u = jax.jit(step_u).lower(state, (batch,), (direct,),
+                                  live).as_text()
+    assert count_collectives(low_f) == 1, low_f.count("all_gather")
+    assert count_collectives(low_u) > 1
+
+    # trace-time counter agrees: tracing a fresh fused program adds
+    # exactly one collective call site
+    before = router_mod.trace_collectives()
+    state2, batch2, direct2 = _seeded_mesh_inputs(n, seed=4)
+    step_f2 = make_mesh_lane_step(mesh, fused=True, gather_bytes=False)
+    step_f2(state2, (batch2,), (direct2,), live)
+    assert router_mod.trace_collectives() - before == 1
+
+
+def test_fused_tick_liveness_and_eviction_equivalence():
+    """Dead-shard masking and ownership-eviction semantics survive the
+    fused packing unchanged."""
+    n = 8
+    mesh = make_broker_mesh(n)
+    state, batch, direct = _seeded_mesh_inputs(n, seed=9)
+    # shard 2 and 5 dead; shard 1 re-claims user 0 at a higher version
+    owners = np.asarray(state.crdt.owners).copy()
+    versions = np.asarray(state.crdt.versions).copy()
+    ids = np.asarray(state.crdt.identities).copy()
+    owners[1, 0], versions[1, 0], ids[1, 0] = 1, 5, 1
+    state = RouterState(
+        CrdtState(jnp.asarray(owners), jnp.asarray(versions),
+                  jnp.asarray(ids)), state.topic_masks)
+    live = np.ones((n, n), bool)
+    live[:, 2] = False
+    live[:, 5] = False
+    live = jnp.asarray(live)
+    out_f = make_mesh_lane_step(mesh, fused=True)(
+        state, (batch,), (direct,), live)
+    out_u = make_mesh_lane_step(mesh, fused=False)(
+        state, (batch,), (direct,), live)
+    for get in (lambda o: o.lanes[0].deliver,
+                lambda o: o.direct_lanes[0].deliver,
+                lambda o: o.state.crdt.owners,
+                lambda o: o.state.crdt.versions,
+                lambda o: o.state.topic_masks,
+                lambda o: o.evictions):
+        np.testing.assert_array_equal(np.asarray(get(out_f)),
+                                      np.asarray(get(out_u)))
+    # the dead shards' slots tombstoned, eviction reported at shard 0
+    merged = np.asarray(out_f.state.crdt.owners)
+    assert (merged[:, 2] == ABSENT).all()
+    assert (merged[:, 5] == ABSENT).all()
+    assert np.asarray(out_f.evictions)[0, 0]
