@@ -400,6 +400,8 @@ def main() -> None:
         row["host_egress_msgs_s"] = round(egress_rate, 1)
     if route_rate is not None:
         row["host_route_msgs_s"] = round(route_rate, 1)
+    from pushcdn_tpu.testing.provenance import provenance
+    row["provenance"] = provenance()
     print(json.dumps(row))
 
 

@@ -1,0 +1,309 @@
+#!/usr/bin/env python
+"""Consensus SLO bench (ISSUE 11): view-driven workload through real
+brokers, clean vs churn vs chaos, with per-view SLOs gated by
+``scripts/trace_report.py --strict``.
+
+Per scenario: N consensus nodes run V leader-broadcast → vote-direct →
+quorum views over an in-process cluster (geo-shaped zipf links), every
+message traced (1-in-1) and view-tagged; the span log is aggregated by
+``trace_report`` and the scenario's SLO row lands in BENCH_r13.json:
+
+    python benches/consensus_bench.py [--quick] [--out-json BENCH_r13.json]
+
+Scenarios:
+
+- **clean** — no interference; the baseline SLO row.
+- **churn** — connect/disconnect storm riding alongside the views (a
+  fresh subscriber joins and leaves per view-ish tick).
+- **shed_mid_view** (chaos) — a subscribe-spammer trips admission
+  shedding (PUSHCDN_SUBSCRIBE_RATE) mid-view; the composition invariant
+  is that shed mutations never stall view completion.
+- **broker_churn** (chaos) — a second, non-serving broker is stopped
+  mid-view and restarted two views later: mesh churn + discovery updates
+  while quorum forms. Survivor-lossless by construction, so the strict
+  zero-orphan trace gate applies.
+- **marshal_restart** (chaos) — the marshal dies mid-view and comes back:
+  no new admissions for a beat, but live consensus links keep serving.
+
+All scenarios assert every view completes (no timeouts) and the chaos
+span logs pass ``trace_report --strict`` (zero orphans, zero stalled
+views). Provenance (cpus/git/python/jax) is stamped by write_bench_json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+TRACE_REPORT = os.path.join(REPO, "scripts", "trace_report.py")
+
+RESULTS = []
+
+
+def emit(row: dict) -> None:
+    RESULTS.append(row)
+    print(json.dumps(row), flush=True)
+
+
+def _pct_ms(x):
+    return None if x is None else round(x * 1e3, 3)
+
+
+async def _run_scenario(name: str, *, num_brokers: int = 1,
+                        chaos_factory=None, sidecar_factory=None,
+                        env: dict = None, quick: bool = False,
+                        span_dir: str = None,
+                        require_sidecar_sheds: bool = False) -> dict:
+    """One scenario: cluster up → (sidecar) → consensus run → strict
+    trace gate on the scenario's own span log."""
+    from pushcdn_tpu.proto import trace as trace_mod
+    from pushcdn_tpu.proto.topic import TopicSpace
+    from pushcdn_tpu.testing.cluster import Cluster
+    from pushcdn_tpu.testing.consensus import ConsensusConfig, run_consensus
+
+    num_nodes = 4 if quick else 6
+    num_views = 4 if quick else 12
+    cfg = ConsensusConfig(
+        num_nodes=num_nodes, num_views=num_views, view_timeout_s=30.0,
+        base_latency_s=0.001, tail_latency_s=0.008, jitter_s=0.001,
+        loss=0.05, rto_s=0.01, seed=13)
+
+    log_path = os.path.join(span_dir, f"{name}.jsonl")
+    prev_env = {}
+    for k, v in (env or {}).items():
+        prev_env[k] = os.environ.get(k)
+        os.environ[k] = v
+    prev_log = trace_mod.set_log_path(log_path)
+    # the default TEST_TOPIC_SPACE is {0,1}; the sidecars churn/spam on
+    # higher topics, and an invalid handshake topic is a rejection
+    # (listeners.py topic prune) — so the bench runs a wide space
+    cluster = await Cluster(num_brokers=num_brokers,
+                            topics=TopicSpace.range(256)).start()
+    sidecar_task = None
+    stop_sidecar = asyncio.Event()
+    try:
+        if num_brokers > 1:
+            # pin consensus nodes onto broker 0 so chaos on broker 1 is
+            # survivor-lossless (the strict zero-orphan gate is honest:
+            # no traced frame was ever routed through the victim)
+            await cluster.place_on(0)
+        chaos = chaos_factory(cluster, cfg) if chaos_factory else None
+        if sidecar_factory is not None:
+            sidecar_task = asyncio.ensure_future(
+                sidecar_factory(cluster, stop_sidecar))
+        run = await run_consensus(cluster, cfg, chaos=chaos)
+    finally:
+        stop_sidecar.set()
+        sidecar_result = None
+        if sidecar_task is not None:
+            try:
+                sidecar_result = await asyncio.wait_for(sidecar_task, 10.0)
+            except Exception:
+                sidecar_task.cancel()
+        await cluster.stop()
+        trace_mod.set_log_path(prev_log)
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    completion = run.completion_percentiles()
+    delivery = run.delivery_percentiles()
+
+    # the SLO gate: per-view aggregation + zero orphans / stalled views
+    gate = subprocess.run(
+        [sys.executable, TRACE_REPORT, "--strict", "--json", log_path],
+        capture_output=True, text=True, timeout=120)
+    strict_ok = gate.returncode == 0
+    try:
+        report = json.loads(gate.stdout)
+    except ValueError:
+        report = {}
+
+    row = {
+        "bench": f"consensus/{name}",
+        "nodes": cfg.num_nodes,
+        "views": cfg.num_views,
+        "completed": run.completed,
+        "timeouts": run.timeouts,
+        "sheds": run.sheds,
+        "votes_sent": run.votes_sent,
+        "view_completion_p50_ms": _pct_ms(completion["p50"]),
+        "view_completion_p95_ms": _pct_ms(completion["p95"]),
+        "view_completion_p99_ms": _pct_ms(completion["p99"]),
+        "publish_delivery_p50_ms": _pct_ms(delivery["p50"]),
+        "publish_delivery_p99_ms": _pct_ms(delivery["p99"]),
+        "trace_strict_ok": strict_ok,
+        "trace_complete_chains": report.get("complete_chains"),
+        "trace_orphaned_spans": report.get("orphaned_spans"),
+        "span_log": os.path.basename(log_path),
+    }
+    if sidecar_result is not None:
+        row["sidecar_sheds"] = sidecar_result
+    vr = report.get("views") or {}
+    if vr:
+        row["trace_view_completion_p99_ms"] = \
+            vr.get("completion_ms", {}).get("p99")
+        row["trace_stalled_views"] = vr.get("stalled_views")
+    if not strict_ok:
+        row["trace_strict_stderr"] = gate.stderr.strip()[-500:]
+    emit(row)
+
+    assert run.timeouts == 0, \
+        f"{name}: {run.timeouts} views timed out (stall)"
+    assert run.completed == cfg.num_views, \
+        f"{name}: only {run.completed}/{cfg.num_views} views completed"
+    assert strict_ok, \
+        f"{name}: trace_report --strict failed:\n{gate.stderr}"
+    if require_sidecar_sheds:
+        assert sidecar_result, \
+            f"{name}: the admission layer never shed (sidecar saw 0) — " \
+            "the scenario proved nothing"
+    return row
+
+
+# -- scenario wiring ----------------------------------------------------
+
+
+async def _churn_sidecar(cluster, stop: asyncio.Event):
+    """Connect/disconnect storm on a topic the consensus run doesn't
+    use: placement, handshakes, and route-state churn ride alongside
+    quorum formation."""
+    seed = 70_000
+    while not stop.is_set():
+        c = cluster.client(seed=seed, topics=[5])
+        seed += 1
+        try:
+            await asyncio.wait_for(c.ensure_initialized(), 10.0)
+        except Exception:
+            pass
+        c.close()
+        try:
+            await asyncio.wait_for(stop.wait(), 0.05)
+        except asyncio.TimeoutError:
+            continue
+
+
+async def _shed_sidecar(cluster, stop: asyncio.Event):
+    """Hammer one connection with subscribe mutations until admission
+    sheds them (typed Error(SHED) notices, never silent drops)."""
+    from pushcdn_tpu.proto.error import Error, ErrorKind
+    c = cluster.client(seed=71_000, topics=[6])
+    sheds = 0
+    try:
+        await asyncio.wait_for(c.ensure_initialized(), 10.0)
+        t = 10
+        while not stop.is_set():
+            try:
+                for _ in range(4):   # burst past the token bucket
+                    t += 1
+                    await c.subscribe([t % 200 + 10])
+                while True:          # drain queued shed notices
+                    await asyncio.wait_for(c.receive_messages(), 0.005)
+            except asyncio.TimeoutError:
+                pass
+            except Error as exc:
+                if exc.kind == ErrorKind.SHED:
+                    sheds += 1
+            except Exception:
+                pass
+            await asyncio.sleep(0)
+    finally:
+        c.close()
+    return sheds
+
+
+def _broker_churn_chaos(cluster, cfg):
+    """Stop the non-serving broker mid-view k, restart it at k+2."""
+    kill_at = cfg.num_views // 3
+    revive_at = min(kill_at + 2, cfg.num_views - 1)
+
+    async def hook(view: int):
+        if view == kill_at:
+            await cluster.brokers[1].stop()
+        elif view == revive_at:
+            await cluster.restart_broker(1)
+    return {kill_at: hook, revive_at: hook}
+
+
+def _marshal_restart_chaos(cluster, cfg):
+    kill_at = cfg.num_views // 2
+
+    async def hook(view: int):
+        await cluster.marshal.stop()
+        await asyncio.sleep(0.05)      # a real outage window
+        await cluster.restart_marshal()
+    return {kill_at: hook}
+
+
+async def amain(quick: bool, out_json: str, scenarios) -> None:
+    span_dir = tempfile.mkdtemp(prefix="consensus-spans-")
+    all_scenarios = {
+        "clean": dict(),
+        "churn": dict(sidecar_factory=_churn_sidecar),
+        "shed_mid_view": dict(
+            sidecar_factory=_shed_sidecar,
+            require_sidecar_sheds=True,
+            env={"PUSHCDN_SUBSCRIBE_RATE": "1",
+                 "PUSHCDN_SUBSCRIBE_BURST": "2"}),
+        "broker_churn": dict(num_brokers=2,
+                             chaos_factory=_broker_churn_chaos),
+        "marshal_restart": dict(chaos_factory=_marshal_restart_chaos),
+    }
+    run_list = scenarios or list(all_scenarios)
+    rows = {}
+    for name in run_list:
+        rows[name] = await _run_scenario(
+            name, quick=quick, span_dir=span_dir, **all_scenarios[name])
+
+    headline = {}
+    for key in ("clean", "churn"):
+        if key in rows:
+            headline[f"{key}_view_p99_ms"] = \
+                rows[key]["view_completion_p99_ms"]
+            headline[f"{key}_delivery_p99_ms"] = \
+                rows[key]["publish_delivery_p99_ms"]
+    chaos_rows = [r for n, r in rows.items()
+                  if n not in ("clean", "churn")]
+    if chaos_rows:
+        headline["chaos_scenarios"] = len(chaos_rows)
+        headline["chaos_view_p99_ms_worst"] = max(
+            (r["view_completion_p99_ms"] or 0) for r in chaos_rows)
+        headline["chaos_strict_ok"] = all(r["trace_strict_ok"]
+                                          for r in chaos_rows)
+    headline["span_dir"] = span_dir
+    print(json.dumps({"headline": headline}), flush=True)
+
+    if out_json:
+        from route_bench import write_bench_json
+        write_bench_json(out_json, "consensus_slo", headline, RESULTS)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="small node/view counts (the CI smoke tier)")
+    ap.add_argument("--out-json", default=None,
+                    help="merge the consensus_slo section into this "
+                         "BENCH_r*.json")
+    ap.add_argument("--scenarios", default=None,
+                    help="comma-separated subset (default: all)")
+    args = ap.parse_args()
+    scenarios = args.scenarios.split(",") if args.scenarios else None
+    asyncio.run(amain(args.quick, args.out_json, scenarios))
+
+
+if __name__ == "__main__":
+    main()

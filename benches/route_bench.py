@@ -1236,8 +1236,10 @@ def write_bench_json(path: str, section: str, headline: dict,
                 doc = json.load(fh)
         except (OSError, ValueError):
             doc = {}
-    doc.setdefault("round", 12)
-    doc[section] = {"headline": headline, "rows": rows}
+    doc.setdefault("round", 13)
+    from pushcdn_tpu.testing.provenance import provenance
+    doc[section] = {"headline": headline, "rows": rows,
+                    "provenance": provenance()}
     with open(path, "w") as fh:
         json.dump(doc, fh, indent=1)
         fh.write("\n")

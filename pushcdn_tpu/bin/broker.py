@@ -44,6 +44,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--global-permits", action="store_true")
     p.add_argument("--scheme", default="ed25519",
                    help="signature scheme: ed25519 | bls-bn254")
+    p.add_argument("--heartbeat-interval", type=float, default=10.0,
+                   help="discovery heartbeat cadence in seconds; chaos "
+                        "drills shrink it so a killed broker ages out of "
+                        "placement quickly")
+    p.add_argument("--membership-ttl", type=float, default=60.0,
+                   help="discovery membership TTL in seconds (parity "
+                        "heartbeat.rs 60 s)")
     # ---- sharded data plane (ISSUE 6) ---------------------------------
     p.add_argument("--shards", type=int, default=None,
                    help="shard the data plane across N worker OS "
@@ -247,6 +254,8 @@ async def amain(args: argparse.Namespace) -> None:
         metrics_bind_endpoint=args.metrics_bind_endpoint,
         ca_cert_path=args.ca_cert_path, ca_key_path=args.ca_key_path,
         global_memory_pool_size=args.global_memory_pool_size,
+        heartbeat_interval_s=args.heartbeat_interval,
+        membership_ttl_s=args.membership_ttl,
         device_plane=device_plane,
         # a mesh-group deployment's inter-broker plane is the device mesh
         form_mesh=args.mesh_shards is None,

@@ -15,6 +15,7 @@ from pushcdn_tpu.bin.common import (
     transport_by_name,
 )
 from pushcdn_tpu.client import Client, ClientConfig
+from pushcdn_tpu.proto.error import Error
 from pushcdn_tpu.proto.message import Broadcast, Direct
 
 logger = logging.getLogger("pushcdn.client-bin")
@@ -67,8 +68,16 @@ async def amain(args: argparse.Namespace) -> None:
                 args.interval, topics)
 
     async def receiver():
+        # elastic like the library (lib.rs disconnect_on_error): a broker
+        # death raises Error(CONNECTION) here, and the next receive call
+        # re-dials through the marshal — the process must ride it out, not
+        # die (scripts/local_cluster.py --chaos SIGKILLs a broker under us)
         while True:
-            message = await client.receive_message()
+            try:
+                message = await client.receive_message()
+            except Error as exc:
+                logger.info("receive failed (%s); reconnecting", exc.kind)
+                continue
             if isinstance(message, Direct):
                 logger.info("recv direct: %r", bytes(message.message)[:64])
             elif isinstance(message, Broadcast):
@@ -83,10 +92,14 @@ async def amain(args: argparse.Namespace) -> None:
     n = 0
     try:
         while True:
-            await client.send_direct_message(direct_target,
-                                             f"echo {n}".encode())
-            await client.send_broadcast_message(topics, f"hello {n}".encode())
-            n += 1
+            try:
+                await client.send_direct_message(direct_target,
+                                                 f"echo {n}".encode())
+                await client.send_broadcast_message(topics,
+                                                    f"hello {n}".encode())
+                n += 1
+            except Error as exc:
+                logger.info("send failed (%s); reconnecting", exc.kind)
             await asyncio.sleep(args.interval)
     finally:
         recv_task.cancel()

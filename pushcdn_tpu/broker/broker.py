@@ -373,9 +373,16 @@ class Broker:
             spawn(whitelist_task.run_whitelist_task(self), name="whitelist"),
         ]
         if self.config.bind_private:
+            # heartbeat rides supervised(): a transient discovery outage
+            # (store locked, network blip) must not fail-fast the whole
+            # broker — readiness already degrades via note_discovery_probe,
+            # each death lands in the supervised-tasks flight recorder, and
+            # the task resumes once the store answers again
             self._tasks += [
-                spawn(heartbeat_task.run_heartbeat_task(self),
-                      name="heartbeat"),
+                spawn(metrics_mod.supervised(
+                    lambda: heartbeat_task.run_heartbeat_task(self),
+                    "heartbeat"),
+                    name="heartbeat"),
                 spawn(sync_task.run_sync_task(self), name="sync"),
                 spawn(listener_tasks.run_broker_listener_task(self),
                       name="broker-listener"),

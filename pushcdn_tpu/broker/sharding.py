@@ -532,6 +532,10 @@ class ShardRuntime:
     def stats(self) -> dict:
         return {
             "shard": self.shard_id,
+            # the worker's OS pid: chaos drivers (scripts/local_cluster.py
+            # --chaos) kill a specific shard worker through the merged
+            # /debug/topology instead of guessing at child-process order
+            "pid": os.getpid(),
             "num_shards": self.num_shards,
             "remote_users": len(self.broker.connections.remote_user_shard),
             "remote_brokers":

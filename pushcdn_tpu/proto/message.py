@@ -64,6 +64,38 @@ TRACE_BLOCK = struct.Struct("<QQ")
 _TRACE_BLOCK = TRACE_BLOCK
 _TRACED_HOT = frozenset((KIND_DIRECT | TRACE_FLAG, KIND_BROADCAST | TRACE_FLAG))
 
+# --- view tag (ISSUE 11) ---------------------------------------------------
+# Consensus-shaped workloads tag traced frames with the u32 view number so
+# trace_report can aggregate per view. Same reserved-bit scheme as the
+# trace flag itself: origin_ns is wall-clock nanoseconds, which stays below
+# 2**63 until the year 2262, so its high bit was always zero on the wire.
+# Setting it means a u32 view tag follows the 16-byte trace block. Frames
+# without a view (and all untraced frames) are byte-identical to the PR 4
+# wire — zero cost unless a view is actually carried.
+TRACE_VIEW_FLAG = 1 << 63
+TRACE_BLOCK_VIEW = struct.Struct("<QQI")
+
+
+def pack_trace(trace) -> bytes:
+    """Encode a trace context — ``(trace_id, origin_ns)`` or
+    ``(trace_id, origin_ns, view)`` — into its wire block (16 or 20 B)."""
+    if len(trace) > 2 and trace[2] is not None:
+        return TRACE_BLOCK_VIEW.pack(trace[0], trace[1] | TRACE_VIEW_FLAG,
+                                     trace[2] & 0xFFFFFFFF)
+    return TRACE_BLOCK.pack(trace[0], trace[1])
+
+
+def unpack_trace(view: BytesLike, off: int) -> Tuple[tuple, int]:
+    """Decode the trace block at ``off``; returns ``(trace, end_offset)``
+    where ``trace`` is a 2- or 3-tuple mirroring :func:`pack_trace`.
+    Raises ``struct.error`` on truncation (callers wrap it in the usual
+    ``Error(DESERIALIZE)``)."""
+    tid, origin = TRACE_BLOCK.unpack_from(view, off)
+    if origin & TRACE_VIEW_FLAG:
+        (v,) = _U32.unpack_from(view, off + TRACE_BLOCK.size)
+        return (tid, origin & ~TRACE_VIEW_FLAG, v), off + TRACE_BLOCK_VIEW.size
+    return (tid, origin), off + TRACE_BLOCK.size
+
 
 @dataclass(frozen=True, slots=True)
 class AuthenticateWithKey:
@@ -298,7 +330,7 @@ def serialize(msg: Message) -> bytes:
                 frame = b"".join((b"\x04", _U32.pack(len(recipient)),
                                   recipient, msg.message))
             else:
-                frame = b"".join((b"\x84", _TRACE_BLOCK.pack(*trace),
+                frame = b"".join((b"\x84", pack_trace(trace),
                                   _U32.pack(len(recipient)), recipient,
                                   msg.message))
         elif kind == KIND_BROADCAST:
@@ -308,7 +340,7 @@ def serialize(msg: Message) -> bytes:
                 frame = b"".join((b"\x05", _U16.pack(len(topics)),
                                   bytes(topics), msg.message))
             else:
-                frame = b"".join((b"\x85", _TRACE_BLOCK.pack(*trace),
+                frame = b"".join((b"\x85", pack_trace(trace),
                                   _U16.pack(len(topics)), bytes(topics),
                                   msg.message))
         elif kind in (KIND_SUBSCRIBE, KIND_UNSUBSCRIBE):
@@ -416,13 +448,12 @@ def deserialize(frame: BytesLike) -> Message:
                      "AuthenticateResponse context is not UTF-8", exc)
             return AuthenticateResponse(permit=permit, context=context)
         if kind in _TRACED_HOT:
-            # traced hot frame: 16-byte trace block after the kind byte,
-            # then the ordinary layout (rare by construction: 1/1024
-            # default sampling)
-            off = 1 + _TRACE_BLOCK.size
-            if n < off:
+            # traced hot frame: 16- or 20-byte trace block (view-tagged)
+            # after the kind byte, then the ordinary layout (rare by
+            # construction: 1/1024 default sampling)
+            if n < 1 + _TRACE_BLOCK.size:
                 bail(ErrorKind.DESERIALIZE, "truncated trace block")
-            trace = _TRACE_BLOCK.unpack_from(view, 1)
+            trace, off = unpack_trace(view, 1)
             if kind & ~TRACE_FLAG == KIND_DIRECT:
                 (rlen,) = _U32.unpack_from(view, off)
                 p = off + 4 + rlen

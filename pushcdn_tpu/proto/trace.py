@@ -45,7 +45,8 @@ from pushcdn_tpu.proto import metrics as metrics_mod
 # on Direct/Broadcast (decoded by proto.message) and the marshal auth
 # frame (handled here at the frame level); everything else treats a
 # flagged kind as unknown (disconnect), exactly like a pre-trace node.
-from pushcdn_tpu.proto.message import TRACE_BLOCK, TRACE_FLAG
+from pushcdn_tpu.proto.message import (TRACE_BLOCK, TRACE_FLAG,
+                                       pack_trace, unpack_trace)
 
 KIND_MASK = 0x7F
 
@@ -54,7 +55,10 @@ TRACE_BLOCK_BYTES = TRACE_BLOCK.size  # 16 (<u64 trace_id, u64 origin_ns>)
 # The lifecycle hops, in chain order.
 HOPS = ("publish", "auth", "ingress", "plan", "egress", "delivery")
 
-Trace = Tuple[int, int]  # (trace_id, origin_ns)
+# (trace_id, origin_ns) or (trace_id, origin_ns, view): consensus-shaped
+# workloads tag the u32 view number so per-view SLOs are derivable from
+# the span log (see proto.message.TRACE_VIEW_FLAG for the wire encoding).
+Trace = Tuple[int, ...]
 
 
 def _env_sample() -> int:
@@ -82,6 +86,23 @@ _LOG_PATH = os.environ.get("PUSHCDN_TRACE_LOG") or None
 _log_file = None
 
 
+def set_log_path(path: Optional[str]) -> Optional[str]:
+    """Redirect (or disable, with ``None``) the JSONL span log at runtime;
+    returns the previous path. ``PUSHCDN_TRACE_LOG`` seeds the initial
+    value at import; in-process drivers (testing.consensus) use this to
+    capture spans without re-importing."""
+    global _LOG_PATH, _log_file
+    prev = _LOG_PATH
+    if _log_file is not None:
+        try:
+            _log_file.close()
+        except Exception:
+            pass
+        _log_file = None
+    _LOG_PATH = path
+    return prev
+
+
 def _log(record: dict) -> None:
     global _log_file, _LOG_PATH
     if _log_file is None:
@@ -99,9 +120,11 @@ def _log(record: dict) -> None:
 def emit(hop: str, trace: Trace, detail: str = "") -> None:
     """Record one span: per-hop latency histogram + recent ring (+ JSONL
     when ``PUSHCDN_TRACE_LOG`` is set). ``trace`` is the carried
-    ``(trace_id, origin_ns)``; latency is wall-clock now minus origin
-    (cross-process on one machine; clock skew applies across machines)."""
-    tid, origin = trace
+    ``(trace_id, origin_ns)`` or ``(trace_id, origin_ns, view)``; latency
+    is wall-clock now minus origin (cross-process on one machine; clock
+    skew applies across machines)."""
+    tid, origin = trace[0], trace[1]
+    view = trace[2] if len(trace) > 2 else None
     now = time.time_ns()
     lat = (now - origin) / 1e9
     if lat < 0.0:
@@ -116,13 +139,18 @@ def emit(hop: str, trace: Trace, detail: str = "") -> None:
             lat, exemplar={"trace_id": f"{tid:016x}"})
     recent.append((hop, tid, origin, now, detail))
     if _LOG_PATH:
-        _log({"hop": hop, "trace_id": tid, "origin_ns": origin,
-              "t_ns": now, "lat_s": round(lat, 9), "detail": detail})
+        record = {"hop": hop, "trace_id": tid, "origin_ns": origin,
+                  "t_ns": now, "lat_s": round(lat, 9), "detail": detail}
+        if view is not None:
+            record["view"] = view
+        _log(record)
 
 
-def new_trace() -> Trace:
-    """A fresh trace context originating NOW."""
-    return (_next_id(), time.time_ns())
+def new_trace(view: Optional[int] = None) -> Trace:
+    """A fresh trace context originating NOW, optionally view-tagged."""
+    if view is None:
+        return (_next_id(), time.time_ns())
+    return (_next_id(), time.time_ns(), view)
 
 
 _id_state = (os.getpid() << 40) ^ (time.time_ns() & 0xFFFFFFFFFF)
@@ -144,25 +172,29 @@ class Sampler:
     """Deterministic 1-in-N publish sampler (one per client). ``pending``
     is the connection trace id: the first sampled decision after a
     (re)connect is forced and reuses that id, chaining the auth span to a
-    message lifecycle."""
+    message lifecycle. ``view``, when set (consensus workloads), tags every
+    sampled trace with the current view number."""
 
-    __slots__ = ("every", "_n", "pending")
+    __slots__ = ("every", "_n", "pending", "view")
 
     def __init__(self, every: int = SAMPLE_EVERY):
         self.every = every
         self._n = 0
         self.pending: Optional[int] = None
+        self.view: Optional[int] = None
 
     def next_trace(self) -> Optional[Trace]:
         if self.every <= 0:
             return None
         if self.pending is not None:
             tid, self.pending = self.pending, None
-            return (tid, time.time_ns())
+            if self.view is None:
+                return (tid, time.time_ns())
+            return (tid, time.time_ns(), self.view)
         self._n += 1
         if self._n % self.every:
             return None
-        return new_trace()
+        return new_trace(self.view)
 
 
 # -- frame-level stamp/strip (for frames whose decoded type carries no
@@ -171,9 +203,8 @@ class Sampler:
 
 def stamp_frame(frame: bytes, trace: Trace) -> bytes:
     """Set the trace flag on a serialized frame: flagged kind byte + the
-    16-byte trace block + the original remainder."""
-    return (bytes((frame[0] | TRACE_FLAG,)) + TRACE_BLOCK.pack(*trace)
-            + frame[1:])
+    16- or 20-byte (view-tagged) trace block + the original remainder."""
+    return bytes((frame[0] | TRACE_FLAG,)) + pack_trace(trace) + frame[1:]
 
 
 def strip_frame(frame) -> Tuple[bytes, Optional[Trace]]:
@@ -181,6 +212,5 @@ def strip_frame(frame) -> Tuple[bytes, Optional[Trace]]:
     with ``trace=None`` (and the input untouched) for unflagged frames."""
     if len(frame) < 1 + TRACE_BLOCK_BYTES or not frame[0] & TRACE_FLAG:
         return (frame if isinstance(frame, bytes) else bytes(frame)), None
-    trace = TRACE_BLOCK.unpack_from(frame, 1)
-    return (bytes((frame[0] & KIND_MASK,))
-            + bytes(frame[1 + TRACE_BLOCK_BYTES:]), trace)
+    trace, off = unpack_trace(frame, 1)
+    return bytes((frame[0] & KIND_MASK,)) + bytes(frame[off:]), trace

@@ -10,6 +10,8 @@ This is the seam that lets whole-system integration tests (marshal + brokers
 from __future__ import annotations
 
 import asyncio
+import itertools
+import random
 from collections import deque
 from typing import Dict, Optional, Tuple
 
@@ -228,6 +230,146 @@ class Memory(Protocol):
         listener = MemoryListener(endpoint)
         _REGISTRY.listeners[endpoint] = listener
         return listener
+
+
+# -- geo-shaped links (ISSUE 11) ---------------------------------------
+#
+# Consensus-shaped workloads need WAN-ish links between in-process nodes:
+# propagation delay, jitter, and loss. The memory transport is a reliable
+# ordered stream (like the QUIC transport above it), so "loss" is modeled
+# the way a reliable stream experiences it — a retransmit (RTO) delay
+# penalty on the affected chunk, never a dropped or reordered byte.
+# Delivery times are monotone per direction (a delayed chunk delays
+# everything behind it), so stream ordering is preserved by construction.
+
+
+class LinkShape:
+    """One direction's shaping parameters. ``latency_s`` is the one-way
+    propagation delay, ``jitter_s`` a uniform [0, jitter) addition,
+    ``loss`` the per-chunk probability of a modeled retransmit costing
+    ``rto_s`` extra. ``seed`` makes every connection's delay sequence
+    deterministic."""
+
+    __slots__ = ("latency_s", "jitter_s", "loss", "rto_s", "seed")
+
+    def __init__(self, latency_s: float = 0.0, jitter_s: float = 0.0,
+                 loss: float = 0.0, rto_s: float = 0.05, seed: int = 0):
+        self.latency_s = latency_s
+        self.jitter_s = jitter_s
+        self.loss = loss
+        self.rto_s = rto_s
+        self.seed = seed
+
+    def __repr__(self):
+        return (f"LinkShape(latency_s={self.latency_s}, "
+                f"jitter_s={self.jitter_s}, loss={self.loss}, "
+                f"rto_s={self.rto_s}, seed={self.seed})")
+
+
+class _ShapedStream(RawStream):
+    """Write-side shaping wrapper: each written chunk is released into the
+    underlying pipe at ``max(prev_release, now + delay)`` by a pump task —
+    pipelined (a burst pays the latency once, not per chunk) and ordered
+    (release times are monotone)."""
+
+    def __init__(self, inner: _PipeStream, shape: LinkShape,
+                 rng: random.Random):
+        self._inner = inner
+        self._shape = shape
+        self._rng = rng
+        self._q: "asyncio.Queue" = asyncio.Queue(maxsize=64)
+        self._pump_task = None
+        self._release_at = 0.0
+        self._closed = False
+
+    async def read_exactly(self, n: int) -> bytes:
+        return await self._inner.read_exactly(n)
+
+    async def read_some(self, max_n: int) -> bytes:
+        return await self._inner.read_some(max_n)
+
+    async def write(self, data) -> None:
+        if self._closed:
+            raise ConnectionResetError("memory stream closed")
+        loop = asyncio.get_running_loop()
+        if self._pump_task is None:
+            self._pump_task = loop.create_task(self._pump())
+        sh = self._shape
+        delay = sh.latency_s
+        if sh.jitter_s:
+            delay += self._rng.random() * sh.jitter_s
+        if sh.loss and self._rng.random() < sh.loss:
+            delay += sh.rto_s  # modeled retransmit on a reliable stream
+        release = max(self._release_at, loop.time() + delay)
+        self._release_at = release
+        # detach now: the deferred write outlives the caller's buffer
+        await self._q.put((release, bytes(data)))
+
+    async def _pump(self) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                release, data = await self._q.get()
+                dt = release - loop.time()
+                if dt > 0:
+                    await asyncio.sleep(dt)
+                await self._inner.write(data)
+                self._q.task_done()
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+
+    async def close(self) -> None:
+        # let queued (in-flight) chunks land before tearing the pipe down
+        if self._pump_task is not None and not self._closed:
+            try:
+                await asyncio.wait_for(self._q.join(), timeout=5.0)
+            except asyncio.TimeoutError:
+                pass
+        self.abort()
+
+    def abort(self) -> None:
+        if not self._closed:
+            self._closed = True
+            if self._pump_task is not None:
+                self._pump_task.cancel()
+            self._inner.abort()
+
+
+_shaped_conn_counter = itertools.count()
+
+
+def shaped_memory(shape: LinkShape) -> type:
+    """A :class:`Memory` subclass whose connections traverse ``shape`` in
+    BOTH directions. Pass it as ``ClientConfig.protocol`` so every
+    (re)connect of that client stays shaped — per-client geography, no
+    global state. Listeners bound via the plain :class:`Memory` accept
+    shaped peers transparently (the shaping rides the connecting side's
+    stream pair)."""
+
+    link_shape = shape
+
+    class ShapedMemory(Memory):
+        name = f"memory+shaped({shape.latency_s * 1e3:g}ms)"
+        _shape = link_shape
+
+        @classmethod
+        async def connect(cls, endpoint: str, use_local_authority: bool = True,
+                          limiter: Limiter = NO_LIMIT) -> Connection:
+            listener = _REGISTRY.listeners.get(endpoint)
+            if listener is None or listener._closed:
+                bail(ErrorKind.CONNECTION,
+                     f"no memory listener bound at {endpoint!r}")
+            n = next(_shaped_conn_counter)
+            ours, theirs = _duplex()
+            # independent deterministic streams per direction
+            rng_c2s = random.Random((link_shape.seed << 21) ^ (2 * n))
+            rng_s2c = random.Random((link_shape.seed << 21) ^ (2 * n + 1))
+            await listener._accept_q.put(
+                _ShapedStream(theirs, link_shape, rng_s2c))
+            return Connection(_ShapedStream(ours, link_shape, rng_c2s),
+                              limiter, label=f"memory+shaped:{endpoint}")
+
+    return ShapedMemory
 
 
 async def gen_testing_connection_pair(limiter: Limiter = NO_LIMIT

@@ -114,11 +114,14 @@ class Cluster:
         await self.marshal.start()
         return self
 
-    def client(self, seed: int, topics=()) -> Client:
+    def client(self, seed: int, topics=(), protocol: Type = Memory) -> Client:
+        """``protocol`` lets a caller shape this client's link (e.g.
+        ``shaped_memory(LinkShape(...))`` for geo-shaped consensus nodes);
+        the default is the plain in-process transport."""
         return Client(ClientConfig(
             marshal_endpoint=self.marshal_endpoint,
             keypair=self.scheme.generate_keypair(seed=seed),
-            protocol=Memory,
+            protocol=protocol,
             scheme=self.scheme,
             subscribed_topics=set(topics),
         ))
@@ -142,6 +145,18 @@ class Cluster:
         await broker.start()
         self.brokers[broker_index] = broker
         return broker
+
+    async def restart_marshal(self) -> "Marshal":
+        """Start a replacement marshal on the same endpoint (chaos tests:
+        marshal loss mid-view). The old instance must already be
+        stopped."""
+        self.marshal = await Marshal.new(MarshalConfig(
+            run_def=self.run_def,
+            discovery_endpoint=self.db,
+            bind_endpoint=self.marshal_endpoint,
+        ))
+        await self.marshal.start()
+        return self.marshal
 
     async def steer_load(self, broker_index: int, load: int):
         """Fake a broker's advertised load to steer marshal placement

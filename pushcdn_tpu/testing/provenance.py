@@ -1,0 +1,47 @@
+"""Host/build provenance for bench artifacts (ISSUE 11).
+
+BENCH_r*.json rows become a cross-round *series* (scripts/bench_series.py),
+which is only honest if each row is attributable to the host it ran on —
+a regression caused by moving from a 16-core runner to a 1-core container
+must be readable as such. Every bench section therefore stamps this dict.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import subprocess
+import sys
+
+
+def git_sha(repo_root: str = None) -> str:
+    """Current commit (short), or "unknown" outside a git checkout."""
+    root = repo_root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=root,
+            capture_output=True, text=True, timeout=10)
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return "unknown"
+
+
+def provenance() -> dict:
+    """cpus / git sha / python + jax versions / platform — cheap enough
+    to stamp into every bench section."""
+    try:
+        import jax
+        jax_version = jax.__version__
+    except Exception:
+        jax_version = None
+    return {
+        "cpus": os.cpu_count(),
+        "git_sha": git_sha(),
+        "python": platform.python_version(),
+        "jax": jax_version,
+        "platform": platform.platform(),
+        "argv0": os.path.basename(sys.argv[0]) if sys.argv else None,
+    }

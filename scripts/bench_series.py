@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Cross-round bench series: merge every ``BENCH_r*.json`` in the repo
+root into ``BENCH_SERIES.md`` and (optionally) gate on regressions.
+
+Each PR round leaves one ``BENCH_r<N>.json`` behind (written by
+``benches/route_bench.py::write_bench_json``: per-section ``headline``
+scalars + rows + provenance). This tool is the longitudinal view — the
+same headline metric tracked round over round, so a perf regression is a
+visible diff in BENCH_SERIES.md instead of an archaeology project:
+
+    python scripts/bench_series.py                  # rewrite BENCH_SERIES.md
+    python scripts/bench_series.py --gate           # exit 1 on >10% regression
+    python scripts/bench_series.py --gate --threshold 0.25
+
+The gate compares the LATEST round's metrics against the most recent
+earlier round that carries the same metric (sections come and go as PRs
+focus on different subsystems; a missing metric is not a regression).
+Direction is inferred from the metric name — latency/footprint suffixes
+(``_ms``/``_us``/``p99``/``lag``/``rss``…) are lower-is-better,
+throughput suffixes (``msgs_s``/``ticks_s``/``ratio``/``ops``…) are
+higher-is-better — and metrics with no inferable direction are tracked
+in the table but never gated.
+
+Legacy rounds (r01–r05 predate sections) are folded in as a ``legacy``
+section from their single parsed metric line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+# direction inference on whole ``_``-separated tokens (substring matching
+# is too greedy: ``chaos_scenarios`` contains ``_s``). Higher-better wins
+# a conflict — ``msgs_s`` is a rate, not a time.
+HIGHER_PARTS = {"msgs", "ops", "ratio", "users", "subs", "sheds",
+                "chains", "delivered", "ticks", "frames", "throughput"}
+LOWER_PARTS = {"ms", "us", "ns", "s", "p50", "p95", "p99", "lag",
+               "overhead", "rss", "staleness", "bytes", "orphans",
+               "orphaned", "stalled", "catchup", "latency"}
+
+
+def direction(metric: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown (not gated)."""
+    parts = set(re.split(r"[^a-z0-9]+", metric.lower()))
+    if parts & HIGHER_PARTS:
+        return 1
+    if parts & LOWER_PARTS:
+        return -1
+    return 0
+
+
+def _slug(text: str) -> str:
+    return re.sub(r"_+", "_", re.sub(r"\W", "_", text)).strip("_")
+
+
+def load_rounds(root: str) -> dict:
+    """{round: {section: {metric: value}}} from every BENCH_r*.json."""
+    rounds = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = ROUND_RE.search(path)
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print(f"[series] skipping unreadable {path}: {exc}",
+                  file=sys.stderr)
+            continue
+        sections = {}
+        if "round" in doc:                       # modern: per-section headline
+            for name, body in doc.items():
+                if name == "round" or not isinstance(body, dict):
+                    continue
+                headline = body.get("headline") or {}
+                metrics = {k: v for k, v in headline.items()
+                           if isinstance(v, (int, float))
+                           and not isinstance(v, bool)}
+                if metrics:
+                    sections[name] = metrics
+        else:                                    # legacy r01–r05 schema
+            parsed = doc.get("parsed") or {}
+            metric, value = parsed.get("metric"), parsed.get("value")
+            if metric and isinstance(value, (int, float)):
+                sections["legacy"] = {_slug(metric): value}
+        if sections:
+            rounds[rnd] = sections
+    return rounds
+
+
+def _fmt(value) -> str:
+    if value is None:
+        return "—"
+    if isinstance(value, float):
+        return f"{value:,.4g}" if abs(value) < 1000 else f"{value:,.0f}"
+    return f"{value:,}"
+
+
+def render_markdown(rounds: dict) -> str:
+    order = sorted(rounds)
+    out = ["# Bench series", "",
+           "Headline metrics per PR round, merged from `BENCH_r*.json` by",
+           "`scripts/bench_series.py` (regenerate with no args; `--gate`",
+           "fails CI on a >10% regression vs the previous round carrying",
+           "the metric). Direction: ↑ higher-is-better, ↓ lower-is-better,",
+           "· untracked.", ""]
+    sections = sorted({s for secs in rounds.values() for s in secs})
+    for section in sections:
+        present = [r for r in order if section in rounds[r]]
+        metrics = sorted({m for r in present for m in rounds[r][section]})
+        out.append(f"## {section}")
+        out.append("")
+        head = "| metric | " + " | ".join(f"r{r}" for r in present) + " |"
+        out.append(head)
+        out.append("|" + "---|" * (len(present) + 1))
+        for metric in metrics:
+            arrow = {1: "↑", -1: "↓", 0: "·"}[direction(metric)]
+            cells = [_fmt(rounds[r][section].get(metric)) for r in present]
+            out.append(f"| {arrow} `{metric}` | " + " | ".join(cells) + " |")
+        out.append("")
+    return "\n".join(out)
+
+
+def gate(rounds: dict, threshold: float) -> list:
+    """Regressions of the latest round vs the nearest earlier round that
+    carries the same metric: [(section, metric, prev_round, prev, cur,
+    pct_worse), ...]."""
+    if len(rounds) < 2:
+        return []
+    order = sorted(rounds)
+    latest = order[-1]
+    failures = []
+    for section, metrics in rounds[latest].items():
+        for metric, cur in metrics.items():
+            sign = direction(metric)
+            if sign == 0:
+                continue
+            prev_round = prev = None
+            for r in reversed(order[:-1]):
+                candidate = rounds[r].get(section, {}).get(metric)
+                if candidate is not None:
+                    prev_round, prev = r, candidate
+                    break
+            if prev is None or prev == 0:
+                continue
+            # pct_worse > 0 means the metric moved the wrong way
+            change = (cur - prev) / abs(prev)
+            pct_worse = -change if sign > 0 else change
+            if pct_worse > threshold:
+                failures.append((section, metric, prev_round, prev, cur,
+                                 pct_worse))
+    return failures
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--root", default=REPO,
+                    help="directory holding BENCH_r*.json (default: repo)")
+    ap.add_argument("--out", default=None,
+                    help="output markdown (default: <root>/BENCH_SERIES.md)")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 if the latest round regressed >threshold "
+                         "vs the previous round carrying the metric")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="gate threshold as a fraction (default 0.10)")
+    args = ap.parse_args()
+
+    rounds = load_rounds(args.root)
+    if not rounds:
+        print("[series] no BENCH_r*.json found", file=sys.stderr)
+        return 1
+    out_path = args.out or os.path.join(args.root, "BENCH_SERIES.md")
+    with open(out_path, "w") as fh:
+        fh.write(render_markdown(rounds))
+    print(f"[series] wrote {out_path} "
+          f"({len(rounds)} rounds: r{min(rounds)}..r{max(rounds)})")
+
+    if args.gate:
+        failures = gate(rounds, args.threshold)
+        for section, metric, prev_round, prev, cur, pct in failures:
+            print(f"[series] GATE FAIL {section}.{metric}: "
+                  f"r{prev_round}={_fmt(prev)} -> r{max(rounds)}={_fmt(cur)} "
+                  f"({pct:+.1%} worse; threshold {args.threshold:.0%})")
+        if failures:
+            return 1
+        print(f"[series] gate OK: no metric regressed "
+              f">{args.threshold:.0%} vs its previous round")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,8 +17,16 @@ Beyond the end-to-end echo, the run proves the observability plane
   reports per-hop p50/p99 for a complete publish→delivery chain with zero
   orphaned spans (with ``--trace-log``).
 
+``--chaos`` adds scripted failure injection after the baseline checks:
+a broker SIGKILL (with ``--shards``, a shard-*worker* SIGKILL that
+fail-fasts the whole sharded box), a marshal loss, and a discovery-store
+outage — each asserted against its composition invariant (echo rides out
+control-plane loss; survivors dump the abnormal-disconnect trail; new
+admissions are refused, never silently dropped; everything recovers on
+respawn/release).
+
 Exits nonzero if any component dies early, the client fails to echo, or
-any observability check fails.
+any observability or chaos check fails.
 """
 
 from __future__ import annotations
@@ -367,6 +375,321 @@ def check_load_shed(marshal_port: int, broker_ports: dict) -> bool:
     return asyncio.run(drive())
 
 
+# ---------------------------------------------------------------------------
+# scripted chaos (--chaos): kill real processes mid-run and assert the
+# composition invariants — the data plane rides out control-plane loss,
+# survivors converge, and every event leaves a flight-recorder trail
+# ---------------------------------------------------------------------------
+
+
+class EchoWatch:
+    """Watch the echo client's merged stdout for FRESH lines without
+    blocking. Reads the raw fd (the startup loop's buffered reader is
+    done by chaos time): anything already pipelined is drained first, so
+    a match proves the data plane worked AFTER the chaos event."""
+
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+        self.fd = proc.stdout.fileno()
+
+    def _read_chunk(self) -> str:
+        import select
+        r, _, _ = select.select([self.fd], [], [], 0.25)
+        if not r:
+            return ""
+        try:
+            chunk = os.read(self.fd, 65536)
+        except OSError:
+            return ""
+        return chunk.decode(errors="replace")
+
+    def drain(self, settle_s: float = 0.3) -> None:
+        deadline = time.time() + settle_s
+        while time.time() < deadline:
+            self._read_chunk()
+
+    def wait_fresh(self, needle: str, wait_s: float) -> bool:
+        buf = ""
+        deadline = time.time() + wait_s
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                print("[chaos] FAIL: echo client process died")
+                return False
+            buf += self._read_chunk()
+            if needle in buf:
+                return True
+        return False
+
+
+def try_connect(marshal_port: int, seed: int, timeout_s: float) -> bool:
+    """One in-process client connect attempt through the real marshal —
+    the probe for 'can NEW work be admitted right now?'."""
+    import asyncio
+
+    from pushcdn_tpu.bin.common import keypair_from_seed
+    from pushcdn_tpu.client import Client, ClientConfig
+    from pushcdn_tpu.proto.transport.tcp import Tcp
+
+    async def drive() -> bool:
+        client = Client(ClientConfig(
+            marshal_endpoint=f"127.0.0.1:{marshal_port}",
+            keypair=keypair_from_seed(seed),
+            protocol=Tcp, subscribed_topics=set()))
+        try:
+            async with asyncio.timeout(timeout_s):
+                await client.ensure_initialized()
+            return True
+        except Exception:
+            return False
+        finally:
+            client.close()
+
+    return asyncio.run(drive())
+
+
+def _log_gained(path: str, offset: int, needle: str, wait_s: float) -> bool:
+    """True once ``needle`` appears in ``path`` PAST ``offset`` — the
+    flight-recorder correlation check (dumps land in the survivor's log
+    after the event, never before it)."""
+    deadline = time.time() + wait_s
+    while time.time() < deadline:
+        try:
+            with open(path, errors="replace") as fh:
+                fh.seek(offset)
+                if needle in fh.read():
+                    return True
+        except OSError:
+            pass
+        time.sleep(0.3)
+    return False
+
+
+def _log_size(path: str) -> int:
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return 0
+
+
+def check_chaos(procs: list, replace_proc, spawn_broker, spawn_marshal,
+                watch: "EchoWatch", broker_ports: dict, metrics_ports: dict,
+                marshal_port: int, db: str, logdir: str, shards: int,
+                events=("broker", "marshal", "discovery")) -> bool:
+    """Scripted chaos events against the live cluster, each asserted
+    against its composition invariant:
+
+    1. **broker SIGKILL** (or, with ``--shards``, SIGKILL of one shard
+       *worker*, which fail-fasts the whole sharded box): the elastic
+       client re-load-balances through the marshal and echoes again; the
+       surviving broker's flight recorder dumps the abnormal peer
+       disconnect; the victim respawns and the mesh re-forms.
+    2. **marshal loss**: NEW admissions fail, but the established data
+       plane keeps echoing (control/data decoupling); the respawned
+       marshal admits again.
+    3. **discovery outage**: the store's write lock is held hostage, so
+       permit minting (and heartbeats) fail — new admissions are refused
+       while the outage lasts, heartbeat failures land in the process
+       flight recorder (``task-died heartbeat``), and everything recovers
+       on release. (The embedded store's writes are synchronous, so
+       in-flight echoes can stall with it — the invariant asserted is
+       refuse-then-recover, not zero-jitter.)
+    """
+    ok = True
+    if "broker" in events:
+        ok = _chaos_broker_kill(procs, replace_proc, spawn_broker, watch,
+                                broker_ports, metrics_ports, logdir,
+                                shards) and ok
+    if "marshal" in events:
+        ok = _chaos_marshal_loss(procs, replace_proc, spawn_marshal, watch,
+                                 marshal_port) and ok
+    if "discovery" in events:
+        ok = _chaos_discovery_outage(watch, broker_ports, marshal_port,
+                                     db) and ok
+    if ok:
+        print("[chaos] OK: all chaos events rode out with invariants held")
+    return ok
+
+
+def _proc_of(procs: list, name: str) -> subprocess.Popen:
+    return next(p for n, p in procs if n == name)
+
+
+def _chaos_broker_kill(procs, replace_proc, spawn_broker,
+                       watch: "EchoWatch", broker_ports: dict,
+                       metrics_ports: dict, logdir: str,
+                       shards: int) -> bool:
+    ok = True
+    if shards > 1:
+        victim = "broker0"
+        topo = fetch_topology(metrics_ports[victim])
+        worker = ((topo or {}).get("shards") or {}).get("1") or {}
+        pid = worker.get("pid")
+        if not pid:
+            print("[chaos] FAIL: no shard-worker pid in broker0 topology")
+            return False
+        survivor = "broker1"
+        surv_log0 = _log_size(os.path.join(logdir, f"{survivor}.log"))
+        print(f"[chaos] SIGKILL shard-1 worker (pid {pid}) of {victim}")
+        os.kill(pid, signal.SIGKILL)
+        # fail-fast supervisor: ANY dead worker takes the whole box down
+        proc = _proc_of(procs, victim)
+        deadline = time.time() + 20.0
+        while time.time() < deadline and proc.poll() is None:
+            time.sleep(0.1)
+        if proc.poll() is None:
+            print("[chaos] FAIL: sharded broker0 survived a dead worker "
+                  "(fail-fast supervisor broken)")
+            ok = False
+    else:
+        # kill whichever broker is serving the echo client — the sharpest
+        # version of the event (the reconnect path MUST run)
+        users = {}
+        for name, port in broker_ports.items():
+            topo = fetch_topology(port)
+            users[name] = (topo or {}).get("num_users", 0)
+        victim = max(users, key=lambda n: users[n])
+        survivor = next(n for n in broker_ports if n != victim)
+        surv_log0 = _log_size(os.path.join(logdir, f"{survivor}.log"))
+        print(f"[chaos] SIGKILL {victim} (serving {users[victim]} user(s))")
+        watch.drain()
+        proc = _proc_of(procs, victim)
+        proc.kill()
+        proc.wait(timeout=10)
+
+    watch.drain()
+    if not watch.wait_fresh("recv direct", 45.0):
+        print(f"[chaos] FAIL: client never echoed again after {victim} "
+              "was killed")
+        ok = False
+    else:
+        print(f"[chaos] echo resumed after {victim} kill (client "
+              "re-load-balanced through the marshal)")
+    # a SIGKILLed peer reads as a clean FIN on the survivor (failure-is-
+    # removal, sender.rs semantics): the correlation trail is the removal
+    # diagnostic ("broker X removed (...); forgot N routed users"), which
+    # the connection's flight recorder also carries as a "removed" event
+    if not _log_gained(os.path.join(logdir, f"{survivor}.log"), surv_log0,
+                       "; forgot", 20.0):
+        print(f"[chaos] FAIL: {survivor} never logged the dead peer's "
+              "removal")
+        ok = False
+    else:
+        print(f"[chaos] peer-loss correlation: {survivor} recorded the "
+              "dead peer's removal")
+
+    # respawn the victim and wait for the mesh to re-form
+    idx = int(victim[-1])
+    replace_proc(victim, spawn_broker(idx))
+
+    def mesh_reformed() -> bool:
+        for port in broker_ports.values():
+            topo = fetch_topology(port)
+            if topo is None or topo.get("num_brokers", 0) != 1:
+                return False
+        return True
+
+    deadline = time.time() + 60.0
+    while time.time() < deadline and not mesh_reformed():
+        time.sleep(0.3)
+    if not mesh_reformed():
+        print(f"[chaos] FAIL: mesh never re-formed after {victim} respawn")
+        ok = False
+    else:
+        print(f"[chaos] mesh re-formed after {victim} respawn")
+    return ok
+
+
+def _chaos_marshal_loss(procs, replace_proc, spawn_marshal,
+                        watch: "EchoWatch", marshal_port: int) -> bool:
+    ok = True
+    print("[chaos] SIGKILL marshal")
+    proc = _proc_of(procs, "marshal")
+    proc.kill()
+    proc.wait(timeout=10)
+    if try_connect(marshal_port, seed=201, timeout_s=4.0):
+        print("[chaos] FAIL: a new client connected with the marshal dead")
+        ok = False
+    else:
+        print("[chaos] new admissions refused while the marshal is down")
+    watch.drain()
+    if not watch.wait_fresh("recv direct", 20.0):
+        print("[chaos] FAIL: established data plane stalled during "
+              "marshal loss")
+        ok = False
+    else:
+        print("[chaos] established data plane kept echoing through "
+              "marshal loss")
+    replace_proc("marshal", spawn_marshal())
+    if not try_connect(marshal_port, seed=202, timeout_s=25.0):
+        print("[chaos] FAIL: new client could not connect after the "
+              "marshal respawn")
+        ok = False
+    else:
+        print("[chaos] marshal respawned; new admissions flow again")
+    return ok
+
+
+def _chaos_discovery_outage(watch: "EchoWatch", broker_ports: dict,
+                            marshal_port: int, db: str) -> bool:
+    import sqlite3
+
+    ok = True
+    print("[chaos] discovery outage: holding the store's write lock")
+    lock = sqlite3.connect(db, isolation_level=None)
+    try:
+        lock.execute("PRAGMA busy_timeout=1000")
+        lock.execute("BEGIN IMMEDIATE")
+        outage_t0 = time.time()
+        if try_connect(marshal_port, seed=203, timeout_s=4.0):
+            print("[chaos] FAIL: a new client was admitted during the "
+                  "discovery outage (permit mint should have failed)")
+            ok = False
+        else:
+            print("[chaos] new admissions refused during the discovery "
+                  "outage")
+        # hold the lock PAST the store's 5 s busy timeout so at least one
+        # broker heartbeat actually fails (a shorter outage just delays
+        # the write, and the failure trail would never exist)
+        remaining = 8.0 - (time.time() - outage_t0)
+        if remaining > 0:
+            time.sleep(remaining)
+    finally:
+        try:
+            lock.rollback()
+        finally:
+            lock.close()
+    if not try_connect(marshal_port, seed=204, timeout_s=25.0):
+        print("[chaos] FAIL: admissions never recovered after the "
+              "discovery outage")
+        ok = False
+    else:
+        print("[chaos] admissions recovered after the discovery outage")
+    watch.drain()
+    if not watch.wait_fresh("recv direct", 20.0):
+        print("[chaos] FAIL: echo never resumed after the discovery outage")
+        ok = False
+    # heartbeat failures during the outage are supervised-task deaths —
+    # the correlation trail lives in the brokers' process flight recorder
+    flightrec_seen = False
+    deadline = time.time() + 10.0
+    while time.time() < deadline and not flightrec_seen:
+        for port in broker_ports.values():
+            res = http_get(port, "/debug/flightrec?limit=400")
+            if res is not None and res[0] == 200 \
+                    and "task-died" in res[1] and "heartbeat" in res[1]:
+                flightrec_seen = True
+                break
+        time.sleep(0.3)
+    if not flightrec_seen:
+        print("[chaos] FAIL: no broker recorded the heartbeat failure in "
+              "its flight recorder during the outage")
+        ok = False
+    else:
+        print("[chaos] flight-recorder correlation: heartbeat task-died "
+              "event recorded during the outage")
+    return ok
+
+
 def check_drain(name: str, proc: subprocess.Popen, port: int) -> bool:
     """SIGINT the process and verify /readyz flips to 503 (draining)
     BEFORE the listeners close — the process keeps answering through the
@@ -490,6 +813,17 @@ def main() -> int:
                          "processes); spawns a second client so directs "
                          "cross the shard boundary, and asserts the "
                          "handoff rings carried them")
+    ap.add_argument("--chaos", action="store_true",
+                    help="scripted chaos events after the baseline checks: "
+                         "broker SIGKILL (a shard-worker kill under "
+                         "--shards), marshal loss, and a discovery outage "
+                         "— each asserted against its composition "
+                         "invariant and correlated in the flight recorder")
+    ap.add_argument("--chaos-events", default="broker,marshal,discovery",
+                    metavar="LIST",
+                    help="comma-separated subset of chaos events to run "
+                         "(broker, marshal, discovery); the CI smoke tier "
+                         "runs one event to stay fast")
     args = ap.parse_args()
 
     if args.trace_log:
@@ -528,57 +862,88 @@ def main() -> int:
     if args.shards > 1:
         metrics_ports["client2"] = bp + 142
     procs: list[tuple[str, subprocess.Popen]] = []
-    ok = True
-    try:
-        for i in range(2):
-            env = {**trace_env(f"broker{i}"),
-                   "PUSHCDN_DRAIN_GRACE_S": str(DRAIN_GRACE_S)}
-            if args.churn:
-                # tiny per-connection subscribe budget so the churn driver
-                # forces shedding quickly; the ready window is generous so
-                # the /readyz flip is externally observable
-                env.update({"PUSHCDN_SUBSCRIBE_RATE": "2",
-                            "PUSHCDN_SUBSCRIBE_BURST": "3",
-                            "PUSHCDN_SHED_READY_S": str(SHED_READY_S)})
-            shard_flags = []
-            if i == 0:
+
+    def replace_proc(name: str, proc: subprocess.Popen) -> None:
+        for idx, (n, _p) in enumerate(procs):
+            if n == name:
+                procs[idx] = (name, proc)
+                return
+        procs.append((name, proc))
+
+    def spawn_broker(i: int, first_boot: bool = False) -> subprocess.Popen:
+        env = {**trace_env(f"broker{i}"),
+               "PUSHCDN_DRAIN_GRACE_S": str(DRAIN_GRACE_S)}
+        if args.churn:
+            # tiny per-connection subscribe budget so the churn driver
+            # forces shedding quickly; the ready window is generous so
+            # the /readyz flip is externally observable
+            env.update({"PUSHCDN_SUBSCRIBE_RATE": "2",
+                        "PUSHCDN_SUBSCRIBE_BURST": "3",
+                        "PUSHCDN_SHED_READY_S": str(SHED_READY_S)})
+        shard_flags = []
+        if i == 0:
+            if first_boot:
                 # hold broker0's listener binds open so the not-ready-
-                # before-bind state is externally observable
+                # before-bind state is externally observable (a chaos
+                # respawn skips the delay: nothing observes it then)
                 env["PUSHCDN_BIND_DELAY_S"] = "1.5"
-                if args.shards > 1:
-                    shard_flags = ["--shards", str(args.shards)]
-                    # deterministic round-robin accept distribution: the
-                    # two clients land on DIFFERENT workers, so their
-                    # directs must cross the shard boundary (this also
-                    # CI-covers the fd-handoff accept path; SO_REUSEPORT
-                    # is covered by benches/route_bench.py --shards)
-                    env["PUSHCDN_SHARD_ACCEPT"] = "handoff"
-            procs.append((f"broker{i}", spawn(
-                "broker",
-                "--discovery-endpoint", db,
-                "--public-advertise-endpoint", f"127.0.0.1:{bp + i * 2}",
-                "--public-bind-endpoint", f"127.0.0.1:{bp + i * 2}",
-                "--private-advertise-endpoint", f"127.0.0.1:{bp + i * 2 + 1}",
-                "--private-bind-endpoint", f"127.0.0.1:{bp + i * 2 + 1}",
-                "--user-transport", "tcp",   # plain tcp for the local demo
-                "--metrics-bind-endpoint",
-                f"127.0.0.1:{metrics_ports[f'broker{i}']}",
-                *shard_flags,
-                *(["--device-plane"] if args.device_plane else []),
-                env_extra=env,
-                log_path=os.path.join(logdir, f"broker{i}.log"))))
+            if args.shards > 1:
+                shard_flags = ["--shards", str(args.shards)]
+                # deterministic round-robin accept distribution: the
+                # two clients land on DIFFERENT workers, so their
+                # directs must cross the shard boundary (this also
+                # CI-covers the fd-handoff accept path; SO_REUSEPORT
+                # is covered by benches/route_bench.py --shards)
+                env["PUSHCDN_SHARD_ACCEPT"] = "handoff"
+        chaos_flags = []
+        if args.chaos:
+            # a SIGKILLed broker must age out of placement fast, or the
+            # marshal keeps handing its dead endpoint to the reconnecting
+            # client for the full 60 s reference TTL
+            chaos_flags = ["--heartbeat-interval", "1",
+                           "--membership-ttl", "5"]
+        return spawn(
+            "broker",
+            "--discovery-endpoint", db,
+            "--public-advertise-endpoint", f"127.0.0.1:{bp + i * 2}",
+            "--public-bind-endpoint", f"127.0.0.1:{bp + i * 2}",
+            "--private-advertise-endpoint", f"127.0.0.1:{bp + i * 2 + 1}",
+            "--private-bind-endpoint", f"127.0.0.1:{bp + i * 2 + 1}",
+            "--user-transport", "tcp",   # plain tcp for the local demo
+            "--metrics-bind-endpoint",
+            f"127.0.0.1:{metrics_ports[f'broker{i}']}",
+            *shard_flags, *chaos_flags,
+            *(["--device-plane"] if args.device_plane else []),
+            env_extra=env,
+            log_path=os.path.join(logdir, f"broker{i}.log"))
+
+    def spawn_marshal() -> subprocess.Popen:
+        return spawn(
+            "marshal",
+            "--discovery-endpoint", db,
+            "--bind-endpoint", f"127.0.0.1:{bp + 50}",
+            "--metrics-bind-endpoint",
+            f"127.0.0.1:{metrics_ports['marshal']}",
+            "--user-transport", "tcp",
+            env_extra=trace_env("marshal"),
+            log_path=os.path.join(logdir, "marshal.log"))
+
+    ok = True
+    # chaos mode heartbeats every 1 s, so the marshal's load view is FRESH
+    # and it correctly balances client2 onto broker1 — which starves the
+    # sharded cross-shard check (it needs both clients on broker0). Spawn
+    # broker1 only after both clients are placed: with one broker alive
+    # the marshal has no choice, and co-location is deterministic instead
+    # of an artifact of stale 10 s load reports.
+    late_broker1 = args.chaos and args.shards > 1
+    try:
+        for i in range(1 if late_broker1 else 2):
+            procs.append((f"broker{i}", spawn_broker(i, first_boot=True)))
             if i == 0:
                 ok = check_readiness_before_bind(metrics_ports["broker0"]) \
                     and ok
         time.sleep(1.5)  # brokers register + mesh up
-        procs.append(("marshal", spawn(
-            "marshal",
-            "--discovery-endpoint", db,
-            "--bind-endpoint", f"127.0.0.1:{bp + 50}",
-            "--metrics-bind-endpoint", f"127.0.0.1:{metrics_ports['marshal']}",
-            "--user-transport", "tcp",
-            env_extra=trace_env("marshal"),
-            log_path=os.path.join(logdir, "marshal.log"))))
+        procs.append(("marshal", spawn_marshal()))
         time.sleep(1.0)
         procs.append(("client", spawn(
             "client",
@@ -598,6 +963,10 @@ def main() -> int:
                 "--metrics-bind-endpoint",
                 f"127.0.0.1:{metrics_ports['client2']}",
                 env_extra=trace_env("client2"))))
+        if late_broker1:
+            time.sleep(1.0)  # both clients placed on broker0 first
+            procs.append(("broker1", spawn_broker(1, first_boot=True)))
+            # mesh forms within ~1 s (chaos heartbeat); check_topology polls
 
         deadline = time.time() + args.duration
         echoed = False
@@ -644,6 +1013,19 @@ def main() -> int:
         if args.trace_log:
             ok = check_trace_chain(args.trace_log) and ok
             ok = run_trace_report(args.trace_log) and ok
+        if args.chaos:
+            # ---- scripted chaos (this PR): broker SIGKILL / marshal
+            # loss / discovery outage, each with its invariant + flight-
+            # recorder correlation; runs LAST before drain because it
+            # respawns processes the earlier checks assume stable
+            ok = check_chaos(procs, replace_proc, spawn_broker,
+                             spawn_marshal, EchoWatch(client),
+                             broker_ports, metrics_ports, bp + 50,
+                             db, logdir, args.shards,
+                             events=tuple(
+                                 e.strip() for e in
+                                 args.chaos_events.split(",") if e.strip()
+                             )) and ok
         # drain LAST: SIGINT broker1 and watch readiness flip before its
         # listeners close (the client may briefly reconnect after; every
         # earlier check has already run)

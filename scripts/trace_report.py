@@ -89,6 +89,79 @@ def _pct(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def build_view_report(spans: List[dict], top: int = 3):
+    """Aggregate view-tagged spans (consensus workloads, ISSUE 11) into
+    per-view SLOs: view completion time (first origin → last delivery),
+    per-view chain completeness, and a per-hop breakdown of the slowest
+    views. Returns ``None`` when no span carries a view tag."""
+    by_view: Dict[int, List[dict]] = {}
+    for rec in spans:
+        view = rec.get("view")
+        if view is None:
+            continue
+        by_view.setdefault(view, []).append(rec)
+    if not by_view:
+        return None
+
+    per_view = {}
+    completions: List[float] = []
+    stalled = 0
+    incomplete_total = 0
+    for view, recs in sorted(by_view.items()):
+        by_id: Dict[int, List[dict]] = {}
+        for rec in recs:
+            by_id.setdefault(rec["trace_id"], []).append(rec)
+        complete = 0
+        incomplete = 0
+        for recs_of_id in by_id.values():
+            if REQUIRED <= {r["hop"] for r in recs_of_id}:
+                complete += 1
+            else:
+                incomplete += 1
+        deliveries = [r["t_ns"] for r in recs if r["hop"] == "delivery"]
+        start_ns = min(r["origin_ns"] for r in recs)
+        completion_ms = (max(deliveries) - start_ns) / 1e6 \
+            if deliveries else None
+        hop_p95 = {}
+        for hop in HOPS:
+            vals = sorted(max(r["t_ns"] - r["origin_ns"], 0) / 1e6
+                          for r in recs if r["hop"] == hop)
+            if vals:
+                hop_p95[hop] = round(_pct(vals, 0.95), 3)
+        per_view[view] = {
+            "chains": len(by_id),
+            "complete": complete,
+            "incomplete": incomplete,
+            "completion_ms": (round(completion_ms, 3)
+                              if completion_ms is not None else None),
+            "stalled": not deliveries,
+            "hop_p95_ms": hop_p95,
+        }
+        if completion_ms is not None:
+            completions.append(completion_ms)
+        else:
+            stalled += 1
+        incomplete_total += incomplete
+
+    completions.sort()
+    slowest = sorted(
+        (v for v in per_view if per_view[v]["completion_ms"] is not None),
+        key=lambda v: per_view[v]["completion_ms"], reverse=True)[:max(top, 0)]
+    return {
+        "views": len(per_view),
+        "stalled_views": stalled,
+        "incomplete_view_chains": incomplete_total,
+        "completion_ms": {
+            "p50": round(_pct(completions, 0.50), 3),
+            "p95": round(_pct(completions, 0.95), 3),
+            "p99": round(_pct(completions, 0.99), 3),
+            "max": round(completions[-1], 3) if completions else 0.0,
+        },
+        "per_view": per_view,
+        "slowest_views": slowest,
+    }
+
+
 def build_report(spans: List[dict], duplicates: int = 0,
                  top: int = 5) -> dict:
     """Assemble chains and stats from (deduplicated) span records."""
@@ -101,9 +174,24 @@ def build_report(spans: List[dict], duplicates: int = 0,
     complete: List[dict] = []
     incomplete = 0
     orphaned_spans = 0
+    auth_only = 0
     for tid, recs in by_id.items():
         recs.sort(key=lambda r: r["t_ns"])
         hops = {r["hop"] for r in recs}
+        if hops == {"auth"}:
+            # a connection that authenticated but never published: its
+            # trace id was never reused by a message, so there is no
+            # message lifecycle to be incomplete — counted separately,
+            # not as an orphan (churny subscribers would otherwise fail
+            # the strict gate without a single lost message)
+            auth_only += 1
+            for r in recs:
+                lat = (r["t_ns"] - r["origin_ns"]) / 1e9
+                if lat < 0:
+                    skewed += 1
+                    lat = 0.0
+                per_hop.setdefault(r["hop"], []).append(lat)
+            continue
         # per-hop latency from the carried origin (floor at 0: a receiver
         # whose clock runs behind the origin's reports negative latency —
         # counted as skew, clamped in the stats)
@@ -163,10 +251,12 @@ def build_report(spans: List[dict], duplicates: int = 0,
         "complete_chains": len(complete),
         "incomplete_chains": incomplete,
         "orphaned_spans": orphaned_spans,
+        "auth_only_chains": auth_only,
         "skewed_hops": skewed,
         "per_hop": {hop: hop_stats[hop] for hop in HOPS
                     if hop in hop_stats},
         "slowest": slowest,
+        "views": build_view_report(spans, top=min(top, 3)),
     }
 
 
@@ -177,7 +267,8 @@ def format_report(report: dict) -> str:
         f"{report['skewed_hops']} clock-skewed hops)",
         f"chains: {report['complete_chains']} complete, "
         f"{report['incomplete_chains']} incomplete "
-        f"({report['orphaned_spans']} orphaned spans)",
+        f"({report['orphaned_spans']} orphaned spans, "
+        f"{report.get('auth_only_chains', 0)} auth-only connections)",
         "",
         f"{'hop':<10} {'count':>6} {'p50 ms':>9} {'p95 ms':>9} "
         f"{'p99 ms':>9} {'max ms':>9}",
@@ -197,6 +288,22 @@ def format_report(report: dict) -> str:
                 detail = f"  ({h['detail']})" if h["detail"] else ""
                 out.append(f"    {h['hop']:<10} +{h['dt_ms']:8.3f} ms  "
                            f"@{h['at_ms']:8.3f} ms{detail}{skew}")
+    vr = report.get("views")
+    if vr:
+        c = vr["completion_ms"]
+        out.append("")
+        out.append(f"views: {vr['views']} tagged "
+                   f"({vr['stalled_views']} stalled, "
+                   f"{vr['incomplete_view_chains']} incomplete view chains)")
+        out.append(f"view completion ms: p50 {c['p50']:.3f}  "
+                   f"p95 {c['p95']:.3f}  p99 {c['p99']:.3f}  "
+                   f"max {c['max']:.3f}")
+        for v in vr["slowest_views"]:
+            s = vr["per_view"][v]
+            hops = "  ".join(f"{h}@{ms:.2f}"
+                             for h, ms in s["hop_p95_ms"].items())
+            out.append(f"  view {v}: {s['completion_ms']:.3f} ms, "
+                       f"{s['complete']}/{s['chains']} chains  [{hops}]")
     return "\n".join(out)
 
 
@@ -228,6 +335,16 @@ def main(argv=None) -> int:
         print("trace_report: FAIL (strict): "
               f"{report['incomplete_chains']} incomplete chains / "
               f"{report['orphaned_spans']} orphaned spans",
+              file=sys.stderr)
+        return 1
+    vr = report.get("views")
+    if args.strict and vr and (vr["stalled_views"]
+                               or vr["incomplete_view_chains"]):
+        # view-level gates (ISSUE 11): a view with zero deliveries is a
+        # stall; a view-tagged chain missing hops is an in-view orphan
+        print("trace_report: FAIL (strict): "
+              f"{vr['stalled_views']} stalled views / "
+              f"{vr['incomplete_view_chains']} incomplete view chains",
               file=sys.stderr)
         return 1
     return 0

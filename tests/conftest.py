@@ -28,6 +28,15 @@ import inspect  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 CI runs `-m 'not slow'`; register the marker so the long
+    # tiers (full chaos suite, big soak runs) deselect cleanly instead
+    # of tripping unknown-marker warnings
+    config.addinivalue_line(
+        "markers", "slow: long-running tier excluded from tier-1 CI "
+        "(run explicitly with -m slow)")
+
+
 # Run `async def` tests on a fresh event loop (no pytest-asyncio needed).
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):

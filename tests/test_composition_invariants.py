@@ -1,0 +1,175 @@
+"""Chaos composition invariants (ISSUE 11): interference degrades
+loudly, it never corrupts. (1) Admission shedding tripped mid-view must
+not stall consensus view completion — sheds are typed notices to the
+offender, not lost frames for everyone else. (2) A shard worker dying
+mid-stream must not cost a surviving sibling-shard subscriber one
+message or one reorder — cross-shard degradation is counted, local
+delivery is untouched. Seeded and deterministic, asserted against BOTH
+route implementations (the native cut-through plane and the scalar
+loops drive the same egress seams)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from pushcdn_tpu.broker.tasks import cutthrough
+from pushcdn_tpu.proto import trace as trace_mod
+from pushcdn_tpu.proto.error import Error, ErrorKind
+from pushcdn_tpu.proto.message import Broadcast, deserialize, serialize
+from pushcdn_tpu.proto.topic import TopicSpace
+from pushcdn_tpu.proto.transport.base import FrameChunk
+from pushcdn_tpu.proto.transport.memory import Memory
+from pushcdn_tpu.testing.cluster import Cluster
+from pushcdn_tpu.testing.consensus import ConsensusConfig, run_consensus
+
+
+def _route_impl(impl):
+    if impl == "native" and not cutthrough.routeplan.available():
+        pytest.skip("native route-plan kernel unavailable")
+
+
+async def _drain_all(conn, settle_s: float = 0.05):
+    got = []
+    while True:
+        try:
+            items = await asyncio.wait_for(conn.recv_frames(), settle_s)
+        except (asyncio.TimeoutError, Exception):
+            return got
+        for item in items:
+            if type(item) is FrameChunk:
+                got.extend(bytes(mv) for mv in item.views())
+            else:
+                got.append(bytes(item.data))
+            item.release()
+
+
+# ---------------------------------------------------------------------------
+# invariant 1: shed mutations mid-view never stall view completion
+# ---------------------------------------------------------------------------
+
+
+async def _subscribe_spammer(cluster, stop: asyncio.Event) -> int:
+    """Burst subscribe mutations past the token bucket until admission
+    sheds; count the typed Error(SHED) notices."""
+    c = cluster.client(seed=71_000, topics=[6])
+    sheds = 0
+    try:
+        await asyncio.wait_for(c.ensure_initialized(), 10.0)
+        t = 0
+        while not stop.is_set():
+            try:
+                for _ in range(4):
+                    t += 1
+                    await c.subscribe([t % 40 + 10])
+                while True:
+                    await asyncio.wait_for(c.receive_messages(), 0.005)
+            except asyncio.TimeoutError:
+                pass
+            except Error as exc:
+                if exc.kind == ErrorKind.SHED:
+                    sheds += 1
+            except Exception:
+                pass
+            await asyncio.sleep(0)
+    finally:
+        c.close()
+    return sheds
+
+
+@pytest.mark.parametrize("impl", ["native", "python"])
+async def test_shed_mid_view_never_stalls_consensus(impl, monkeypatch):
+    _route_impl(impl)
+    # tiny budget so the spammer trips shedding within the first view
+    monkeypatch.setenv("PUSHCDN_SUBSCRIBE_RATE", "1")
+    monkeypatch.setenv("PUSHCDN_SUBSCRIBE_BURST", "2")
+    prev_log = trace_mod.set_log_path(None)
+    prev_impl = cutthrough.ROUTE_IMPL
+    cutthrough.ROUTE_IMPL = impl
+    try:
+        # wide topic space: the spammer's mutation topics must be VALID —
+        # an invalid topic is a handshake rejection, not a shed
+        cluster = await Cluster(num_brokers=1,
+                                topics=TopicSpace.range(64)).start()
+        try:
+            stop = asyncio.Event()
+            spam = asyncio.create_task(_subscribe_spammer(cluster, stop))
+            run = await run_consensus(cluster, ConsensusConfig(
+                num_nodes=4, num_views=3, view_timeout_s=15.0, seed=21))
+            stop.set()
+            sheds = await asyncio.wait_for(spam, 15.0)
+        finally:
+            await cluster.stop()
+        assert sheds > 0, \
+            "the admission layer never shed — the scenario proved nothing"
+        assert run.timeouts == 0, \
+            f"shed traffic stalled consensus: {run.timeouts} view timeouts"
+        assert run.completed == 3
+        # the shed offender's connection was degraded, not killed: every
+        # quorum vote still arrived
+        assert all(v.votes >= 3 for v in run.views)
+    finally:
+        cutthrough.ROUTE_IMPL = prev_impl
+        trace_mod.set_log_path(prev_log)
+
+
+# ---------------------------------------------------------------------------
+# invariant 2: a shard worker death never reorders a survivor's stream
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl", ["native", "python"])
+async def test_shard_worker_death_no_survivor_reorder(impl):
+    _route_impl(impl)
+    from pushcdn_tpu.testing.shardharness import run_sharded
+    prev_impl = cutthrough.ROUTE_IMPL
+    prev_win = Memory.set_duplex_window(512 * 1024)
+    cutthrough.ROUTE_IMPL = impl
+    try:
+        # user-0: survivor subscriber on shard 0; user-1: subscriber on
+        # shard 1 (dies with its worker); user-2: publisher on shard 0
+        run = await run_sharded([(0, [0]), (1, [0]), (0, [])],
+                                num_shards=2)
+        try:
+            rng = np.random.default_rng(1311)
+
+            def frame(seq: int) -> bytes:
+                tail = bytes(rng.integers(
+                    0, 256, int(rng.integers(8, 64)), dtype=np.uint8))
+                return serialize(Broadcast(
+                    [0], seq.to_bytes(4, "big") + tail))
+
+            sender = run.user(2).remote
+            await sender.send_raw_many([frame(s) for s in range(20)],
+                                       flush=True)
+            await run.settle(40)
+            # mid-stream worker death: shard 1 stops draining its rings
+            # and its users are gone — the in-process analog of the
+            # SIGKILL scripts/local_cluster.py --chaos --shards deals out
+            await run.brokers[1].stop()
+            await sender.send_raw_many([frame(s) for s in range(20, 40)],
+                                       flush=True)
+            await run.settle(40)
+
+            got = await _drain_all(run.user(0).remote)
+            seqs = []
+            for raw in got:
+                m = deserialize(raw)
+                assert isinstance(m, Broadcast)
+                seqs.append(int.from_bytes(bytes(m.message)[:4], "big"))
+            assert seqs == list(range(40)), (
+                f"survivor lost/reordered: got {len(seqs)}, first miss at "
+                f"{next((i for i, s in enumerate(seqs) if s != i), '?')}")
+            # the publisher rode out its sibling's death
+            assert run.brokers[0].connections.has_user(b"user-2")
+            # degradation is COUNTED, never silent: the frames destined
+            # for the dead shard show up in shard 0's fallback counters
+            # once its ring backs up (ring capacity may absorb them all
+            # in a short run, so assert the counters exist, not a floor)
+            stats = run.runtimes[0].stats()
+            assert "relay_fallbacks" in stats and "relay_shed" in stats
+        finally:
+            await run.shutdown()
+    finally:
+        cutthrough.ROUTE_IMPL = prev_impl
+        Memory.set_duplex_window(prev_win)

@@ -1,0 +1,100 @@
+"""Consensus-shaped workload driver (ISSUE 11): view lifecycle, zipf
+geography, view-tagged span capture, and the chaos composition
+invariants (shed-mid-view must not stall; shard-worker kill must not
+reorder a surviving peer)."""
+
+import asyncio
+import json
+import os
+
+from pushcdn_tpu.proto import trace as trace_mod
+from pushcdn_tpu.testing.cluster import Cluster
+from pushcdn_tpu.testing.consensus import (
+    ConsensusConfig,
+    ConsensusDriver,
+    encode_proposal,
+    encode_vote,
+    percentile,
+    run_consensus,
+)
+
+
+def test_config_zipf_latency_tail():
+    cfg = ConsensusConfig(num_nodes=8, base_latency_s=0.01,
+                          tail_latency_s=0.08, zipf_alpha=1.0)
+    lats = [cfg.node_latency_s(i) for i in range(8)]
+    # node 0 carries the full tail; the tail decays monotonically to base
+    assert lats[0] == 0.09
+    assert all(a >= b for a, b in zip(lats, lats[1:]))
+    assert abs(lats[-1] - 0.02) < 1e-9
+    # unshaped config keeps the plain Memory protocol (no pump tasks)
+    from pushcdn_tpu.proto.transport.memory import Memory
+    assert ConsensusConfig().node_protocol(0) is Memory
+    assert cfg.node_protocol(0) is not Memory
+
+
+def test_quorum_default_is_two_thirds_plus_one():
+    assert ConsensusConfig(num_nodes=4).effective_quorum() == 3
+    assert ConsensusConfig(num_nodes=10).effective_quorum() == 7
+    assert ConsensusConfig(num_nodes=3, quorum=5).effective_quorum() == 3
+
+
+def test_payload_codecs_are_sized_and_parseable():
+    p = encode_proposal(7, 256)
+    assert len(p) == 256 and p[:1] == b"P"
+    v = encode_vote(7, 3, 64)
+    assert len(v) == 64 and v[:1] == b"V"
+    assert percentile([], 0.5) is None
+    assert percentile([1.0, 2.0, 3.0, 4.0], 0.5) == 2.0
+
+
+async def test_consensus_views_complete_clean(tmp_path):
+    log = str(tmp_path / "spans.jsonl")
+    prev = trace_mod.set_log_path(log)
+    cluster = await Cluster(num_brokers=1).start()
+    try:
+        run = await run_consensus(cluster, ConsensusConfig(
+            num_nodes=4, num_views=3, view_timeout_s=10.0, seed=1))
+        assert run.completed == 3 and run.timeouts == 0
+        assert run.proposals_sent == 3
+        # quorum is 3 of 4: at least quorum votes counted per view
+        assert all(v.votes >= 3 for v in run.views)
+        pct = run.completion_percentiles()
+        assert pct["p50"] is not None and pct["p50"] > 0
+    finally:
+        await cluster.stop()
+        trace_mod.set_log_path(prev)
+    # the span log carries the view tag on every consensus hop
+    views = set()
+    for line in open(log):
+        rec = json.loads(line)
+        if "view" in rec:
+            views.add(rec["view"])
+    assert views == {0, 1, 2}
+
+
+async def test_consensus_zipf_tail_slows_but_does_not_stall(tmp_path):
+    prev = trace_mod.set_log_path(None)
+    cluster = await Cluster(num_brokers=1).start()
+    try:
+        run = await run_consensus(cluster, ConsensusConfig(
+            num_nodes=4, num_views=2, view_timeout_s=10.0,
+            base_latency_s=0.002, tail_latency_s=0.03, loss=0.2,
+            rto_s=0.01, seed=9))
+        assert run.completed == 2
+        # quorum formation waits on real shaped links: completion can't
+        # be faster than the base one-way latency
+        assert min(v.completion_s for v in run.views) >= 0.002
+    finally:
+        await cluster.stop()
+        trace_mod.set_log_path(prev)
+
+
+async def test_leader_rotates_per_view():
+    cluster = await Cluster(num_brokers=1).start()
+    try:
+        driver = ConsensusDriver(cluster, ConsensusConfig(num_nodes=3,
+                                                          num_views=4))
+        assert [driver.leader_of(v) for v in range(4)] == [0, 1, 2, 0]
+    finally:
+        await cluster.stop()

@@ -11,6 +11,7 @@ images), and the test self-skips where loopback TCP listeners are
 unavailable. Runtime ~15-25 s (the client echoes on a 1 s interval).
 """
 
+import json
 import os
 import socket
 import subprocess
@@ -20,6 +21,7 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "scripts", "local_cluster.py")
+CONSENSUS_BENCH = os.path.join(REPO, "benches", "consensus_bench.py")
 
 
 def _loopback_available() -> bool:
@@ -135,3 +137,82 @@ def test_local_cluster_sharded_broker(tmp_path):
     assert "0 orphaned spans" in out, out[-6000:]
     assert "drain readiness flip observed" in out, out[-6000:]
     assert "FAIL" not in out, out[-6000:]
+
+
+@pytest.mark.skipif(os.environ.get("PUSHCDN_SKIP_CLUSTER_TEST") == "1",
+                    reason="PUSHCDN_SKIP_CLUSTER_TEST=1")
+@pytest.mark.skipif(not _loopback_available(),
+                    reason="no loopback TCP in this sandbox")
+def test_local_cluster_chaos_broker_kill_smoke():
+    """ISSUE 11 (tier-1 smoke): ONE chaos event — SIGKILL the broker
+    serving the echo client — against real processes: the elastic client
+    re-load-balances through the marshal and echoes again, the survivor
+    logs the peer removal, and the respawned victim re-forms the mesh.
+    The full three-event suite is the ``slow``-marked test below."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--duration", "10", "--base-port", "0",
+         "--chaos", "--chaos-events", "broker"],
+        env=env, capture_output=True, text=True, timeout=240)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"chaos local_cluster failed:\n{out[-6000:]}"
+    assert "SIGKILL broker" in out, out[-6000:]
+    assert "echo resumed after" in out, out[-6000:]
+    assert "peer-loss correlation" in out, out[-6000:]
+    assert "mesh re-formed after" in out, out[-6000:]
+    assert "all chaos events rode out" in out, out[-6000:]
+    assert "FAIL" not in out, out[-6000:]
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(os.environ.get("PUSHCDN_SKIP_CLUSTER_TEST") == "1",
+                    reason="PUSHCDN_SKIP_CLUSTER_TEST=1")
+@pytest.mark.skipif(not _loopback_available(),
+                    reason="no loopback TCP in this sandbox")
+def test_local_cluster_chaos_full_suite():
+    """ISSUE 11 (slow tier): every scripted chaos event — broker SIGKILL,
+    marshal loss (control/data decoupling), and a discovery outage held
+    past the store's busy timeout (heartbeat failures land in the
+    supervised-task flight recorder; admissions refuse then recover)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--duration", "20", "--base-port", "0",
+         "--chaos"],
+        env=env, capture_output=True, text=True, timeout=400)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"chaos local_cluster failed:\n{out[-8000:]}"
+    assert "echo resumed after" in out, out[-8000:]
+    assert "new admissions refused while the marshal is down" in out
+    assert "established data plane kept echoing" in out, out[-8000:]
+    assert "new admissions refused during the discovery outage" in out
+    assert "admissions recovered after the discovery outage" in out
+    assert "heartbeat task-died event recorded" in out, out[-8000:]
+    assert "all chaos events rode out" in out, out[-8000:]
+    assert "FAIL" not in out, out[-8000:]
+
+
+@pytest.mark.skipif(os.environ.get("PUSHCDN_SKIP_CLUSTER_TEST") == "1",
+                    reason="PUSHCDN_SKIP_CLUSTER_TEST=1")
+def test_consensus_bench_quick_smoke():
+    """ISSUE 11: the consensus SLO bench's clean scenario in --quick mode
+    (in-process cluster, ~1 s): every view completes, the strict
+    per-view trace gate passes with zero orphans, and the SLO row
+    carries the percentile schema BENCH_r*.json records."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, CONSENSUS_BENCH, "--quick",
+         "--scenarios", "clean"],
+        env=env, capture_output=True, text=True, timeout=120)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"consensus_bench failed:\n{out[-4000:]}"
+    rows = [json.loads(line) for line in proc.stdout.splitlines()
+            if line.startswith("{")]
+    clean = next(r for r in rows if r.get("bench") == "consensus/clean")
+    assert clean["completed"] == clean["views"] and clean["timeouts"] == 0
+    assert clean["trace_strict_ok"] is True
+    assert clean["trace_orphaned_spans"] == 0
+    assert clean["view_completion_p99_ms"] > 0
+    assert clean["publish_delivery_p99_ms"] > 0

@@ -80,6 +80,48 @@ def test_decode_frames_handles_traced_mid_batch():
     assert bytes(out[1].message) == b"b"
 
 
+def test_view_tagged_codec_roundtrip():
+    # ISSUE 11: an optional u32 view tag rides the high bit of origin_ns
+    # (reserved: wall-clock ns stays below 2**63 until 2262). View-less
+    # traces keep the 16-byte block byte-for-byte.
+    tr3 = (0xDEADBEEF12345678, 1_700_000_000_000_000_000, 42)
+    tr2 = tr3[:2]
+    for mk in (lambda t: TracedBroadcast([3], b"p", t),
+               lambda t: TracedDirect(b"r", b"p", t)):
+        f3, f2 = serialize(mk(tr3)), serialize(mk(tr2))
+        assert len(f3) == len(f2) + 4
+        for dec in (deserialize, deserialize_owned):
+            assert dec(f3).trace == tr3
+            assert dec(f2).trace == tr2
+    # view 0 is a real view, distinct from "no view"
+    f0 = serialize(TracedBroadcast([3], b"p", (1, 2, 0)))
+    assert deserialize(f0).trace == (1, 2, 0)
+
+
+def test_view_tagged_stamp_strip_and_emit():
+    frame = serialize(Broadcast([5], b"q"))
+    tr = (99, 1_700_000_000_000_000_000, 7)
+    stamped = trace_mod.stamp_frame(frame, tr)
+    plain, got = trace_mod.strip_frame(stamped)
+    assert plain == frame and got == tr
+    trace_mod.emit("delivery", tr, "view-tag")
+    hop, tid, origin, _, detail = trace_mod.recent[-1]
+    assert (hop, tid, origin, detail) == ("delivery", 99, tr[1], "view-tag")
+
+
+def test_sampler_view_tags_sampled_traces():
+    s = trace_mod.Sampler(every=1)
+    assert len(s.next_trace()) == 2
+    s.view = 12
+    tr = s.next_trace()
+    assert len(tr) == 3 and tr[2] == 12
+    s.pending = 77  # forced post-connect trace carries the view too
+    tr = s.next_trace()
+    assert tr[0] == 77 and tr[2] == 12
+    s.view = None
+    assert len(s.next_trace()) == 2
+
+
 def test_truncated_trace_block_is_deserialize_error():
     import pytest
     frame = serialize(TracedBroadcast([0], b"p", (1, 2)))

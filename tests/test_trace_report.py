@@ -125,3 +125,116 @@ def test_cli_fails_without_any_complete_chain(tmp_path):
         capture_output=True, text=True, timeout=60)
     assert proc.returncode == 1
     assert "no complete chain" in proc.stderr
+
+
+# -- per-view aggregation (ISSUE 11) ------------------------------------
+
+
+def _view_span(tid, hop, origin_ns, at_ms, view):
+    s = _span(tid, hop, origin_ns, at_ms)
+    s["view"] = view
+    return s
+
+
+def _chain(tid, origin_ns, view, base_ms, hops=("publish", "ingress",
+                                                "plan", "egress",
+                                                "delivery")):
+    return [_view_span(tid, hop, origin_ns, base_ms + i * 0.2, view)
+            for i, hop in enumerate(hops)]
+
+
+def test_view_report_aggregates_completion_and_slowest(tmp_path):
+    origin = 1_700_000_000_000_000_000
+    spans = []
+    # view 0: two complete chains, slow (completion ~5ms)
+    spans += _chain(10, origin, 0, 0.1)
+    spans += _chain(11, origin, 0, 4.2)
+    # view 1: one complete chain, fast
+    spans += _chain(12, origin, 1, 0.1)
+    # untagged chain rides along and stays OUT of the view section
+    spans += _chain(13, origin, None, 0.1)[0:5]
+    for s in spans:
+        if s.get("view") is None:
+            s.pop("view", None)
+    _write(tmp_path / "s.jsonl", spans)
+    loaded, _ = trace_report.load_spans([str(tmp_path)])
+    vr = trace_report.build_view_report(loaded)
+    assert vr["views"] == 2
+    assert vr["stalled_views"] == 0
+    assert vr["incomplete_view_chains"] == 0
+    assert vr["per_view"][0]["chains"] == 2
+    assert vr["per_view"][0]["complete"] == 2
+    # slowest view is 0 (its last delivery lands latest)
+    assert vr["slowest_views"][0] == 0
+    assert vr["completion_ms"]["max"] >= vr["completion_ms"]["p50"]
+    # no tags at all -> no view section
+    assert trace_report.build_view_report(
+        [s for s in loaded if "view" not in s]) is None
+
+
+def test_view_strict_gate_catches_stall_and_orphan(tmp_path):
+    origin = 1_700_000_000_000_000_000
+    good = _chain(20, origin, 0, 0.1)
+    # view 1 stalled: publish happened, nothing ever delivered
+    stalled = [_view_span(21, "publish", origin, 0.1, 1),
+               _view_span(21, "ingress", origin, 0.3, 1)]
+    _write(tmp_path / "s.jsonl", good + stalled)
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--strict", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    # the chain-level orphan gate fires first; the stalled view is the
+    # same defect seen at view granularity
+    assert "orphaned" in proc.stderr or "stalled" in proc.stderr
+
+    # all chains complete but one view never delivers -> the VIEW gate
+    # is what fails
+    v0 = _chain(30, origin, 0, 0.1)
+    v1_publish_only = _chain(31, origin, 1, 0.1,
+                             hops=("publish", "ingress", "plan", "egress",
+                                   "delivery"))
+    # strip view 1's delivery span but keep the chain complete via an
+    # untagged delivery (same trace id, no view key): chain gate passes,
+    # stalled-view gate fires
+    for s in v1_publish_only:
+        if s["hop"] == "delivery":
+            s.pop("view")
+    _write(tmp_path / "s.jsonl", v0 + v1_publish_only)
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--strict", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert "stalled views" in proc.stderr or "incomplete view" in proc.stderr
+
+
+def test_view_report_renders_in_text_output(tmp_path):
+    origin = 1_700_000_000_000_000_000
+    _write(tmp_path / "s.jsonl",
+           _chain(40, origin, 0, 0.1) + _chain(41, origin, 1, 0.3))
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--strict", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "views: 2 tagged" in proc.stdout
+    assert "view completion ms" in proc.stdout
+
+
+def test_auth_only_connection_is_not_an_orphan(tmp_path):
+    origin = 1_700_000_000_000_000_000
+    spans = _chain(50, origin, None, 0.1)
+    for s in spans:
+        s.pop("view", None)
+    # a churny subscriber: authenticated, never published
+    spans.append(_span(51, "auth", origin, 0.8, detail="marshal-verify"))
+    _write(tmp_path / "s.jsonl", spans)
+    loaded, _ = trace_report.load_spans([str(tmp_path)])
+    report = trace_report.build_report(loaded)
+    assert report["complete_chains"] == 1
+    assert report["incomplete_chains"] == 0
+    assert report["orphaned_spans"] == 0
+    assert report["auth_only_chains"] == 1
+    # and the strict CLI gate passes
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--strict", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr

@@ -705,3 +705,61 @@ async def test_quic_ack_delay_keeps_rtt_honest():
         a.abort()
         if b is not None:
             b.abort()
+
+
+# -- geo-shaped memory links (ISSUE 11) ---------------------------------
+
+
+async def test_shaped_memory_adds_pipelined_latency_and_keeps_order():
+    from pushcdn_tpu.proto.transport.memory import LinkShape, shaped_memory
+
+    listener = await Memory.bind("shaped-lat")
+    try:
+        Shaped = shaped_memory(LinkShape(latency_s=0.03, seed=1))
+        connect = asyncio.create_task(Shaped.connect("shaped-lat"))
+        server = await (await listener.accept()).finalize()
+        client = await connect
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        for i in range(16):
+            await client.send_message(Direct(recipient=b"r",
+                                             message=b"m%d" % i))
+        msgs = [await server.recv_message() for _ in range(16)]
+        dt = loop.time() - t0
+        # ordered, and the burst pays the one-way latency once (pipelined),
+        # not per message
+        assert [bytes(m.message) for m in msgs] == \
+            [b"m%d" % i for i in range(16)]
+        assert 0.03 <= dt < 0.4, dt
+        client.close()
+        server.close()
+    finally:
+        await listener.close()
+
+
+async def test_shaped_memory_loss_is_deterministic_delay_not_corruption():
+    from pushcdn_tpu.proto.transport.memory import LinkShape, shaped_memory
+
+    listener = await Memory.bind("shaped-loss")
+    try:
+        # heavy loss: every chunk still arrives intact and in order (the
+        # reliable stream models loss as an RTO penalty, never a drop)
+        Shaped = shaped_memory(LinkShape(latency_s=0.001, loss=0.8,
+                                         rto_s=0.005, seed=42))
+        connect = asyncio.create_task(Shaped.connect("shaped-loss"))
+        server = await (await listener.accept()).finalize()
+        client = await connect
+        payloads = [bytes([i]) * (i + 1) for i in range(24)]
+        for p in payloads:
+            await client.send_message(Broadcast(topics=[0], message=p))
+        got = [bytes((await server.recv_message()).message)
+               for _ in range(24)]
+        assert got == payloads
+        # and the reverse direction is shaped too
+        await server.send_message(Direct(recipient=b"c", message=b"pong"))
+        back = await client.recv_message()
+        assert bytes(back.message) == b"pong"
+        client.close()
+        server.close()
+    finally:
+        await listener.close()
