@@ -1,0 +1,578 @@
+#!/usr/bin/env python
+"""Multi-broker swarm soak (ISSUE 12): elastic membership under load,
+measured against REAL OS processes over real TCP.
+
+Topology: SQLite discovery + marshal + 2 brokers, with a pack of worker
+processes (:mod:`pushcdn_tpu.testing.clientpack`) hosting the subscriber
+swarm and one in-bench publisher streaming per-topic sequence numbers.
+The run drives a full membership cycle while the stream is LIVE:
+
+    join (broker2 spawns) -> drain (operator GET /drain on the swarm's
+    home broker: every user actively re-homed via typed Migrate frames)
+    -> leave (drained broker exits) -> rejoin (fresh process, same
+    identity) -> reconnect storm (>=10K full marshal+broker reconnect
+    cycles from a separate client pool while the soak stream continues)
+
+Measured, written to ``BENCH_r<N>.json`` (section ``swarm_soak``) and
+gated by ``scripts/bench_series.py --gate``:
+
+- aggregate delivered/s before the cycle and during the storm;
+- re-home latency p50/p99 (client-observed: Migrate processed -> new
+  home live) and the orphan count after the grace window;
+- the elastic invariant, measured not assumed: zero delivered-message
+  gaps and zero reorders across every migrated subscriber (duplicates
+  during the two-home overlap are legal and reported separately);
+- storm connection count, rate, and connect-latency percentiles.
+
+The bench exits nonzero if any invariant fails (lost/reordered
+deliveries, <99% of users re-homed inside the grace window, or an
+orphaned user) — it is the live acceptance for the elastic tentpole.
+
+    python benches/swarm_bench.py --quick          # CI-sized (~1 min)
+    python benches/swarm_bench.py                  # full soak, 10K storm
+    python benches/swarm_bench.py --json BENCH_r14.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pushcdn_tpu.bin.common import spawn_binary  # noqa: E402
+
+DRAIN_GRACE_S = 2.0
+
+
+def log(msg: str) -> None:
+    print(f"[swarm] {msg}", flush=True)
+
+
+def http_get_json(port: int, path: str, timeout: float = 10.0):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except (urllib.error.URLError, OSError, ValueError, TimeoutError):
+        return None
+
+
+def wait_ready(port: int, wait_s: float = 20.0) -> bool:
+    deadline = time.time() + wait_s
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=1.0) as resp:
+                if resp.status == 200:
+                    return True
+        except urllib.error.HTTPError:
+            pass
+        except (urllib.error.URLError, OSError, TimeoutError):
+            pass
+        time.sleep(0.1)
+    return False
+
+
+def pick_base_port() -> int:
+    while True:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            candidate = s.getsockname()[1]
+        if candidate <= 65000 - 200:
+            return candidate
+
+
+def _pctile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class Pack:
+    """A clientpack worker process: JSON-line events in a reader thread,
+    single-word commands down stdin."""
+
+    def __init__(self, name: str, argv: list, logdir: str):
+        self.name = name
+        self.events: list = []
+        self._cond = threading.Condition()
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (REPO + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else REPO)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self._errlog = open(os.path.join(logdir, f"{name}.log"), "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "pushcdn_tpu.testing.clientpack", *argv],
+            env=env, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=self._errlog, text=True)
+        self._reader = threading.Thread(target=self._read, daemon=True)
+        self._reader.start()
+
+    def _read(self):
+        for line in self.proc.stdout:
+            try:
+                event = json.loads(line)
+            except ValueError:
+                continue
+            with self._cond:
+                self.events.append(event)
+                self._cond.notify_all()
+
+    def send(self, cmd: str) -> None:
+        try:
+            self.proc.stdin.write(cmd + "\n")
+            self.proc.stdin.flush()
+        except (OSError, ValueError):
+            pass
+
+    def wait_event(self, kind: str, timeout: float, after: int = 0):
+        """First event of ``kind`` at index >= after, or None."""
+        deadline = time.time() + timeout
+        with self._cond:
+            while True:
+                for i in range(after, len(self.events)):
+                    if self.events[i].get("event") == kind:
+                        return self.events[i]
+                left = deadline - time.time()
+                if left <= 0:
+                    return None
+                self._cond.wait(min(left, 0.5))
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+        self._errlog.close()
+
+
+def mark_all(packs: list, timeout: float = 30.0):
+    """Synchronized snapshot across every soak worker: send ``mark``,
+    collect one fresh ``mark`` reply each, and merge."""
+    starts = [len(p.events) for p in packs]
+    for p in packs:
+        p.send("mark")
+    merged = {"clients": 0, "live": 0, "rehomed": 0, "delivered": 0,
+              "unique": 0, "gaps": 0, "reorders": 0, "hard_reconnects": 0,
+              "rehome_ms": []}
+    for p, start in zip(packs, starts):
+        ev = p.wait_event("mark", timeout, after=start)
+        if ev is None:
+            raise RuntimeError(f"worker {p.name} never answered mark")
+        for k in merged:
+            merged[k] += ev[k]
+    merged["rehome_ms"].sort()
+    return merged
+
+
+async def publisher_loop(client, topics: int, interval_s: float,
+                         seqs: list, stop: asyncio.Event) -> None:
+    """Round-robin per-topic sequence stream; a send error retries the
+    SAME seq (at-least-once — receivers dedup), so a migration or broker
+    exit under the publisher never silently skips a number."""
+    from pushcdn_tpu.proto.error import Error
+    tick = 0
+    while not stop.is_set():
+        topic = tick % topics
+        payload = seqs[topic].to_bytes(4, "big") + b"swarm"
+        try:
+            await client.send_broadcast_message([topic], payload)
+        except Error:
+            await asyncio.sleep(0.2)
+            continue  # retry the same seq
+        seqs[topic] += 1
+        tick += 1
+        await asyncio.sleep(interval_s)
+
+
+async def publisher_drain(client, stop: asyncio.Event) -> None:
+    """Keep the publisher's inbound side serviced so a Migrate from a
+    draining broker is processed promptly (make-before-break re-home)."""
+    from pushcdn_tpu.proto.error import Error
+    while not stop.is_set():
+        try:
+            await client.receive_messages()
+        except asyncio.CancelledError:
+            raise
+        except Error:
+            await asyncio.sleep(0.2)
+
+
+def find_home(broker_metrics: dict, key: bytes):
+    """Which broker homes the user with this public key (by the
+    /debug/topology mnemonic)? Returns the broker name or None."""
+    from pushcdn_tpu.proto.util import mnemonic
+    wanted = mnemonic(key)
+    for name, port in broker_metrics.items():
+        topo = http_get_json(port, "/debug/topology")
+        if topo and any(u["key"] == wanted for u in topo["users"]):
+            return name
+    return None
+
+
+async def amain(args) -> int:
+    from pushcdn_tpu.client.client import Client, ClientConfig
+    from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME
+    from pushcdn_tpu.proto.transport import Tcp
+    from pushcdn_tpu.testing.provenance import provenance
+
+    logdir = tempfile.mkdtemp(prefix="pushcdn-swarm-")
+    db = os.path.join(logdir, "cdn.sqlite")
+    bp = args.base_port or pick_base_port()
+    metrics = {"broker0": bp + 100, "broker1": bp + 120,
+               "broker2": bp + 160, "marshal": bp + 140}
+    marshal_ep = f"127.0.0.1:{bp + 50}"
+    procs: dict = {}
+
+    def spawn_broker(i: int):
+        return spawn_binary(
+            "broker",
+            "--discovery-endpoint", db,
+            "--public-advertise-endpoint", f"127.0.0.1:{bp + i * 2}",
+            "--public-bind-endpoint", f"127.0.0.1:{bp + i * 2}",
+            "--private-advertise-endpoint", f"127.0.0.1:{bp + i * 2 + 1}",
+            "--private-bind-endpoint", f"127.0.0.1:{bp + i * 2 + 1}",
+            "--user-transport", "tcp",
+            "--metrics-bind-endpoint", f"127.0.0.1:{metrics[f'broker{i}']}",
+            # fast membership so join/leave/rejoin are observable in
+            # bench time (and a drained broker ages out of placement)
+            "--heartbeat-interval", "1", "--membership-ttl", "5",
+            env_extra={"PUSHCDN_DRAIN_GRACE_S": str(DRAIN_GRACE_S),
+                       "JAX_PLATFORMS": "cpu"},
+            log_path=os.path.join(logdir, f"broker{i}.log"))
+
+    packs: list = []
+    publisher = None
+    stop_pub = asyncio.Event()
+    # broker2 joins LATE, after thousands of client sockets have pulled
+    # ephemeral ports — hold placeholder binds on its endpoints until
+    # spawn time or the join races an ephemeral allocation (seen live:
+    # bind EADDRINUSE on the private endpoint)
+    reserved = []
+    for port in (bp + 4, bp + 5, metrics["broker2"]):
+        s_ = socket.socket()
+        s_.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s_.bind(("127.0.0.1", port))
+        reserved.append(s_)
+    try:
+        procs["broker0"] = spawn_broker(0)
+        procs["broker1"] = spawn_broker(1)
+        procs["marshal"] = spawn_binary(
+            "marshal",
+            "--discovery-endpoint", db,
+            "--bind-endpoint", marshal_ep,
+            "--metrics-bind-endpoint", f"127.0.0.1:{metrics['marshal']}",
+            "--user-transport", "tcp",
+            env_extra={"JAX_PLATFORMS": "cpu"},
+            log_path=os.path.join(logdir, "marshal.log"))
+        for name in ("broker0", "broker1", "marshal"):
+            if not await asyncio.to_thread(wait_ready, metrics[name]):
+                log(f"FAIL: {name} never became ready")
+                return 1
+        log(f"cluster up (logs under {logdir})")
+
+        # publisher FIRST: it lands within the first 256 /debug/topology
+        # rows of its home broker, so the drain can target the OTHER
+        # broker (the swarm, not the publisher, is what we migrate)
+        publisher = Client(ClientConfig(
+            marshal_endpoint=marshal_ep,
+            keypair=DEFAULT_SCHEME.generate_keypair(seed=777_001),
+            protocol=Tcp))
+        await asyncio.wait_for(publisher.ensure_initialized(), 20.0)
+
+        per_worker = args.soak_clients // args.workers
+        for w in range(args.workers):
+            packs.append(Pack(f"soak{w}", [
+                "--marshal-endpoint", marshal_ep, "--mode", "soak",
+                "--clients", str(per_worker),
+                "--seed-base", str(90_000 + w * 10_000),
+                "--topics", str(args.topics),
+                "--settle-s", str(args.settle_s)], logdir))
+        total_clients = per_worker * args.workers
+        for p in packs:
+            if await asyncio.to_thread(
+                    p.wait_event, "ready", args.connect_wait_s) is None:
+                log(f"FAIL: {p.name} never finished connecting")
+                return 1
+        log(f"packs ready ({total_clients} subscribers across "
+            f"{args.workers} worker processes)")
+
+        seqs = [0] * args.topics
+        pub_task = asyncio.create_task(publisher_loop(
+            publisher, args.topics, 1.0 / args.publish_rate, seqs, stop_pub))
+        pub_drain = asyncio.create_task(publisher_drain(publisher, stop_pub))
+
+        # ---- baseline delivered/s ----
+        await asyncio.sleep(2.0)  # interest propagation + first deliveries
+        m0, t0 = await asyncio.to_thread(mark_all, packs), time.monotonic()
+        await asyncio.sleep(args.baseline_s)
+        m1, t1 = await asyncio.to_thread(mark_all, packs), time.monotonic()
+        delivered_per_s = (m1["delivered"] - m0["delivered"]) / (t1 - t0)
+        log(f"baseline delivered/s: {delivered_per_s:.0f} "
+            f"({total_clients} subscribers, {args.publish_rate}/s published)")
+
+        # ---- JOIN: a third broker enters the mesh ----
+        for s_ in reserved:
+            s_.close()
+        reserved.clear()
+        procs["broker2"] = spawn_broker(2)
+        if not await asyncio.to_thread(wait_ready, metrics["broker2"]):
+            log("FAIL: joining broker2 never became ready")
+            return 1
+        log("join OK (broker2 in placement rotation)")
+
+        # ---- DRAIN: operator /drain on the swarm's home broker ----
+        pub_home = await asyncio.to_thread(
+            find_home, {"broker0": metrics["broker0"],
+                        "broker1": metrics["broker1"]},
+            publisher.public_key) or "broker1"
+        target = "broker0" if pub_home != "broker0" else "broker1"
+        before = await asyncio.to_thread(
+            http_get_json, metrics[target], "/debug/topology")
+        users_before = before["num_users"] if before else -1
+        t_drain = time.monotonic()
+        # the stream stays LIVE through the drain: the HTTP call runs
+        # in a thread so the publisher keeps ticking mid-migration
+        summary = await asyncio.to_thread(
+            http_get_json, metrics[target], "/drain", args.grace_s)
+        if summary is None:
+            log(f"FAIL: {target} /drain did not answer")
+            return 1
+        log(f"drain summary from {target}: {summary} "
+            f"(had {users_before} users)")
+
+        # grace window: every signaled user back live on a new home
+        deadline = time.monotonic() + args.grace_s
+        final = None
+        while time.monotonic() < deadline:
+            snap = await asyncio.to_thread(mark_all, packs)
+            topo = await asyncio.to_thread(
+                http_get_json, metrics[target], "/debug/topology")
+            drained_empty = bool(topo) and topo["num_users"] == 0
+            if snap["live"] == total_clients \
+                    and snap["rehomed"] >= summary["signaled"] \
+                    and drained_empty:
+                final = snap
+                break
+            await asyncio.sleep(1.0)
+        if final is None:
+            final = await asyncio.to_thread(mark_all, packs)
+        rehome_s = time.monotonic() - t_drain
+        rehomed_pct = (100.0 * final["rehomed"] / max(summary["signaled"], 1))
+        orphans = total_clients - final["live"]
+        p50 = _pctile(final["rehome_ms"], 0.50) or 0.0
+        p99 = _pctile(final["rehome_ms"], 0.99) or 0.0
+        log(f"rehome OK: {final['rehomed']}/{summary['signaled']} re-homed "
+            f"in {rehome_s:.1f}s (p50 {p50:.0f}ms p99 {p99:.0f}ms), "
+            f"orphans {orphans}" if orphans == 0 and rehomed_pct >= 99.0
+            else f"rehome DEGRADED: {final['rehomed']}/{summary['signaled']} "
+                 f"re-homed, {orphans} orphans after {args.grace_s}s grace")
+
+        # ---- LEAVE: the drained broker exits cleanly ----
+        procs[target].send_signal(signal.SIGINT)
+        try:
+            await asyncio.to_thread(procs[target].wait,
+                                    DRAIN_GRACE_S + 10.0)
+            log(f"leave OK ({target} exited {procs[target].returncode})")
+        except subprocess.TimeoutExpired:
+            log(f"FAIL: {target} did not exit after SIGINT")
+            return 1
+
+        # ---- REJOIN: fresh process, same identity/endpoints ----
+        procs[target] = spawn_broker(int(target[-1]))
+        if not await asyncio.to_thread(wait_ready, metrics[target]):
+            log(f"FAIL: {target} rejoin never became ready")
+            return 1
+        log(f"rejoin OK ({target} back in rotation)")
+
+        # ---- RECONNECT STORM while the soak stream continues ----
+        storm_packs = []
+        per_storm = args.storm_connections // args.workers
+        storm_pool = max(args.storm_clients // args.workers, 1)
+        s0, st0 = await asyncio.to_thread(mark_all, packs), time.monotonic()
+        for w in range(args.workers):
+            storm_packs.append(Pack(f"storm{w}", [
+                "--marshal-endpoint", marshal_ep, "--mode", "storm",
+                "--clients", str(storm_pool),
+                "--seed-base", str(200_000 + w * 10_000),
+                "--storm-connections", str(per_storm),
+                "--connect-concurrency", str(args.storm_concurrency)],
+                logdir))
+        storm = {"established": 0, "attempts": 0, "sheds": 0}
+        conn_p99s = []
+        for p in storm_packs:
+            res = await asyncio.to_thread(
+                p.wait_event, "result", args.storm_wait_s)
+            if res is None:
+                log(f"FAIL: storm worker {p.name} never finished")
+                return 1
+            storm["established"] += res["established"]
+            storm["attempts"] += res["attempts"]
+            storm["sheds"] += res["sheds"]
+            conn_p99s.append(res["conn_p99_ms"])
+        storm_s = time.monotonic() - st0
+        s1 = await asyncio.to_thread(mark_all, packs)
+        storm_delivered_per_s = (s1["delivered"] - s0["delivered"]) / (
+            time.monotonic() - st0)
+        log(f"storm OK: {storm['established']} real reconnects in "
+            f"{storm_s:.1f}s ({storm['established'] / storm_s:.0f}/s, "
+            f"{storm['attempts']} attempts, {storm['sheds']} sheds, "
+            f"conn p99 {max(conn_p99s):.0f}ms); soak stream held "
+            f"{storm_delivered_per_s:.0f} delivered/s")
+
+        # ---- wrap up: stop the stream, settle, collect ----
+        stop_pub.set()
+        pub_task.cancel()
+        pub_drain.cancel()
+        await asyncio.gather(pub_task, pub_drain, return_exceptions=True)
+        for p in packs:
+            p.send("finish")
+        results = []
+        for p in packs:
+            res = await asyncio.to_thread(p.wait_event, "result", 60.0)
+            if res is None:
+                log(f"FAIL: soak worker {p.name} never reported")
+                return 1
+            results.append(res)
+        gaps = sum(r["gaps"] for r in results)
+        reorders = sum(r["reorders"] for r in results)
+        hard = sum(r["hard_reconnects"] for r in results)
+        delivered_total = sum(r["delivered"] for r in results)
+        unique_total = sum(r["unique"] for r in results)
+        dups = delivered_total - unique_total
+        log(f"loss check: gaps {gaps}, reorders {reorders}, "
+            f"duplicates {dups} (legal), hard reconnects {hard}, "
+            f"{delivered_total} delivered / {sum(seqs)} published")
+
+        ok = (gaps == 0 and reorders == 0 and orphans == 0
+              and rehomed_pct >= 99.0)
+        headline = {
+            "soak_users": total_clients,
+            "delivered_per_s": round(delivered_per_s, 1),
+            "storm_delivered_per_s": round(storm_delivered_per_s, 1),
+            "rehome_p50_ms": round(p50, 1),
+            "rehome_p99_ms": round(p99, 1),
+            "rehomed_pct": round(rehomed_pct, 2),
+            "orphans": orphans,
+            "loss_gaps": gaps,
+            "reorder_violations": reorders,
+            "storm_reconnects": storm["established"],
+            "storm_conns_per_s": round(storm["established"] / storm_s, 1),
+            "storm_conn_p99_ms": round(max(conn_p99s), 1),
+        }
+        rows = [{"phase": "baseline", "delivered_per_s":
+                 round(delivered_per_s, 1)},
+                {"phase": "drain", "target": target,
+                 "signaled": summary["signaled"],
+                 "orphaned_by_broker": summary["orphaned"],
+                 "rehomed": final["rehomed"],
+                 "rehome_window_s": round(rehome_s, 1),
+                 "rehome_ms_count": len(final["rehome_ms"])},
+                {"phase": "storm", **{k: v for k, v in storm.items()
+                                      if k != "conn_ms"},
+                 "duration_s": round(storm_s, 1)},
+                {"phase": "wrapup", "delivered_total": delivered_total,
+                 "published_total": sum(seqs), "duplicates": dups,
+                 "hard_reconnects": hard}]
+        if args.json:
+            path = os.path.join(REPO, args.json) \
+                if not os.path.isabs(args.json) else args.json
+            doc = {"round": args.round}
+            if os.path.exists(path):
+                try:
+                    with open(path) as fh:
+                        doc = json.load(fh)
+                except (OSError, ValueError):
+                    pass
+            doc["swarm_soak"] = {"headline": headline, "rows": rows,
+                                 "provenance": provenance()}
+            with open(path, "w") as fh:
+                json.dump(doc, fh, indent=1)
+                fh.write("\n")
+            log(f"wrote {path}")
+        if not ok:
+            log("FAIL: elastic invariant violated (see above)")
+            return 1
+        log("OK: join -> drain -> leave -> rejoin -> storm, "
+            "zero loss, zero reorders, zero orphans")
+        return 0
+    finally:
+        for s_ in reserved:
+            s_.close()
+        stop_pub.set()
+        if publisher is not None:
+            publisher.close()
+        for p in packs:
+            p.stop()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGINT)
+        deadline = time.time() + DRAIN_GRACE_S + 5.0
+        while time.time() < deadline and any(
+                p.poll() is None for p in procs.values()):
+            time.sleep(0.1)
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized run (~1-2 min): small swarm, 200-cycle "
+                         "storm")
+    ap.add_argument("--soak-clients", type=int, default=None)
+    ap.add_argument("--workers", type=int, default=None)
+    ap.add_argument("--topics", type=int, default=8)
+    ap.add_argument("--publish-rate", type=float, default=None,
+                    help="broadcasts/s across all topics")
+    ap.add_argument("--storm-connections", type=int, default=None,
+                    help="total reconnect cycles across storm workers")
+    ap.add_argument("--storm-clients", type=int, default=None,
+                    help="distinct users in the storm pool")
+    ap.add_argument("--storm-concurrency", type=int, default=25,
+                    help="in-flight dials per storm worker")
+    ap.add_argument("--baseline-s", type=float, default=None)
+    ap.add_argument("--grace-s", type=float, default=None,
+                    help="re-home grace window")
+    ap.add_argument("--connect-wait-s", type=float, default=None)
+    ap.add_argument("--storm-wait-s", type=float, default=None)
+    ap.add_argument("--settle-s", type=float, default=2.0)
+    ap.add_argument("--base-port", type=int, default=0)
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="merge the swarm_soak section into this "
+                         "BENCH_r*.json (relative to the repo root)")
+    ap.add_argument("--round", type=int, default=14)
+    args = ap.parse_args()
+
+    defaults = {
+        # full soak: ~1K live subscribers, >=10K-connection storm
+        False: dict(soak_clients=1000, workers=4, publish_rate=16.0,
+                    storm_connections=10_000, storm_clients=2000,
+                    baseline_s=10.0, grace_s=90.0, connect_wait_s=240.0,
+                    storm_wait_s=480.0),
+        True: dict(soak_clients=60, workers=2, publish_rate=20.0,
+                   storm_connections=200, storm_clients=40,
+                   baseline_s=5.0, grace_s=45.0, connect_wait_s=90.0,
+                   storm_wait_s=180.0),
+    }[args.quick]
+    for key, val in defaults.items():
+        if getattr(args, key) is None:
+            setattr(args, key, val)
+    return asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

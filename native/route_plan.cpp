@@ -538,7 +538,16 @@ int64_t pushcdn_route_plan(
                                      fnv1a(key, (int32_t)rlen));
       if (slot < 0) continue;  // unknown recipient: drop
       const int32_t peer = t->dmap[slot].peer;
-      if (mode == 1 && peer >= t->n_users) continue;  // to_user_only
+      if (mode == 1 && peer >= t->n_users) {
+        // Broker-origin direct whose DirectMap owner is another broker:
+        // the one-hop rule forbids re-forwarding, but the frame may
+        // still be deliverable over a local `parting` connection (a
+        // migration eviction raced the sender's stale DirectMap
+        // replica). Rare by construction — hand it to the scalar path
+        // (which chases parting) instead of silently dropping.
+        *stop_reason = 1;
+        break;
+      }
       if (pairs == pair_cap) { *stop_reason = 2; break; }
       out_peer[pairs] = peer;
       out_frame[pairs] = (int32_t)i;

@@ -306,6 +306,16 @@ async def amain(args: argparse.Namespace) -> None:
                            return_when=asyncio.FIRST_COMPLETED)
         if drain.is_set():
             broker.begin_drain("signal")
+            # elastic drain (ISSUE 12): actively re-home every connected
+            # user to the surviving brokers before the grace sleep — the
+            # UserSync evictions land while we're still serving
+            try:
+                from pushcdn_tpu.broker import rehome as rehome_mod
+                await rehome_mod.rehome_users(broker)
+            except Exception as exc:
+                import logging
+                logging.getLogger("pushcdn.broker").warning(
+                    "drain re-home failed: %r", exc)
             await asyncio.sleep(drain_grace_s())
             run_task.cancel()
             await asyncio.gather(run_task, return_exceptions=True)

@@ -103,8 +103,12 @@ class AdmissionControl:
             return None
         if self.broker.connections.num_users < self.max_user_conns:
             return None
+        # retry-after rides the context as a typed hint (ISSUE 12): the
+        # readiness window is exactly how long the balancer steers away,
+        # so it is the honest earliest-useful-retry estimate
         reason = (f"shed: user connection budget {self.max_user_conns} "
-                  f"reached (PUSHCDN_MAX_CONNS_USER)")
+                  f"reached (PUSHCDN_MAX_CONNS_USER); "
+                  f"retry-after={self.ready_window_s:g}")
         self._note_shed("user_conn", reason, None,
                         metrics_mod.ROUTE_SHED_USER_CONN)
         return reason
@@ -115,7 +119,8 @@ class AdmissionControl:
         if self.broker.connections.num_brokers < self.max_broker_conns:
             return None
         reason = (f"shed: broker link budget {self.max_broker_conns} "
-                  f"reached (PUSHCDN_MAX_CONNS_BROKER)")
+                  f"reached (PUSHCDN_MAX_CONNS_BROKER); "
+                  f"retry-after={self.ready_window_s:g}")
         self._note_shed("broker_conn", reason, None,
                         metrics_mod.ROUTE_SHED_BROKER_CONN)
         return reason

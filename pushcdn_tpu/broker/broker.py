@@ -132,6 +132,10 @@ class Broker:
         self._discovery_probe: tuple = (False, "not probed yet")
         self._discovery_probe_at: Optional[float] = None
         self.last_peer_count: Optional[int] = None
+        # elastic membership (ISSUE 12): set by begin_drain; the heartbeat
+        # task checks it to deregister instead of re-advertising, and the
+        # re-homer refuses to run twice
+        self.draining = False
 
     @classmethod
     async def new(cls, config: BrokerConfig) -> "Broker":
@@ -234,11 +238,13 @@ class Broker:
         health_mod.register_readiness("admission", self._check_admission)
         metrics_mod.register_debug_route("/debug/topology",
                                          self._topology_route)
+        metrics_mod.register_debug_route("/drain", self._drain_route)
 
     def unregister_observability(self) -> None:
         for name in ("listeners", "discovery", "mesh", "admission"):
             health_mod.unregister(name)
         metrics_mod.unregister_debug_route("/debug/topology")
+        metrics_mod.unregister_debug_route("/drain")
 
     def _check_listeners(self):
         if not self.listeners_bound:
@@ -301,7 +307,21 @@ class Broker:
         """Flip /readyz to 503 (and record the ready-flip flight-recorder
         event) BEFORE any listener closes — the load balancer stops
         routing here while in-flight traffic still drains."""
+        self.draining = True
         health_mod.set_draining(reason)
+
+    async def _drain_route(self, params: dict) -> dict:
+        """``GET /drain``: operator-triggered elastic drain (ISSUE 12) —
+        same sequence SIGTERM runs: flip /readyz, leave discovery, then
+        actively re-home every connected user to the live peers. Returns
+        the re-home summary so the operator sees migrated/orphaned counts
+        without tailing logs."""
+        from pushcdn_tpu.broker import rehome as rehome_mod
+        already = self.draining
+        self.begin_drain("operator /drain")
+        summary = await rehome_mod.rehome_users(self)
+        summary["was_draining"] = already
+        return summary
 
     def _topology_route(self, params: dict) -> dict:
         return self.topology_snapshot()

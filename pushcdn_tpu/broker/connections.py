@@ -24,6 +24,7 @@ canonical "pub/priv" form) inside CRDT payloads so the codec stays scalar.
 
 from __future__ import annotations
 
+import asyncio
 import enum
 import logging
 import os
@@ -40,6 +41,18 @@ logger = logging.getLogger("pushcdn.broker")
 
 UserPublicKey = bytes
 Topic = int
+
+# How long a migration-evicted user's connection stays in ``parting``
+# (sendable for already-routed deliveries AND for late directs that
+# raced the eviction — see route_direct's parting chase) before the
+# deferred flush-and-FIN. Must cover the UserSync propagation skew
+# between mesh peers: a publisher's broker keeps forwarding to the old
+# home until the out-versioned DirectMap row reaches it, which under
+# load can lag by hundreds of ms. Kept just under the client's own
+# drain backstop (PUSHCDN_MIGRATE_DRAIN_S, default 2 s) so the broker
+# FINs first and the client's drain ends on EOF, not on its timer.
+PARTING_GRACE_S = float(os.environ.get("PUSHCDN_PARTING_GRACE_S",
+                                       "1.5") or 1.5)
 
 
 class SubscriptionStatus(enum.IntEnum):
@@ -83,6 +96,14 @@ class Connections:
         self.observer = None
         self.users: Dict[UserPublicKey, UserHandle] = {}
         self.brokers: Dict[str, BrokerHandle] = {}
+        # Migration evictions (ISSUE 12): a user whose UserSync merge says
+        # it now lives elsewhere leaves ``users`` immediately (routing must
+        # follow the new owner) but its connection lingers here so
+        # deliveries ALREADY routed to it — this very batch's egress, a
+        # sibling shard's in-flight ring record — still flush to the old
+        # connection the client is draining. A deferred soft_close empties
+        # the writer, FINs, and drops the entry after PARTING_GRACE_S.
+        self.parting: Dict[UserPublicKey, Connection] = {}
         # user → owning-broker CRDT (DirectMap, connections/direct/mod.rs:14)
         self.direct_map: VersionedMap = VersionedMap(local_identity=identity)
         # topic interest indexes (BroadcastMap, broadcast/mod.rs:19-55)
@@ -157,11 +178,18 @@ class Connections:
         # ``user`` delta below makes the old shard evict its stale conn
         if self.remote_user_shard.pop(public_key, None) is not None:
             self.user_topics.remove_key(public_key)
+        # elastic re-home arrival (ISSUE 12): the DirectMap still naming
+        # ANOTHER broker as owner means this user just migrated here — the
+        # insert below out-versions that claim, and the next UserSync delta
+        # makes the old home evict its half of the connection
+        prev_owner = self.direct_map.get(public_key)
         self.interest_version += 1
         self.users[public_key] = UserHandle(connection, abort_handle)
         if topics:
             self.user_topics.associate_key_with_values(public_key, topics)
         self.direct_map.insert(public_key, self.identity)
+        if prev_owner is not None and prev_owner != self.identity:
+            connection.flightrec.record("migrate-in", f"from {prev_owner}")
         self._log_route("user", public_key)
         self._log_route("dmap", public_key)
         if self.observer is not None:
@@ -174,13 +202,25 @@ class Connections:
         handle = self.users.pop(public_key, None)
         if handle is None:
             return
-        self._teardown(handle, reason)
         self.interest_version += 1
-        self.user_topics.remove_key(public_key)
+        if reason == "user connected elsewhere":
+            # elastic re-home (ISSUE 12): flush-then-close, never abort —
+            # the client is still draining this connection. The interest
+            # rows survive until the parting grace expires (``_part``
+            # returns True and owns the deferred cleanup), so LATE
+            # broadcasts — routed here by peers whose TopicSync view of
+            # the new home still lags — chase the parting connection
+            # instead of dropping into a zero-home window.
+            deferred = self._part(public_key, handle)
+        else:
+            self._teardown(handle, reason)
+            deferred = False
+        if not deferred:
+            self.user_topics.remove_key(public_key)
+            self._log_route("user", public_key)
         # Release our DirectMap claim only if we still hold it — a newer
         # claim by another broker must not be clobbered.
         self.direct_map.remove_if_equals(public_key, self.identity)
-        self._log_route("user", public_key)
         self._log_route("dmap", public_key)
         if self.observer is not None:
             self.observer.on_user_removed(public_key)
@@ -192,7 +232,61 @@ class Connections:
 
     def get_user_connection(self, public_key: UserPublicKey) -> Optional[Connection]:
         h = self.users.get(public_key)
-        return None if h is None else h.connection
+        if h is not None:
+            return h.connection
+        # send-time fallback for deliveries routed before a migration
+        # eviction landed mid-batch (see ``parting``); new routing
+        # decisions never reach here — the interest indexes and the
+        # DirectMap already point at the new home
+        return self.parting.get(public_key)
+
+    def _part(self, public_key: UserPublicKey, handle) -> bool:
+        """Move a migration-evicted user's connection into ``parting``:
+        the receive loop is aborted now (nothing further is accepted from
+        the old connection), queued deliveries keep flushing to it, and a
+        deferred ``soft_close`` drains the writer, FINs, and forgets the
+        entry. Without this the egress batch that carried the eviction's
+        own UserSync drops every delivery it had already routed to the
+        user — a real delivered-message loss window under migration.
+
+        Returns True when the deferred close task was scheduled and owns
+        the user's interest-row cleanup (the rows stay live through the
+        grace so late-routed broadcasts still reach the parting
+        connection); False when everything was torn down synchronously
+        and the caller must clean up now."""
+        rec = getattr(handle.connection, "flightrec", None)
+        if rec is not None:
+            # routine under elastic drain — recorded, not dumped
+            rec.record("removed", "user connected elsewhere (parting)")
+        if handle.abort_handle is not None:
+            handle.abort_handle.abort()
+        conn = handle.connection
+        self.parting[public_key] = conn
+
+        async def _close_later():
+            try:
+                await asyncio.sleep(PARTING_GRACE_S)
+                await conn.soft_close()
+            finally:
+                if self.parting.get(public_key) is conn:
+                    del self.parting[public_key]
+                    # deferred interest cleanup (see remove_user): the
+                    # grace is over — unless the user reconnected HERE
+                    # meanwhile (their rows are live again), drop them.
+                    # A superseding _part re-entered via the dict guard
+                    # above owns its own cleanup.
+                    if public_key not in self.users:
+                        self.interest_version += 1
+                        self.user_topics.remove_key(public_key)
+                        self._log_route("user", public_key)
+
+        try:
+            asyncio.get_running_loop().create_task(_close_later())
+        except RuntimeError:  # no loop (teardown from sync context)
+            self.parting.pop(public_key, None)
+            conn.close()
+            return False
+        return True
 
     @property
     def num_users(self) -> int:
@@ -514,3 +608,6 @@ class Connections:
             self.remove_user(key, "broker shutdown")
         for ident in list(self.brokers):
             self.remove_broker(ident, "broker shutdown")
+        for conn in self.parting.values():
+            conn.close()  # shutdown outruns the deferred soft_close
+        self.parting.clear()

@@ -314,6 +314,10 @@ class RouteState:
         not rebuild per connection."""
         conns = self.broker.connections
         local_users = list(conns.users.keys())
+        # parting users keep their interest rows through the migration
+        # grace (late-broadcast chase, see Connections.remove_user) —
+        # keep their slots plannable across a rebuild too
+        local_users += [k for k in conns.parting if k not in conns.users]
         remote_users = list(conns.remote_user_shard.keys())
         users = local_users + remote_users
         local_brokers = list(conns.brokers.keys())

@@ -296,7 +296,14 @@ def route_direct(broker: "Broker", recipient: bytes, raw: Bytes,
         owner = conns.get_broker_identifier_of_user(recipient)
         if owner is not None and owner != conns.identity:
             if to_user_only:
-                return  # one-hop rule: never re-forward
+                # one-hop rule: never re-forward. But a forwarded direct
+                # that raced a migration eviction here (the sender's
+                # DirectMap replica hadn't caught up yet) can still reach
+                # the user over the ``parting`` connection the client is
+                # draining — chasing it beats a silent delivered-loss.
+                if recipient in conns.parting:
+                    egress.to_user(recipient, raw)
+                return
             if owner in conns.brokers:
                 egress.to_broker(owner, raw)
             else:
@@ -314,16 +321,30 @@ def route_direct(broker: "Broker", recipient: bytes, raw: Bytes,
         shard = conns.remote_user_shard.get(recipient)
         if shard is not None:
             egress.to_shard(shard, shardring.KIND_USER, recipient, raw)
+            return
+        if recipient in conns.parting:  # evicted mid-flight: chase
+            egress.to_user(recipient, raw)
         return  # unknown/stale user: drop
     owner = conns.get_broker_identifier_of_user(recipient)
-    if owner is None:
-        return  # unknown user: drop
     if owner == conns.identity:
         egress.to_user(recipient, raw)
+    elif owner is None:
+        # unknown user: drop — unless the old connection is still
+        # parting after an eviction (the row may be gone entirely when
+        # the user disconnected elsewhere before this frame landed)
+        if recipient in conns.parting:
+            egress.to_user(recipient, raw)
     elif not to_user_only:
         # forward one hop to the owning broker; the remote end delivers
         # with to_user_only=True so it can never bounce back
         egress.to_broker(owner, raw)
+    else:
+        # one-hop rule: never re-forward. A forwarded direct that raced
+        # the migration eviction (sender's DirectMap replica was behind)
+        # still reaches the user over the ``parting`` connection the
+        # client is draining — chasing it beats a silent delivered-loss.
+        if recipient in conns.parting:
+            egress.to_user(recipient, raw)
 
 
 def route_broadcast(broker: "Broker", topics: Sequence[int], raw: Bytes,
@@ -383,6 +404,11 @@ def route_broadcast(broker: "Broker", topics: Sequence[int], raw: Bytes,
                     if shard is not None:
                         egress.to_shard(shard, shardring.KIND_USER, user,
                                         raw)
+                    elif user in conns.parting:
+                        # interest rows outlive the eviction through the
+                        # parting grace: the chase delivery (see
+                        # Connections.remove_user)
+                        egress.to_user(user, raw)
         return
     for ident in brokers:
         if ident not in exclude_brokers:

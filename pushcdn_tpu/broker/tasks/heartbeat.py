@@ -40,6 +40,17 @@ async def _dial(broker: "Broker", peer) -> None:
 
 
 async def heartbeat_once(broker: "Broker") -> None:
+    if broker.draining:
+        # elastic drain (ISSUE 12): a draining broker must leave placement
+        # rotation immediately, not age out after the membership TTL — and
+        # a heartbeat here would re-insert the row deregister just removed
+        try:
+            await broker.discovery.deregister()
+        except Exception as exc:
+            broker.note_discovery_probe(False, f"deregister failed: {exc!r}")
+            raise
+        broker.note_discovery_probe(True, "draining: deregistered")
+        return
     # every heartbeat IS a discovery-store probe: report the outcome to
     # the readiness plane so /readyz's cached-TTL check stays fresh for
     # free in steady state (ISSUE 5)

@@ -21,7 +21,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import random
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import List, Optional, Set, Type
 
@@ -35,6 +38,7 @@ from pushcdn_tpu.proto.message import (
     Broadcast,
     Direct,
     Message,
+    Migrate,
     Subscribe,
     Unsubscribe,
     deserialize_owned,
@@ -45,8 +49,37 @@ from pushcdn_tpu.proto.transport.base import Connection, Protocol
 
 logger = logging.getLogger("pushcdn.client")
 
-RETRY_INTERVAL_S = 2.0      # lib.rs reconnect cadence
 CONNECT_TIMEOUT_S = 10.0    # per-attempt timeout
+
+# Reconnect backoff (ISSUE 12): exponential with FULL jitter —
+# delay = uniform(0, min(cap, base * 2^attempt)) — so a broker death
+# under 10K clients produces a spread-out reconnect storm instead of
+# synchronized waves (the classic full-jitter result: contention decays
+# instead of echoing). A typed Error(SHED) retry-after hint acts as a
+# FLOOR on the draw: the server told us when it expects to be useful
+# again, so retrying earlier is wasted work for both sides.
+BACKOFF_BASE_S = float(os.environ.get("PUSHCDN_BACKOFF_BASE_S", "") or 0.25)
+BACKOFF_CAP_S = float(os.environ.get("PUSHCDN_BACKOFF_CAP_S", "") or 30.0)
+
+# Bounded final drain of the OLD connection during a migration: the old
+# broker closes it once the target's UserSync eviction lands; this is
+# only the backstop when that propagation stalls (mesh partition).
+MIGRATE_DRAIN_TIMEOUT_S = float(
+    os.environ.get("PUSHCDN_MIGRATE_DRAIN_S", "") or 2.0)
+
+
+def backoff_delay(attempt: int, retry_after_s: Optional[float] = None,
+                  base_s: Optional[float] = None,
+                  cap_s: Optional[float] = None) -> float:
+    """The full-jitter reconnect delay for ``attempt`` (0-based), with an
+    optional typed retry-after floor. Module-level so the backoff policy
+    is unit-testable without a socket in sight."""
+    base = BACKOFF_BASE_S if base_s is None else base_s
+    cap = BACKOFF_CAP_S if cap_s is None else cap_s
+    delay = random.uniform(0.0, min(cap, base * (2 ** attempt)))
+    if retry_after_s is not None and retry_after_s > 0:
+        delay = max(delay, float(retry_after_s))
+    return delay
 
 
 def decode_received(items) -> List[Message]:
@@ -116,6 +149,17 @@ class Client:
         # reconnect replays the full set, subscribe/unsubscribe send the
         # requested topics verbatim instead of the delta
         self._topics_dirty = False
+        # elastic re-home (ISSUE 12): a Migrate frame seen mid-batch is
+        # stashed here until the deliveries ahead of it are handed over;
+        # the backlog holds old-connection stragglers collected during
+        # the make-before-break switch, delivered before anything from
+        # the new connection
+        self._pending_migrate: Optional[Migrate] = None
+        self._migration_backlog: deque = deque()
+        # re-home observability: wall-clock ms per completed migration
+        # (Migrate processed -> new home live), read by the swarm soak
+        # harness for its re-home latency percentiles
+        self.rehome_ms: List[float] = []
 
     def _shed_error(self, message: AuthenticateResponse) -> Error:
         """A post-handshake ``permit=0`` response is the broker's typed
@@ -201,6 +245,7 @@ class Client:
             conn = self._connection
             if conn is not None and not conn.is_closed:
                 return conn
+            attempt = 0
             while True:
                 try:
                     async with asyncio.timeout(CONNECT_TIMEOUT_S):
@@ -209,9 +254,17 @@ class Client:
                 except asyncio.CancelledError:
                     raise
                 except Exception as exc:
-                    logger.info("connect attempt failed (%r); retrying in %ss",
-                                exc, RETRY_INTERVAL_S)
-                    await asyncio.sleep(RETRY_INTERVAL_S)
+                    # full-jitter exponential backoff; a typed SHED
+                    # retry-after hint floors the draw (ISSUE 12). A
+                    # rejected permit re-runs the whole marshal dance on
+                    # the next attempt, so the marshal re-load-balances
+                    # us for free.
+                    delay = backoff_delay(
+                        attempt, getattr(exc, "retry_after_s", None))
+                    attempt += 1
+                    logger.info("connect attempt %d failed (%r); "
+                                "retrying in %.2fs", attempt, exc, delay)
+                    await asyncio.sleep(delay)
 
     def _disconnect_on_error(self) -> None:
         """Tear the connection down so the next call re-dials
@@ -219,6 +272,81 @@ class Client:
         conn, self._connection = self._connection, None
         if conn is not None:
             conn.close()
+
+    # -- elastic re-home (ISSUE 12) ------------------------------------------
+
+    async def _complete_migration(self, migrate: Migrate) -> None:
+        """Make-before-break re-home. The OLD connection stays open while
+        the new home is established: closing it first would release the
+        old broker's DirectMap claim before the target claims the user —
+        a zero-home window where a mid-migration direct is lost. Instead
+        the target's ``add_user`` out-versions the claim, the UserSync
+        eviction makes the old broker close its half, and we do a bounded
+        final drain of the old connection into the backlog so stragglers
+        are delivered (in order) before anything from the new home.
+        Subscriptions replay inside the target handshake, riding the same
+        full-set replay a reconnect uses."""
+        c = self.config
+        t0 = time.monotonic()
+        old, self._connection = self._connection, None
+        new_conn = None
+        async with self._reconnect_sem:  # serialize vs lazy reconnects
+            if migrate.permit >= 2 and migrate.target:
+                # pre-issued permit: dial the new home DIRECTLY — the
+                # draining broker already did the placement + permit work
+                # in one batch, no per-connection marshal round-trip
+                try:
+                    async with asyncio.timeout(CONNECT_TIMEOUT_S):
+                        new_conn = await c.protocol.connect(
+                            migrate.target, c.use_local_authority, c.limiter)
+                        await user_auth.authenticate_with_broker(
+                            new_conn, migrate.permit, sorted(self._topics))
+                    self._topics_dirty = False
+                    logger.info("re-homed to broker at %s", migrate.target)
+                except asyncio.CancelledError:
+                    if new_conn is not None:
+                        new_conn.close()
+                    raise
+                except Exception as exc:
+                    logger.info("direct re-home to %s failed (%r); "
+                                "falling back to the marshal",
+                                migrate.target, exc)
+                    if new_conn is not None:
+                        new_conn.close()
+                    new_conn = None
+            if new_conn is None:
+                # fallback: the full marshal re-dance (it re-load-balances
+                # us); a failure here leaves the client disconnected and
+                # the NEXT call enters the ordinary backoff loop
+                try:
+                    async with asyncio.timeout(CONNECT_TIMEOUT_S):
+                        new_conn = await self._connect_once()
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    logger.info("marshal fallback after migrate failed: %r",
+                                exc)
+            # bounded final drain: collect every delivery still buffered
+            # on (or in flight to) the old connection. Normally ends fast
+            # — the old broker closes the connection once the UserSync
+            # eviction lands; the timeout is the partition backstop.
+            if old is not None and not old.is_closed:
+                try:
+                    async with asyncio.timeout(MIGRATE_DRAIN_TIMEOUT_S):
+                        while True:
+                            items = await old.recv_frames()
+                            for m in decode_received(items):
+                                if isinstance(m, (Broadcast, Direct)):
+                                    self._migration_backlog.append(m)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    pass  # closed by the old broker, or timed out
+            if old is not None:
+                old.close()
+            self._connection = new_conn
+            if new_conn is not None:
+                self.rehome_ms.append((time.monotonic() - t0) * 1000.0)
 
     # -- messaging API (lib.rs:295-481) -------------------------------------
 
@@ -251,24 +379,35 @@ class Client:
                                        message=payload))
 
     async def receive_message(self) -> Message:
-        if self._pending_shed is not None:
-            err, self._pending_shed = self._pending_shed, None
-            raise err
-        conn = self._connection  # fast path: live connection, no coroutine
-        if conn is None or conn.is_closed:
-            conn = await self._get_connection()
-        try:
-            message = await conn.recv_message()
-        except Exception as exc:
-            self._disconnect_on_error()
-            bail(ErrorKind.CONNECTION, "receive failed; connection reset", exc)
-        if isinstance(message, AuthenticateResponse) and message.permit == 0:
-            raise self._shed_error(message)
-        if trace_mod.ENABLED:
-            tr = getattr(message, "trace", None)
-            if tr is not None:
-                trace_mod.emit("delivery", tr)
-        return message
+        while True:
+            if self._pending_shed is not None:
+                err, self._pending_shed = self._pending_shed, None
+                raise err
+            if self._migration_backlog:
+                return self._migration_backlog.popleft()
+            if self._pending_migrate is not None:
+                mig, self._pending_migrate = self._pending_migrate, None
+                await self._complete_migration(mig)
+                continue
+            conn = self._connection  # fast path: live conn, no coroutine
+            if conn is None or conn.is_closed:
+                conn = await self._get_connection()
+            try:
+                message = await conn.recv_message()
+            except Exception as exc:
+                self._disconnect_on_error()
+                bail(ErrorKind.CONNECTION,
+                     "receive failed; connection reset", exc)
+            if isinstance(message, Migrate):
+                await self._complete_migration(message)
+                continue
+            if isinstance(message, AuthenticateResponse) and message.permit == 0:
+                raise self._shed_error(message)
+            if trace_mod.ENABLED:
+                tr = getattr(message, "trace", None)
+                if tr is not None:
+                    trace_mod.emit("delivery", tr)
+            return message
 
     async def receive_messages(self, max_messages: int = 1024
                                ) -> List[Message]:
@@ -283,46 +422,70 @@ class Client:
         ``max_messages`` is approximate: the transport hands over whole
         parse batches, so one call may return more than asked (never
         fewer than 1)."""
-        if self._pending_shed is not None:
-            err, self._pending_shed = self._pending_shed, None
-            raise err
-        conn = self._connection
-        if conn is None or conn.is_closed:
-            conn = await self._get_connection()
-        try:
-            items = await conn.recv_frames(max_messages)
-        except Exception as exc:
-            self._disconnect_on_error()
-            bail(ErrorKind.CONNECTION, "receive failed; connection reset", exc)
-        try:
-            # batch decode with ZERO-COPY payloads (decode_received docs):
-            # the old one-copy-per-message residue is gone — Broadcast/
-            # Direct ``message`` fields are memoryviews over the chunk
-            out = decode_received(items)
-        except Exception as exc:
-            self._disconnect_on_error()
-            bail(ErrorKind.CONNECTION,
-                 "malformed frame in receive batch; connection reset", exc)
-        # load-shed notices (permit=0 post-handshake) surface as typed
-        # Error(SHED): immediately when nothing else arrived, otherwise
-        # after the real deliveries are handed over (next receive call) —
-        # a shed is never a silent drop and never loses deliveries
-        shed = [m for m in out
-                if isinstance(m, AuthenticateResponse) and m.permit == 0]
-        if shed:
-            out = [m for m in out
-                   if not (isinstance(m, AuthenticateResponse)
-                           and m.permit == 0)]
-            err = self._shed_error(shed[-1])
-            if not out:
+        while True:
+            if self._pending_shed is not None:
+                err, self._pending_shed = self._pending_shed, None
                 raise err
-            self._pending_shed = err
-        if trace_mod.ENABLED:
-            for m in out:
-                tr = getattr(m, "trace", None)
-                if tr is not None:
-                    trace_mod.emit("delivery", tr)
-        return out
+            if self._migration_backlog:
+                out = list(self._migration_backlog)
+                self._migration_backlog.clear()
+                return out
+            if self._pending_migrate is not None:
+                mig, self._pending_migrate = self._pending_migrate, None
+                await self._complete_migration(mig)
+                continue
+            conn = self._connection
+            if conn is None or conn.is_closed:
+                conn = await self._get_connection()
+            try:
+                items = await conn.recv_frames(max_messages)
+            except Exception as exc:
+                self._disconnect_on_error()
+                bail(ErrorKind.CONNECTION,
+                     "receive failed; connection reset", exc)
+            try:
+                # batch decode with ZERO-COPY payloads (decode_received
+                # docs): the old one-copy-per-message residue is gone —
+                # Broadcast/Direct ``message`` fields are memoryviews
+                # over the chunk
+                out = decode_received(items)
+            except Exception as exc:
+                self._disconnect_on_error()
+                bail(ErrorKind.CONNECTION,
+                     "malformed frame in receive batch; connection reset", exc)
+            # a Migrate mid-batch splits it: deliveries ahead of it are
+            # returned now, the frame is stashed for the next call (the
+            # re-home completes then), and anything decoded after it is
+            # backlogged so nothing is lost or reordered
+            for i, m in enumerate(out):
+                if isinstance(m, Migrate):
+                    self._pending_migrate = m
+                    self._migration_backlog.extend(
+                        x for x in out[i + 1:] if not isinstance(x, Migrate))
+                    out = out[:i]
+                    break
+            # load-shed notices (permit=0 post-handshake) surface as typed
+            # Error(SHED): immediately when nothing else arrived, otherwise
+            # after the real deliveries are handed over (next receive call)
+            # — a shed is never a silent drop and never loses deliveries
+            shed = [m for m in out
+                    if isinstance(m, AuthenticateResponse) and m.permit == 0]
+            if shed:
+                out = [m for m in out
+                       if not (isinstance(m, AuthenticateResponse)
+                               and m.permit == 0)]
+                err = self._shed_error(shed[-1])
+                if not out:
+                    raise err
+                self._pending_shed = err
+            if not out:
+                continue  # the batch was pure control traffic (a Migrate)
+            if trace_mod.ENABLED:
+                for m in out:
+                    tr = getattr(m, "trace", None)
+                    if tr is not None:
+                        trace_mod.emit("delivery", tr)
+            return out
 
     # -- subscriptions -------------------------------------------------------
 

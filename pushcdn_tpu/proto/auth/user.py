@@ -79,9 +79,19 @@ async def authenticate_with_marshal(
     if response.permit <= 1:
         # permit 0 = failure with reason; 1 would be a bare ack which the
         # marshal never sends (message.rs:338-341 semantics)
-        bail(ErrorKind.AUTHENTICATION,
-             f"marshal rejected authentication: {response.context!r}")
+        _bail_rejection("marshal", response.context)
     return response.permit, response.context
+
+
+def _bail_rejection(who: str, context: str):
+    """A ``permit=0`` rejection at connect time: load sheds surface as the
+    TYPED ``Error(SHED)`` (carrying any ``retry-after=`` hint for the
+    client's backoff loop, ISSUE 12) so they're distinguishable from a
+    real auth failure — today both looked identical to the retry logic."""
+    if context.startswith("shed"):
+        bail(ErrorKind.SHED, f"{who} shed the connection: {context}")
+    bail(ErrorKind.AUTHENTICATION,
+         f"{who} rejected authentication: {context!r}")
 
 
 async def authenticate_with_broker(
@@ -114,13 +124,11 @@ async def authenticate_with_broker(
         except Exception:
             raise send_err
         if isinstance(response, AuthenticateResponse) and response.permit != 1:
-            bail(ErrorKind.AUTHENTICATION,
-                 f"broker rejected permit: {response.context!r}")
+            _bail_rejection("broker", response.context)
         raise send_err
     response = await connection.recv_message()
     if not isinstance(response, AuthenticateResponse):
         bail(ErrorKind.AUTHENTICATION,
              f"broker sent unexpected {type(response).__name__}")
     if response.permit != 1:
-        bail(ErrorKind.AUTHENTICATION,
-             f"broker rejected permit: {response.context!r}")
+        _bail_rejection("broker", response.context)

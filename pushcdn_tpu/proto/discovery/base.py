@@ -86,6 +86,13 @@ class DiscoveryClient(abc.ABC):
         """Publish liveness + load; membership ages out after the expiry
         (60 s TTL in the reference, heartbeat.rs:37-50)."""
 
+    async def deregister(self) -> None:
+        """Remove this broker's membership row immediately (ISSUE 12 drain):
+        a draining broker must leave placement rotation NOW, not after its
+        heartbeat TTL ages out. Permits/whitelist are untouched — the row
+        would re-appear on the next heartbeat, so drainers also stop
+        heartbeating. Default: no-op for identity-less clients."""
+
     @abc.abstractmethod
     async def get_other_brokers(self) -> List[BrokerIdentifier]:
         """All live brokers except self."""

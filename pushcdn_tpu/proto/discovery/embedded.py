@@ -17,6 +17,7 @@ the async interface is kept so the Redis implementation can be truly async.
 
 from __future__ import annotations
 
+import asyncio
 import secrets
 import sqlite3
 import time
@@ -24,6 +25,40 @@ from typing import List, Optional
 
 from pushcdn_tpu.proto.discovery.base import BrokerIdentifier, DiscoveryClient
 from pushcdn_tpu.proto.error import ErrorKind, bail
+
+# Cross-process write contention policy (ISSUE 12): sqlite raises
+# OperationalError('database is locked') when another process holds the
+# write lock past busy_timeout. Writes retry on this bounded schedule
+# before surfacing a TYPED Error(CONNECTION) — never the raw sqlite3
+# exception. Total budget (~0.75 s + busy_timeout per attempt) stays well
+# under the 8 s chaos-outage hold, so a genuine discovery outage still
+# fails loudly (heartbeat task-died events, admissions refused) instead
+# of hanging. Tests shrink both knobs to keep the slow path fast.
+LOCKED_RETRY_SCHEDULE = (0.05, 0.1, 0.2, 0.4)
+BUSY_TIMEOUT_MS = 5000
+
+
+def _is_locked(exc: sqlite3.OperationalError) -> bool:
+    msg = str(exc).lower()
+    return "locked" in msg or "busy" in msg
+
+
+async def _locked_retry(op, what: str):
+    """Run the synchronous sqlite write ``op`` with bounded backoff on
+    lock contention; other OperationalErrors propagate unchanged."""
+    for delay in LOCKED_RETRY_SCHEDULE:
+        try:
+            return op()
+        except sqlite3.OperationalError as exc:
+            if not _is_locked(exc):
+                raise
+        await asyncio.sleep(delay)
+    try:
+        return op()
+    except sqlite3.OperationalError as exc:
+        if not _is_locked(exc):
+            raise
+        bail(ErrorKind.CONNECTION, f"discovery store busy: {what}", exc)
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS brokers (
@@ -66,7 +101,7 @@ class Embedded(DiscoveryClient):
         self._db = sqlite3.connect(path, check_same_thread=False,
                                    isolation_level=None)
         self._db.execute("PRAGMA journal_mode=WAL")
-        self._db.execute("PRAGMA busy_timeout=5000")
+        self._db.execute(f"PRAGMA busy_timeout={int(BUSY_TIMEOUT_MS)}")
         # Permits/heartbeats are ephemeral (30-60 s TTLs): losing the tail
         # of the WAL on power loss only forces reconnects, so skip the
         # per-commit fsync — it was most of the auth handshake's floor
@@ -96,16 +131,29 @@ class Embedded(DiscoveryClient):
                                 heartbeat_expiry_s: float) -> None:
         if self.identity is None:
             bail(ErrorKind.PARSE, "heartbeat requires a broker identity")
-        self._db.execute(
-            "INSERT INTO brokers (identifier, num_connections, expiry) "
-            "VALUES (?, ?, ?) ON CONFLICT(identifier) DO UPDATE SET "
-            "num_connections=excluded.num_connections, expiry=excluded.expiry",
-            (str(self.identity), num_connections,
-             time.time() + heartbeat_expiry_s))
-        self._db.commit()
+
+        def write():
+            self._db.execute(
+                "INSERT INTO brokers (identifier, num_connections, expiry) "
+                "VALUES (?, ?, ?) ON CONFLICT(identifier) DO UPDATE SET "
+                "num_connections=excluded.num_connections, expiry=excluded.expiry",
+                (str(self.identity), num_connections,
+                 time.time() + heartbeat_expiry_s))
+            self._db.commit()
+        await _locked_retry(write, "heartbeat")
+
+    async def deregister(self) -> None:
+        if self.identity is None:
+            return
+
+        def write():
+            self._db.execute("DELETE FROM brokers WHERE identifier = ?",
+                             (str(self.identity),))
+            self._db.commit()
+        await _locked_retry(write, "deregister")
 
     async def get_other_brokers(self) -> List[BrokerIdentifier]:
-        self._prune()
+        await _locked_retry(self._prune, "prune")
         me = str(self.identity) if self.identity else None
         rows = self._db.execute(
             "SELECT identifier FROM brokers").fetchall()
@@ -115,7 +163,7 @@ class Embedded(DiscoveryClient):
     async def get_with_least_connections(self) -> BrokerIdentifier:
         """Load = live connections + outstanding permits (parity
         redis.rs:139-167)."""
-        self._prune()
+        await _locked_retry(self._prune, "prune")
         rows = self._db.execute(
             "SELECT b.identifier, b.num_connections + "
             " (SELECT COUNT(*) FROM permits p WHERE p.broker = b.identifier) "
@@ -131,13 +179,16 @@ class Embedded(DiscoveryClient):
         # permit semantics: 0=fail, 1=ack, >1=real permit (message.rs:338-341)
         while True:
             permit = secrets.randbits(62) + 2
-            try:
+
+            def write():
                 self._db.execute(
                     "INSERT INTO permits (permit, broker, public_key, expiry) "
                     "VALUES (?, ?, ?, ?)",
                     (permit, str(for_broker), bytes(public_key),
                      time.time() + expiry_s))
                 self._db.commit()
+            try:
+                await _locked_retry(write, "issue_permit")
                 return permit
             except sqlite3.IntegrityError:
                 continue  # permit collision: retry
@@ -146,7 +197,7 @@ class Embedded(DiscoveryClient):
                                permit: int) -> Optional[bytes]:
         """Redeem-and-delete (GETDEL parity, redis permit redemption);
         range-checked by the base-class template method."""
-        self._prune()
+        await _locked_retry(self._prune, "prune")
         row = self._db.execute(
             "SELECT broker, public_key FROM permits WHERE permit = ?",
             (permit,)).fetchone()
@@ -154,8 +205,11 @@ class Embedded(DiscoveryClient):
             return None
         if not self.global_permits and row[0] != str(broker):
             return None  # issued for a different broker
-        self._db.execute("DELETE FROM permits WHERE permit = ?", (permit,))
-        self._db.commit()
+
+        def write():
+            self._db.execute("DELETE FROM permits WHERE permit = ?", (permit,))
+            self._db.commit()
+        await _locked_retry(write, "validate_permit")
         return bytes(row[1])
 
     # -- whitelist ----------------------------------------------------------
@@ -164,16 +218,18 @@ class Embedded(DiscoveryClient):
         # the one compound write that must stay atomic under autocommit: a
         # reader between the DELETE and the INSERTs would see an empty
         # whitelist (= admit everyone)
-        self._db.execute("BEGIN IMMEDIATE")
-        try:
-            self._db.execute("DELETE FROM whitelist")
-            self._db.executemany(
-                "INSERT OR IGNORE INTO whitelist (public_key) VALUES (?)",
-                [(bytes(u),) for u in users])
-            self._db.execute("COMMIT")
-        except BaseException:
-            self._db.execute("ROLLBACK")
-            raise
+        def write():
+            self._db.execute("BEGIN IMMEDIATE")
+            try:
+                self._db.execute("DELETE FROM whitelist")
+                self._db.executemany(
+                    "INSERT OR IGNORE INTO whitelist (public_key) VALUES (?)",
+                    [(bytes(u),) for u in users])
+                self._db.execute("COMMIT")
+            except BaseException:
+                self._db.execute("ROLLBACK")
+                raise
+        await _locked_retry(write, "set_whitelist")
         # The whitelist is DURABLE access control (an empty table admits
         # everyone) — force the WAL to disk so synchronous=NORMAL's
         # skipped fsync (fine for ephemeral permits/heartbeats) can't

@@ -62,6 +62,11 @@ class Redis(DiscoveryClient):
                  ex=int(heartbeat_expiry_s))
         await pipe.execute()
 
+    async def deregister(self) -> None:
+        if self.identity is None:
+            return
+        await self._client.delete(f"{_PREFIX_BROKER}{self.identity}")
+
     async def get_other_brokers(self) -> List[BrokerIdentifier]:
         me = f"{_PREFIX_BROKER}{self.identity}" if self.identity else None
         out = []

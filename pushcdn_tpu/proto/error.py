@@ -11,9 +11,28 @@ plus ``bail``/``bail_option`` helpers mirroring the reference's macros
 from __future__ import annotations
 
 import enum
+import re
 from typing import NoReturn, Optional, TypeVar
 
 T = TypeVar("T")
+
+# typed retry-after hint embedded in shed contexts: "...; retry-after=2.5"
+_RETRY_AFTER = re.compile(r"retry-after=([0-9]+(?:\.[0-9]+)?)")
+
+
+def retry_after_hint(context: str) -> Optional[float]:
+    """Parse the ``retry-after=<seconds>`` hint a shedding server appends
+    to its rejection context. Returns None when absent/unparseable — the
+    hint is advisory; clients fall back to plain jittered backoff."""
+    if not context:
+        return None
+    m = _RETRY_AFTER.search(context)
+    if m is None:
+        return None
+    try:
+        return float(m.group(1))
+    except ValueError:  # pragma: no cover - regex guarantees a float
+        return None
 
 
 class ErrorKind(enum.Enum):
@@ -45,6 +64,10 @@ class Error(Exception):
         self.kind = kind
         self.message = message
         self.cause = cause
+        # typed backoff hint (seconds) for SHED errors — parsed from the
+        # server's context by retry_after_hint(); None when absent
+        self.retry_after_s: Optional[float] = retry_after_hint(message) \
+            if kind is ErrorKind.SHED else None
 
     @property
     def is_reconnectable(self) -> bool:

@@ -1,4 +1,5 @@
-"""Wire format: the 9-variant ``Message`` model and its canonical binary codec.
+"""Wire format: the ``Message`` model (9 reference variants + ``Migrate``)
+and its canonical binary codec.
 
 Capability parity with the reference's Cap'n Proto envelope + hand-written
 enum (cdn-proto/src/message.rs:83-105 for the variants, :107-457 for
@@ -46,6 +47,7 @@ KIND_SUBSCRIBE = 6
 KIND_UNSUBSCRIBE = 7
 KIND_USER_SYNC = 8
 KIND_TOPIC_SYNC = 9
+KIND_MIGRATE = 10
 
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
@@ -288,6 +290,26 @@ class TopicSync:
     kind = KIND_TOPIC_SYNC
 
 
+@dataclass(frozen=True, slots=True)
+class Migrate:
+    """Broker → user: re-home to ``target`` (ISSUE 12 elastic membership).
+
+    A draining broker sends this on the ordered egress path — after every
+    message already queued for the user — with a permit it pre-issued for
+    the target broker, so the client dials the new home directly without a
+    per-connection marshal round-trip (the batched-handoff lesson from the
+    DMA streaming / "RPC Considered Harmful" lineage). ``permit == 0``
+    means no pre-issued permit: the client falls back to the marshal
+    re-dance. Backward compatible: kind 10 was unused, and peers that
+    don't know it reject it through the existing unexpected-kind policy.
+    """
+
+    target: str  # the new home's public advertise endpoint
+    permit: int = 0
+
+    kind = KIND_MIGRATE
+
+
 Message = Union[
     AuthenticateWithKey,
     AuthenticateWithPermit,
@@ -298,6 +320,7 @@ Message = Union[
     Unsubscribe,
     UserSync,
     TopicSync,
+    Migrate,
 ]
 
 _ALL_KINDS = {
@@ -310,6 +333,7 @@ _ALL_KINDS = {
     KIND_UNSUBSCRIBE,
     KIND_USER_SYNC,
     KIND_TOPIC_SYNC,
+    KIND_MIGRATE,
 }
 
 
@@ -365,6 +389,9 @@ def serialize(msg: Message) -> bytes:
         elif kind == KIND_AUTHENTICATE_RESPONSE:
             ctx = msg.context.encode("utf-8")
             frame = bytes([kind]) + _U64.pack(msg.permit) + _U32.pack(len(ctx)) + ctx
+        elif kind == KIND_MIGRATE:
+            tgt = msg.target.encode("utf-8")
+            frame = bytes([kind]) + _U64.pack(msg.permit) + _U32.pack(len(tgt)) + tgt
         else:  # pragma: no cover - unreachable with the Message union
             bail(ErrorKind.SERIALIZE, f"unknown message kind {kind}")
     except (struct.error, ValueError) as exc:
@@ -447,6 +474,17 @@ def deserialize(frame: BytesLike) -> Message:
                 bail(ErrorKind.DESERIALIZE,
                      "AuthenticateResponse context is not UTF-8", exc)
             return AuthenticateResponse(permit=permit, context=context)
+        if kind == KIND_MIGRATE:
+            (permit,) = _U64.unpack_from(view, 1)
+            (tlen,) = _U32.unpack_from(view, 9)
+            tgt = bytes(view[13:13 + tlen])
+            if len(tgt) != tlen or 13 + tlen != n:
+                bail(ErrorKind.DESERIALIZE, "Migrate target length mismatch")
+            try:
+                target = tgt.decode("utf-8")
+            except UnicodeDecodeError as exc:
+                bail(ErrorKind.DESERIALIZE, "Migrate target is not UTF-8", exc)
+            return Migrate(target=target, permit=permit)
         if kind in _TRACED_HOT:
             # traced hot frame: 16- or 20-byte trace block (view-tagged)
             # after the kind byte, then the ordinary layout (rare by

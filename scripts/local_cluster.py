@@ -690,6 +690,68 @@ def _chaos_discovery_outage(watch: "EchoWatch", broker_ports: dict,
     return ok
 
 
+def check_rehome(broker_ports: dict, watch: "EchoWatch") -> bool:
+    """ISSUE 12: operator-triggered elastic drain against REAL brokers.
+    ``GET /drain`` on the broker homing the echo client must actively
+    re-home every user (typed Migrate frames, make-before-break): the
+    user count moves to the surviving broker, the drained broker latches
+    /readyz 503 ``draining`` while still serving, and the echo keeps
+    flowing on the new home."""
+    homes = {}
+    for name, port in broker_ports.items():
+        topo = fetch_topology(port)
+        if topo is None:
+            print(f"[cluster] FAIL: {name} topology unreachable pre-rehome")
+            return False
+        homes[name] = topo["num_users"]
+    target = max(homes, key=lambda n: homes[n])
+    if homes[target] == 0:
+        print("[cluster] FAIL: no broker homes any user pre-rehome")
+        return False
+    survivor = next(n for n in broker_ports if n != target)
+    users_moving = homes[target]
+    watch.drain()
+    res = http_get(broker_ports[target], "/drain", timeout=30.0)
+    if res is None or res[0] != 200:
+        print(f"[cluster] FAIL: {target} /drain did not answer: {res}")
+        return False
+    try:
+        summary = json.loads(res[1])
+    except ValueError:
+        print(f"[cluster] FAIL: /drain body unparseable: {res[1][:200]}")
+        return False
+    print(f"[cluster] rehome drain summary from {target}: {summary}")
+    if summary.get("signaled", 0) < users_moving or summary.get("orphaned"):
+        print("[cluster] FAIL: drain signaled too few users or left "
+              "orphans")
+        return False
+    deadline = time.time() + 20.0
+    moved = False
+    while time.time() < deadline:
+        t_old = fetch_topology(broker_ports[target])
+        t_new = fetch_topology(broker_ports[survivor])
+        if t_old and t_new and t_old["num_users"] == 0 \
+                and t_new["num_users"] >= homes[survivor] + users_moving:
+            moved = True
+            break
+        time.sleep(0.2)
+    if not moved:
+        print(f"[cluster] FAIL: users never moved {target} -> {survivor}")
+        return False
+    res = http_get(broker_ports[target], "/readyz")
+    if res is None or res[0] != 503:
+        print(f"[cluster] FAIL: drained {target} still reports ready: {res}")
+        return False
+    # the data plane survived the migration: a FRESH direct echo arrives
+    # through the new home (the client re-homed without a marshal trip)
+    if not watch.wait_fresh("recv direct", 15.0):
+        print("[cluster] FAIL: echo stalled after re-home")
+        return False
+    print(f"[cluster] rehome OK: {users_moving} user(s) re-homed "
+          f"{target} -> {survivor}, echo alive on the new home")
+    return True
+
+
 def check_drain(name: str, proc: subprocess.Popen, port: int) -> bool:
     """SIGINT the process and verify /readyz flips to 503 (draining)
     BEFORE the listeners close — the process keeps answering through the
@@ -808,6 +870,13 @@ def main() -> int:
                          "verifies the typed shed Error, the /readyz "
                          "admission flip + flight-recorder event, and "
                          "recovery")
+    ap.add_argument("--rehome", action="store_true",
+                    help="elastic drain (ISSUE 12): GET /drain on the "
+                         "broker homing the echo client, verify every "
+                         "user is actively re-homed to the survivor via "
+                         "typed Migrate frames (topology moves, drained "
+                         "broker latches 503 draining, echo keeps "
+                         "flowing on the new home)")
     ap.add_argument("--shards", type=int, default=1,
                     help="run broker0 with a sharded data plane (N worker "
                          "processes); spawns a second client so directs "
@@ -999,6 +1068,12 @@ def main() -> int:
         ok = check_topology(broker_ports,
                             expected_users=2 if args.shards > 1 else 1) \
             and ok
+        if args.rehome:
+            # ---- elastic membership (ISSUE 12): operator /drain actively
+            # re-homes the echo client to the surviving broker; runs
+            # BEFORE the trace checks so trace_report --strict also
+            # covers post-migration delivery chains
+            ok = check_rehome(broker_ports, EchoWatch(client)) and ok
         if args.shards > 1:
             # ---- sharded data plane (ISSUE 6): users on 2+ workers and
             # cross-shard directs carried by the handoff rings

@@ -216,3 +216,58 @@ def test_consensus_bench_quick_smoke():
     assert clean["trace_orphaned_spans"] == 0
     assert clean["view_completion_p99_ms"] > 0
     assert clean["publish_delivery_p99_ms"] > 0
+
+
+@pytest.mark.skipif(os.environ.get("PUSHCDN_SKIP_CLUSTER_TEST") == "1",
+                    reason="PUSHCDN_SKIP_CLUSTER_TEST=1")
+@pytest.mark.skipif(not _loopback_available(),
+                    reason="no loopback TCP in this sandbox")
+def test_local_cluster_rehome(tmp_path):
+    """ISSUE 12: operator-triggered elastic drain against REAL broker
+    processes — GET /drain actively re-homes the echo client to the
+    surviving broker via a typed Migrate frame (make-before-break, no
+    marshal round-trip), topology shows the move, the drained broker
+    latches 503 ``draining`` while still serving, the echo keeps flowing
+    on the new home, and trace_report --strict still sees complete span
+    chains with zero orphans THROUGH the migration."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    trace_dir = str(tmp_path / "spans")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--duration", "10", "--base-port", "0",
+         "--rehome", "--trace-log", trace_dir],
+        env=env, capture_output=True, text=True, timeout=180)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"rehome local_cluster failed:\n{out[-6000:]}"
+    assert "rehome drain summary" in out, out[-6000:]
+    assert "'orphaned': 0" in out, out[-6000:]
+    assert "rehome OK" in out, out[-6000:]
+    assert "echo alive on the new home" in out, out[-6000:]
+    assert "trace report OK" in out, out[-6000:]
+    assert "0 orphaned spans" in out, out[-6000:]
+    assert "FAIL" not in out, out[-6000:]
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(os.environ.get("PUSHCDN_SKIP_CLUSTER_TEST") == "1",
+                    reason="PUSHCDN_SKIP_CLUSTER_TEST=1")
+@pytest.mark.skipif(not _loopback_available(),
+                    reason="no loopback TCP in this sandbox")
+def test_swarm_soak_quick():
+    """ISSUE 12 (slow tier): the multi-process swarm soak in --quick
+    size — client-pack workers over real TCP, a live join -> drain ->
+    leave -> rejoin cycle and a reconnect storm, with the elastic
+    invariant measured (zero delivered-message gaps, zero reorders,
+    zero orphans)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benches", "swarm_bench.py"),
+         "--quick"],
+        env=env, capture_output=True, text=True, timeout=500)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"swarm_bench failed:\n{out[-6000:]}"
+    assert "rehome OK" in out, out[-6000:]
+    assert "storm OK" in out, out[-6000:]
+    assert "loss check: gaps 0, reorders 0" in out, out[-6000:]
+    assert "[swarm] OK" in out, out[-6000:]

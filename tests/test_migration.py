@@ -1,0 +1,432 @@
+"""Elastic re-homing invariants (ISSUE 12).
+
+A draining broker actively migrates its users with a typed ``Migrate``
+frame; the client performs a make-before-break switch. The invariants
+under test, all seeded and asserted against BOTH route implementations:
+
+1. **no delivered-message loss or reorder** for a subscribed topic across
+   a live migration (duplicates during the two-home overlap window are
+   legal at-least-once handoff artifacts; the de-duplicated stream must
+   be the complete, ordered sequence);
+2. **a direct sent mid-migration reaches the user at exactly one home**
+   — the DirectMap claim/eviction merge race never double-delivers and
+   never opens a zero-home window, in the full 2-broker cluster and in
+   the 1- and 2-shard worker harness;
+3. the drain trail is observable: ``migrate-out`` on the old home's
+   flight recorder, ``migrate-in`` on the new one.
+"""
+
+import asyncio
+
+import pytest
+
+from pushcdn_tpu.broker import rehome as rehome_mod
+from pushcdn_tpu.broker.connections import SubscriptionStatus  # noqa: F401
+from pushcdn_tpu.broker.tasks import cutthrough
+from pushcdn_tpu.broker.versioned_map import VersionedMap
+from pushcdn_tpu.proto import trace as trace_mod
+from pushcdn_tpu.proto.error import Error
+from pushcdn_tpu.proto.message import (
+    Broadcast,
+    Direct,
+    Migrate,
+    deserialize,
+    serialize,
+)
+from pushcdn_tpu.proto.topic import TopicSpace
+from pushcdn_tpu.proto.transport.base import FrameChunk
+from pushcdn_tpu.testing.cluster import Cluster, wait_until
+
+TOPIC = 1
+
+
+def _route_impl(impl):
+    if impl == "native" and not cutthrough.routeplan.available():
+        pytest.skip("native route-plan kernel unavailable")
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+
+def test_migrate_codec_roundtrip():
+    for m in (Migrate(target="it0-b1-pub", permit=12345),
+              Migrate(target="x" * 300, permit=2 ** 63),
+              Migrate(target="no-permit"),  # permit=0: marshal fallback
+              Migrate(target="")):
+        assert deserialize(serialize(m)) == m
+
+
+def test_migrate_codec_rejects_truncated():
+    frame = serialize(Migrate(target="endpoint", permit=7))
+    for cut in (1, len(frame) // 2, len(frame) - 1):
+        with pytest.raises(Error):
+            deserialize(frame[:cut])
+
+
+# ---------------------------------------------------------------------------
+# live-cluster migration: no loss, no reorder, exactly one home
+# ---------------------------------------------------------------------------
+
+
+def _seq(payload) -> int:
+    return int.from_bytes(bytes(payload)[:4], "big")
+
+
+async def _collect_seqs(client, total: int, out: list):
+    """Drain broadcasts/directs into ``out`` (arrival order, raw — dups
+    included) until every seq in [0, total) has been seen."""
+    seen = set()
+    while len(seen) < total:
+        for m in await asyncio.wait_for(client.receive_messages(), 20.0):
+            if isinstance(m, (Broadcast, Direct)):
+                s = _seq(m.message)
+                out.append(s)
+                seen.add(s)
+
+
+def _dedup(seqs):
+    seen, ordered = set(), []
+    for s in seqs:
+        if s not in seen:
+            seen.add(s)
+            ordered.append(s)
+    return ordered
+
+
+async def _two_broker_pair(cluster, sub_topics):
+    """Subscriber homed on broker 0, publisher on broker 1."""
+    await cluster.place_on(0)
+    sub = cluster.client(seed=82_000, topics=sub_topics)
+    await asyncio.wait_for(sub.ensure_initialized(), 10.0)
+    pk = sub.config.keypair.public_key
+    await wait_until(lambda: cluster.brokers[0].connections.has_user(pk))
+    await cluster.place_on(1)
+    pub = cluster.client(seed=82_001)
+    await asyncio.wait_for(pub.ensure_initialized(), 10.0)
+    await wait_until(
+        lambda: cluster.brokers[1].connections.num_users == 1)
+    return sub, pub, pk
+
+
+@pytest.mark.parametrize("impl", ["native", "python"])
+async def test_no_loss_no_reorder_across_migration(impl):
+    _route_impl(impl)
+    total = 150
+    prev_log = trace_mod.set_log_path(None)
+    prev_impl = cutthrough.ROUTE_IMPL
+    cutthrough.ROUTE_IMPL = impl
+    try:
+        cluster = await Cluster(num_brokers=2,
+                                topics=TopicSpace.range(8)).start()
+        try:
+            sub, pub, pk = await _two_broker_pair(cluster, [TOPIC])
+            b0, b1 = cluster.brokers
+            # the publisher's home must know the old home wants TOPIC
+            # before the stream starts (interest propagation is async)
+            await wait_until(lambda: len(
+                b1.connections.get_interested_by_topic([TOPIC], False)[1])
+                == 1)
+            old_rec = b0.connections.users[pk].connection.flightrec
+
+            got: list = []
+            collector = asyncio.create_task(_collect_seqs(sub, total, got))
+            try:
+
+                async def publish():
+                    for s in range(total):
+                        await pub.send_broadcast_message(
+                            [TOPIC], s.to_bytes(4, "big") + b"payload")
+                        await asyncio.sleep(0.002)
+
+                publisher = asyncio.create_task(publish())
+                # drain mid-stream: the subscriber is re-homed while
+                # the topic is live
+                await asyncio.sleep(0.1)
+                summary = await rehome_mod.rehome_users(b0)
+                assert summary["signaled"] == 1
+                assert summary["orphaned"] == 0
+                await asyncio.wait_for(publisher, 30.0)
+                await asyncio.wait_for(collector, 30.0)
+            finally:
+                collector.cancel()
+
+            # THE invariant: de-duplicated arrival order is the complete
+            # published sequence — nothing lost, nothing reordered
+            assert _dedup(got) == list(range(total)), (
+                f"migration lost/reordered the stream: got {len(got)} "
+                f"raw, {len(_dedup(got))} unique")
+
+            # the user now lives at exactly one home — the new one
+            await wait_until(lambda: b1.connections.has_user(pk))
+            await wait_until(lambda: not b0.connections.has_user(pk))
+            # flight-recorder trail on both sides of the handoff
+            assert any(e == "migrate-out" for _, e, _ in old_rec._events)
+            new_rec = b1.connections.users[pk].connection.flightrec
+            assert any(e == "migrate-in" for _, e, _ in new_rec._events)
+            sub.close()
+            pub.close()
+        finally:
+            await cluster.stop()
+    finally:
+        cutthrough.ROUTE_IMPL = prev_impl
+        trace_mod.set_log_path(prev_log)
+
+
+@pytest.mark.parametrize("impl", ["native", "python"])
+async def test_direct_mid_migration_exactly_one_home(impl):
+    """Directs sent while the migration is in flight chase the user
+    through the DirectMap CRDT row: every one arrives, none twice."""
+    _route_impl(impl)
+    total = 120
+    prev_log = trace_mod.set_log_path(None)
+    prev_impl = cutthrough.ROUTE_IMPL
+    cutthrough.ROUTE_IMPL = impl
+    try:
+        cluster = await Cluster(num_brokers=2,
+                                topics=TopicSpace.range(8)).start()
+        try:
+            sub, pub, pk = await _two_broker_pair(cluster, [TOPIC])
+            b0, b1 = cluster.brokers
+            # the sender's home must hold the DirectMap row for the
+            # recipient (propagated by the strong-consistency UserSync)
+            await wait_until(lambda: b1.connections.direct_map.get(pk)
+                             == b0.connections.identity)
+
+            got: list = []
+            collector = asyncio.create_task(_collect_seqs(sub, total, got))
+            try:
+
+                async def send_directs():
+                    for s in range(total):
+                        await pub.send_direct_message(
+                            pk, s.to_bytes(4, "big") + b"direct")
+                        await asyncio.sleep(0.002)
+
+                sender = asyncio.create_task(send_directs())
+                await asyncio.sleep(0.1)
+                summary = await rehome_mod.rehome_users(b0)
+                assert summary["signaled"] == 1
+                await asyncio.wait_for(sender, 30.0)
+                await asyncio.wait_for(collector, 30.0)
+            finally:
+                collector.cancel()
+
+            # exactly one home: every direct delivered exactly ONCE —
+            # no zero-home drop, no two-home double delivery
+            assert sorted(got) == list(range(total)), (
+                f"mid-migration directs lost or duplicated: {len(got)} "
+                f"deliveries of {len(set(got))} unique / {total} sent")
+            await wait_until(lambda: b1.connections.has_user(pk))
+            sub.close()
+            pub.close()
+        finally:
+            await cluster.stop()
+    finally:
+        cutthrough.ROUTE_IMPL = prev_impl
+        trace_mod.set_log_path(prev_log)
+
+
+# ---------------------------------------------------------------------------
+# DirectMap eviction/merge race in the sharded worker harness
+# ---------------------------------------------------------------------------
+
+
+async def _drain_messages(conn, settle_s: float = 0.05):
+    got = []
+    while True:
+        try:
+            items = await asyncio.wait_for(conn.recv_frames(), settle_s)
+        except (asyncio.TimeoutError, Exception):
+            return got
+        for item in items:
+            if type(item) is FrameChunk:
+                got.extend(deserialize(bytes(mv)) for mv in item.views())
+            else:
+                got.append(deserialize(bytes(item.data)))
+            item.release()
+
+
+@pytest.mark.parametrize("impl", ["native", "python"])
+@pytest.mark.parametrize("num_shards", [1, 2])
+async def test_directmap_eviction_race_sharded(impl, num_shards):
+    """A peer broker's out-versioning claim lands mid-stream of directs:
+    pre-claim directs reach the local connection, post-claim directs are
+    forwarded to the claimant, the evicted local record is gone — on the
+    1-shard broker and across the worker ring (user on the NON-mesh
+    shard, claim relayed over the shard bus)."""
+    _route_impl(impl)
+    from pushcdn_tpu.testing.shardharness import run_sharded
+    prev_impl = cutthrough.ROUTE_IMPL
+    cutthrough.ROUTE_IMPL = impl
+    try:
+        # user-0: migrating recipient on the LAST shard (cross-shard relay
+        # when num_shards=2); user-1: direct sender on shard 0; one mesh
+        # peer = the new home
+        run = await run_sharded([(num_shards - 1, [0]), (0, [])],
+                                num_shards=num_shards,
+                                connected_brokers=[([0], [])])
+        try:
+            peer = run.peer(0)
+            key = b"user-0"
+
+            def direct_frames(lo, hi):
+                return [serialize(Direct(
+                    recipient=key, message=s.to_bytes(4, "big") + b"d"))
+                    for s in range(lo, hi)]
+
+            sender = run.user(1).remote
+            await sender.send_raw_many(direct_frames(0, 10), flush=True)
+            await run.settle(40)
+
+            # the migration claim: the peer out-versions our DirectMap row
+            # (exactly what the target's add_user produces)
+            claim = VersionedMap(local_identity=peer.identifier)
+            claim.insert(key, peer.identifier)
+            claim.insert(key, peer.identifier)  # version 2 > local 1
+            run.brokers[0].connections.apply_user_sync(
+                VersionedMap.serialize_entries(claim.full()))
+            await run.settle(40)
+            # the eviction propagated to every shard
+            assert not any(b.connections.has_user(key)
+                           for b in run.brokers)
+            assert all(b.connections.direct_map.get(key) == peer.identifier
+                       for b in run.brokers)
+
+            await sender.send_raw_many(direct_frames(10, 20), flush=True)
+            await run.settle(40)
+
+            local = [_seq(m.message)
+                     for m in await _drain_messages(run.user(0).remote)
+                     if isinstance(m, Direct)]
+            chased = [_seq(m.message)
+                      for m in await _drain_messages(peer.remote)
+                      if isinstance(m, Direct)]
+            # exactly one home per direct: the pre-claim batch landed
+            # locally, the post-claim batch chased the user to the peer,
+            # and no seq appears on both sides
+            assert local == list(range(10)), f"pre-claim batch: {local}"
+            assert chased == list(range(10, 20)), \
+                f"post-claim batch: {chased}"
+            assert not set(local) & set(chased)
+        finally:
+            await run.shutdown()
+    finally:
+        cutthrough.ROUTE_IMPL = prev_impl
+
+
+@pytest.mark.parametrize("impl", ["native", "python"])
+@pytest.mark.parametrize("num_shards", [1, 2])
+async def test_late_forwarded_direct_chases_parting(impl, num_shards):
+    """A forwarded direct that lands AFTER the migration claim — the
+    sender's DirectMap replica was behind when it chose us as the home —
+    must chase the evicted user over the ``parting`` connection instead
+    of vanishing into the one-hop rule. This is the stale-replica loss
+    window the swarm soak exposed at 500+ concurrent migrations: the
+    publisher's broker keeps forwarding to the old home until the
+    out-versioned row reaches it, and the old home used to drop every
+    such frame the moment its own replica had flipped."""
+    _route_impl(impl)
+    from pushcdn_tpu.testing.shardharness import run_sharded
+    prev_impl = cutthrough.ROUTE_IMPL
+    cutthrough.ROUTE_IMPL = impl
+    try:
+        # recipient on shard 0 — the mesh shard, where broker-origin
+        # frames are routed and where ``parting`` must be consulted; one
+        # mesh peer plays both the new home and the stale forwarder
+        run = await run_sharded([(0, [0])], num_shards=num_shards,
+                                connected_brokers=[([0], [])])
+        try:
+            peer = run.peer(0)
+            key = b"user-0"
+
+            # the migration claim: the peer out-versions our DirectMap
+            # row, evicting the local user into ``parting``
+            claim = VersionedMap(local_identity=peer.identifier)
+            claim.insert(key, peer.identifier)
+            claim.insert(key, peer.identifier)  # version 2 > local 1
+            run.brokers[0].connections.apply_user_sync(
+                VersionedMap.serialize_entries(claim.full()))
+            await run.settle(40)
+            assert not run.brokers[0].connections.has_user(key)
+            assert key in run.brokers[0].connections.parting
+
+            # late frames from the stale forwarder: broker-origin, so
+            # they arrive with to_user_only semantics and our replica
+            # already names the peer as owner
+            late = [serialize(Direct(
+                recipient=key, message=s.to_bytes(4, "big") + b"late"))
+                for s in range(5)]
+            await peer.remote.send_raw_many(late, flush=True)
+            await run.settle(40)
+
+            got = [_seq(m.message)
+                   for m in await _drain_messages(run.user(0).remote)
+                   if isinstance(m, Direct)]
+            assert got == list(range(5)), \
+                f"late forwarded directs lost: {got}"
+            # the one-hop rule still holds: nothing bounced back out to
+            # the forwarder
+            bounced = [m for m in await _drain_messages(peer.remote)
+                       if isinstance(m, Direct)]
+            assert not bounced, f"late directs re-forwarded: {bounced}"
+        finally:
+            await run.shutdown()
+    finally:
+        cutthrough.ROUTE_IMPL = prev_impl
+
+
+@pytest.mark.parametrize("impl", ["native", "python"])
+@pytest.mark.parametrize("num_shards", [1, 2])
+async def test_late_broadcast_chases_parting(impl, num_shards,
+                                             monkeypatch):
+    """Broadcast twin of the stale-replica race — THE swarm-soak loss
+    mechanism: a publisher's broker keeps fanning a topic to the old
+    home until its TopicSync view of the new home catches up, and the
+    old home used to have dropped the user's interest rows the instant
+    the eviction landed — a zero-home window for every broadcast routed
+    in between. The rows must outlive the eviction through the parting
+    grace (delivering to the connection the client is still draining),
+    then disappear when the grace expires."""
+    _route_impl(impl)
+    from pushcdn_tpu.broker import connections as conns_mod
+    from pushcdn_tpu.testing.shardharness import run_sharded
+    monkeypatch.setattr(conns_mod, "PARTING_GRACE_S", 0.2)
+    prev_impl = cutthrough.ROUTE_IMPL
+    cutthrough.ROUTE_IMPL = impl
+    try:
+        run = await run_sharded([(0, [TOPIC])], num_shards=num_shards,
+                                connected_brokers=[([TOPIC], [])])
+        try:
+            peer = run.peer(0)
+            key = b"user-0"
+            claim = VersionedMap(local_identity=peer.identifier)
+            claim.insert(key, peer.identifier)
+            claim.insert(key, peer.identifier)  # version 2 > local 1
+            run.brokers[0].connections.apply_user_sync(
+                VersionedMap.serialize_entries(claim.full()))
+            await run.settle(40)
+            conns = run.brokers[0].connections
+            assert key in conns.parting
+            # the chase window: interest survives the eviction
+            assert conns.user_topics.get_values_of_key(key)
+
+            late = [serialize(Broadcast(
+                topics=[TOPIC], message=s.to_bytes(4, "big") + b"late"))
+                for s in range(5)]
+            await peer.remote.send_raw_many(late, flush=True)
+            await run.settle(40)
+            got = [_seq(m.message)
+                   for m in await _drain_messages(run.user(0).remote)
+                   if isinstance(m, Broadcast)]
+            assert got == list(range(5)), f"late broadcasts lost: {got}"
+
+            # ... and the rows are gone once the grace expires
+            await asyncio.sleep(0.5)
+            assert key not in conns.parting
+            assert not conns.user_topics.get_values_of_key(key)
+        finally:
+            await run.shutdown()
+    finally:
+        cutthrough.ROUTE_IMPL = prev_impl

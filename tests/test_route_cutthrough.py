@@ -515,6 +515,7 @@ def test_sharded_route_direct_directmap_precedence():
             self.brokers = {}
             self.remote_broker_shard = {}
             self.direct = {}
+            self.parting = {}
 
         def get_broker_identifier_of_user(self, key):
             return self.direct.get(key)
