@@ -1130,10 +1130,126 @@ async def bench_forward_decoded(impl: str, receivers: int, msgs: int,
             "forward_decoded_delivered_s": round(res["delivered"], 1)}
 
 
+async def bench_io_plane(quick: bool) -> dict:
+    """ISSUE 15 rows: the host I/O data plane A/B (asyncio vs io_uring).
+
+    Four tiers, every uring row honestly skipped when the kernel denies
+    io_uring (ENOSYS / seccomp EPERM) instead of mislabeling an asyncio
+    run:
+
+    - ``io/probe``: the capability probe itself (CI asserts this row).
+    - ``route/forward_tcp``: the route/forward loop with user links over
+      real loopback TCP, per io impl — the end-to-end A/B. Routing +
+      framing CPU dominates this tier on a shared core, so the ratio
+      understates the byte-path win.
+    - ``io/stream``: raw RawStream throughput, no broker — the byte
+      path itself.
+    - ``io/syscalls_per_msg``: counted data-plane syscalls per delivered
+      message (LD_PRELOAD interposer in a measurement subprocess; strace
+      is absent here and /proc/self/io misses socket ops).
+    """
+    import subprocess
+
+    from pushcdn_tpu.native import syscount
+    from pushcdn_tpu.native import uring as nuring
+
+    stats: dict = {}
+    cap = nuring.probe()
+    emit("io/probe", max(cap, 0), "bitmask",
+         available=nuring.available(),
+         zerocopy=nuring.zerocopy_supported(),
+         errname=None if nuring.available() else nuring.probe_errname())
+    stats["io_uring_available"] = nuring.available()
+    impls = ["asyncio"] + (["uring"] if nuring.available() else [])
+    if not nuring.available():
+        reason = f"io_uring unavailable ({nuring.probe_errname()})"
+        for row in ("route/forward_tcp", "io/stream",
+                    "io/syscalls_per_kmsg"):
+            emit(row, 0, "skipped", io_impl="uring", reason=reason)
+
+    # Every measured tier runs in a FRESH child per impl: an earlier
+    # uring run warms the allocator (its ring + pbuf mappings leave
+    # reusable pages) and a following asyncio stream run measures up to
+    # 2x faster in the same process — subprocess isolation removes the
+    # ordering bias. The forwarding child also runs under the
+    # LD_PRELOAD interposer, so one run yields both the rate row and
+    # the counted syscalls-per-message row (strace is absent here and
+    # /proc/self/io misses socket ops).
+    lib = syscount.build()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def child(impl: str, extra: list) -> Optional[dict]:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        if lib is not None:
+            env["LD_PRELOAD"] = str(lib)
+        argv = [sys.executable, "-m", "pushcdn_tpu.testing.routebench",
+                "--io-impl", impl, "--trials",
+                str(2 if quick else 5)] + extra
+        try:
+            out = subprocess.run(
+                argv, capture_output=True, text=True, timeout=600,
+                env=env, cwd=repo).stdout.strip()
+            return json.loads(out.splitlines()[-1])
+        except (subprocess.SubprocessError, ValueError, IndexError):
+            return None
+
+    fwd: dict = {}
+    spm: dict = {}
+    for impl in impls:
+        res = child(impl, ["--receivers", "8",
+                           "--msgs", str(1_000 if quick else 4_000)])
+        if res is None:
+            emit("route/forward_tcp", 0, "skipped", io_impl=impl,
+                 reason="measurement child failed")
+            continue
+        fwd[impl] = res["median"]
+        emit("route/forward_tcp", res["median"], "msgs/s", io_impl=impl,
+             receivers=res["receivers"], msgs=res["msgs"],
+             payload=res["payload"],
+             delivered_msgs_s=round(res["delivered"], 1),
+             trials=[round(r, 1) for r in res["trials"]])
+        if "syscalls_per_msg" in res:
+            spm[impl] = res["syscalls_per_msg"]
+            emit("io/syscalls_per_kmsg", res["syscalls_per_msg"] * 1e3,
+                 "calls/kmsg", io_impl=impl,
+                 syscalls={k: v for k, v in res["syscalls"].items() if v})
+        elif lib is not None:
+            emit("io/syscalls_per_kmsg", 0, "skipped", io_impl=impl,
+                 reason="interposer inactive in child")
+    if fwd.get("uring") and fwd.get("asyncio"):
+        emit("io/ratio", fwd["uring"] / fwd["asyncio"], "x",
+             tier="forward_tcp")
+        stats["forward_tcp_uring_x"] = round(
+            fwd["uring"] / fwd["asyncio"], 2)
+    if spm.get("asyncio") and spm.get("uring"):
+        emit("io/ratio", spm["asyncio"] / spm["uring"], "x",
+             tier="syscalls_per_kmsg")
+        stats["syscall_reduction_x"] = round(
+            spm["asyncio"] / spm["uring"], 2)
+
+    st: dict = {}
+    for impl in impls:
+        res = child(impl, ["--stream",
+                           "--stream-mb", str(128 if quick else 256)])
+        if res is None:
+            emit("io/stream", 0, "skipped", io_impl=impl,
+                 reason="measurement child failed")
+            continue
+        st[impl] = res["median"]
+        emit("io/stream", res["median"], "MB/s", io_impl=impl,
+             write_size=res["write_size"], total_mb=res["total_mb"],
+             trials=[round(r, 1) for r in res["trials"]])
+    if st.get("uring") and st.get("asyncio"):
+        emit("io/ratio", st["uring"] / st["asyncio"], "x", tier="stream")
+        stats["stream_uring_x"] = round(st["uring"] / st["asyncio"], 2)
+    return stats
+
+
 async def amain(quick: bool, impl_arg: str,
                 out_json: Optional[str] = None,
                 shard_rows: Optional[str] = None,
-                churn_rows: bool = False) -> None:
+                churn_rows: bool = False,
+                io_rows: bool = True) -> None:
     from pushcdn_tpu.bin.common import tune_gc
     tune_gc()
     impls = ("native", "python") if impl_arg == "auto" else (impl_arg,)
@@ -1177,6 +1293,13 @@ async def amain(quick: bool, impl_arg: str,
         dec_impl, receivers=8, msgs=2_000 if quick else 10_000,
         trials=2 if quick else 3))
     gc.collect()
+
+    # ISSUE 15: the host I/O data plane A/B (asyncio vs io_uring) —
+    # forwarding over real TCP, the raw byte path, and counted
+    # syscalls-per-message
+    if io_rows:
+        stats.update(await bench_io_plane(quick))
+        gc.collect()
 
     # ISSUE 8: the device data plane — dense-vs-ragged delivery A/B on
     # the CPU twin + the one-collective fused mesh tick (dryrun)
@@ -1236,7 +1359,7 @@ def write_bench_json(path: str, section: str, headline: dict,
                 doc = json.load(fh)
         except (OSError, ValueError):
             doc = {}
-    doc.setdefault("round", 13)
+    doc.setdefault("round", 15)
     from pushcdn_tpu.testing.provenance import provenance
     doc[section] = {"headline": headline, "rows": rows,
                     "provenance": provenance()}
@@ -1271,9 +1394,13 @@ def main() -> None:
                          "(incremental deltas vs the rebuild-guard "
                          "baseline) + the synthetic 1M-subscription "
                          "control-plane harness")
+    ap.add_argument("--no-io-rows", action="store_true",
+                    help="skip the ISSUE 15 host-I/O (asyncio vs "
+                         "io_uring) tiers")
     args = ap.parse_args()
     asyncio.run(amain(args.quick, args.route_impl, args.out_json,
-                      args.shard_rows, args.churn_rows))
+                      args.shard_rows, args.churn_rows,
+                      io_rows=not args.no_io_rows))
 
 
 if __name__ == "__main__":

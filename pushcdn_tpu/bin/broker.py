@@ -13,6 +13,8 @@ import asyncio
 import os
 
 from pushcdn_tpu.bin.common import (
+    add_io_impl_flag,
+    apply_io_impl,
     drain_grace_s,
     init_logging,
     install_drain_signals,
@@ -89,6 +91,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="global broker-mesh shard count; this broker "
                         "attaches to --mesh-shard (default: first local)")
     p.add_argument("--mesh-shard", type=int, default=None)
+    add_io_impl_flag(p)
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -329,6 +332,7 @@ async def amain(args: argparse.Namespace) -> None:
 def main() -> None:
     args = build_parser().parse_args()
     init_logging(args.verbose)
+    apply_io_impl(args)
     tune_gc()
     try:
         asyncio.run(amain(args))

@@ -9,6 +9,8 @@ import asyncio
 import logging
 
 from pushcdn_tpu.bin.common import (
+    add_io_impl_flag,
+    apply_io_impl,
     init_logging,
     keypair_from_seed,
     scheme_by_name,
@@ -38,6 +40,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-bind-endpoint", default=None,
                    help="serve /metrics + /healthz + /readyz (readiness = "
                         "live broker link)")
+    add_io_impl_flag(p)
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -108,6 +111,7 @@ async def amain(args: argparse.Namespace) -> None:
 def main() -> None:
     args = build_parser().parse_args()
     init_logging(args.verbose)
+    apply_io_impl(args)
     try:
         asyncio.run(amain(args))
     except KeyboardInterrupt:

@@ -55,6 +55,26 @@ def tune_gc(threshold0: int = 50_000) -> None:
     gc.set_threshold(threshold0, 50, 100)
 
 
+def add_io_impl_flag(p) -> None:
+    """The host data-plane selector, shared by every binary: ``auto``
+    probes the kernel once and demotes honestly, ``uring`` insists (and
+    fails fast when denied), ``asyncio`` is the default this round."""
+    from pushcdn_tpu.proto.transport.uring import IO_IMPLS
+    p.add_argument("--io-impl", choices=IO_IMPLS, default=None,
+                   help="host I/O engine for tcp links: auto (io_uring "
+                        "when the kernel allows, else asyncio), uring "
+                        "(insist), asyncio (default; also inherited via "
+                        "PUSHCDN_IO_IMPL)")
+
+
+def apply_io_impl(args) -> None:
+    """Write the selection into PUSHCDN_IO_IMPL so THIS process and its
+    children (shard workers, spawned helpers) resolve the same plane."""
+    if getattr(args, "io_impl", None):
+        from pushcdn_tpu.proto.transport.uring import set_io_impl
+        set_io_impl(args.io_impl)
+
+
 def init_logging(verbosity: int = 0) -> None:
     """Env-driven log format: ``PUSHCDN_LOG_FORMAT=json`` switches to
     structured JSON lines (reference: RUST_LOG_FORMAT=json)."""

@@ -66,6 +66,11 @@ class TestDefinition:
     # e.g. "127.0.0.1:0" to exercise the observability endpoint
     # (/healthz, /readyz, /debug/topology) against an injected broker
     metrics_bind_endpoint: Optional[str] = None
+    # route the injected USER links over real loopback TCP instead of the
+    # Memory pair — the io-impl (asyncio vs io_uring) A/B seam: the whole
+    # forwarding path then crosses real sockets on both ends while the
+    # broker internals stay identical
+    tcp_users: bool = False
 
     async def run(self) -> "TestRun":
         uid = next(_UNIQUE)
@@ -87,7 +92,10 @@ class TestDefinition:
         broker = await Broker.new(config)
         await broker.start()
         run = TestRun(broker=broker)
-        await run.inject_users(self.connected_users)
+        if self.tcp_users:
+            await run.inject_users_tcp(self.connected_users)
+        else:
+            await run.inject_users(self.connected_users)
         await run.inject_brokers(self.connected_brokers)
         return run
 
@@ -98,12 +106,36 @@ class TestRun:
     broker: Broker
     connected_users: List[TestUser] = field(default_factory=list)
     connected_brokers: List[TestBroker] = field(default_factory=list)
+    tcp_listener: Optional[object] = None  # set by inject_users_tcp
 
     async def inject_users(self, user_topics) -> None:
         """Parity inject_users (mod.rs:258-300): real receive loops, no auth."""
         for i, topics in enumerate(user_topics):
             key = f"user-{i}".encode()
             local, remote = await gen_testing_connection_pair(self.broker.limiter)
+            task = asyncio.create_task(
+                user_receive_loop(self.broker, key, local))
+            self.broker.connections.add_user(key, local, list(topics),
+                                             AbortOnDropHandle(task))
+            self.connected_users.append(TestUser(key, remote))
+
+    async def inject_users_tcp(self, user_topics) -> None:
+        """``inject_users`` over real loopback TCP: the broker side accepts
+        and finalizes with the broker limiter (exactly what the public
+        accept loop does after auth), then spawns the same
+        ``user_receive_loop``. The Tcp protocol resolves ``--io-impl``
+        per process, so these links exercise whichever data plane
+        (asyncio or io_uring) is selected."""
+        from pushcdn_tpu.proto.transport.tcp import Tcp
+        listener = await Tcp.bind("127.0.0.1:0")
+        self.tcp_listener = listener
+        port = listener.bound_port
+        for i, topics in enumerate(user_topics):
+            key = f"user-{i}".encode()
+            accept_t = asyncio.create_task(listener.accept())
+            remote = await Tcp.connect(f"127.0.0.1:{port}",
+                                       limiter=self.broker.limiter)
+            local = await (await accept_t).finalize(self.broker.limiter)
             task = asyncio.create_task(
                 user_receive_loop(self.broker, key, local))
             self.broker.connections.add_user(key, local, list(topics),
@@ -167,6 +199,8 @@ class TestRun:
             u.remote.close()
         for b in self.connected_brokers:
             b.remote.close()
+        if self.tcp_listener is not None:
+            await self.tcp_listener.close()
         await self.broker.stop()
 
     # index helpers (parity at_index!)

@@ -399,6 +399,26 @@ class _EgressLease:
 _EGRESS_POOL: list = []   # free bytearrays (bounded; newest last)
 _EGRESS_POOL_MAX = 3
 _EGRESS_NEED_HW = 1 << 20  # decaying high-water mark of real step sizes
+# io_uring fixed-buffer hook: callbacks invoked once per pooled egress
+# buffer (existing and future) so the engine can page-pin each buffer a
+# single time at allocation instead of per send. Pool buffers are never
+# resized in place (a too-small buffer rotates away and a fresh one is
+# allocated), so a persistent registration stays valid for the buffer's
+# whole life.
+_EGRESS_REGISTRARS: list = []
+
+
+def add_egress_registrar(fn) -> None:
+    """Subscribe ``fn(buf)`` to every pooled egress buffer, replaying the
+    current free pool immediately. ``fn`` must never raise."""
+    _EGRESS_REGISTRARS.append(fn)
+    for buf in list(_EGRESS_POOL):
+        fn(buf)
+
+
+def egress_pool_buffers() -> list:
+    """Snapshot of the free egress pool (for fixed-buffer registration)."""
+    return list(_EGRESS_POOL)
 
 
 def _egress_note_need(nbytes: int) -> None:
@@ -424,6 +444,8 @@ def _egress_take(nbytes: int):
     except IndexError:  # raced another taker
         pass
     buf = bytearray(max(nbytes, 1 << 20))
+    for fn in _EGRESS_REGISTRARS:
+        fn(buf)
     return buf, _EgressLease(buf)
 
 

@@ -461,6 +461,14 @@ BUILD_INFO = Gauge("cdn_build_info",
                    labels=("version", "jax", "backend", "device_kind"))
 
 
+# Host I/O engine identity: which data-plane impl this process resolved
+# (--io-impl auto can honestly demote to asyncio when the kernel denies
+# io_uring — the label is set at resolution time, value always 1)
+IO_IMPL = Gauge("cdn_io_impl",
+                "Resolved host I/O data-plane impl (value is always 1)",
+                labels=("impl",))
+
+
 _build_info_last: tuple = ()
 
 

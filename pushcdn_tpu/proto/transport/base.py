@@ -194,6 +194,11 @@ def _py_scan_frames(buf, max_frame_len: int):
 class RawStream(abc.ABC):
     """Minimal async byte-stream pair every transport lowers to."""
 
+    # streams that set this accept ``write(data, owner)`` /
+    # ``writev(bufs, owner)`` and anchor the owner lease until the
+    # kernel is done with the bytes (io_uring zero-copy deferral)
+    wants_owner = False
+
     @abc.abstractmethod
     async def read_exactly(self, n: int) -> bytes: ...
 
@@ -290,6 +295,10 @@ class Connection:
         self._stream = stream
         self._limiter = limiter
         self.label = label
+        # owner-aware streams (io_uring) take the PreEncoded lease down
+        # the flush path so zero-copy sends can defer its release until
+        # the kernel's completion notification
+        self._owner_write = bool(getattr(stream, "wants_owner", False))
         # per-transport byte accounting: the label's prefix is the
         # transport name ("tcp:host:port" → "tcp"); the labeled children
         # are cached here so the hot path pays one plain inc per flush
@@ -397,29 +406,48 @@ class Connection:
     # frames above the limit are written directly, no extra copy.
     _BATCH_COALESCE_LIMIT = 64 * 1024
 
-    async def _flush(self, buf) -> None:
+    async def _flush(self, buf, owner=None) -> None:
         """One bounded write under its own timeout; BYTES_SENT counts only
         bytes that actually flushed."""
         async with asyncio.timeout(WRITE_TIMEOUT_S):
-            await self._stream.write(buf)
+            if owner is not None and self._owner_write:
+                await self._stream.write(buf, owner)
+            else:
+                await self._stream.write(buf)
         self._m_sent.inc(len(buf))
 
-    async def _flush_v(self, bufs) -> None:
+    async def _flush_v(self, bufs, owner=None) -> None:
         """Vectored twin of :meth:`_flush`: one timeout window, one gather
         handoff (``writev``) for a run of buffers."""
         async with asyncio.timeout(WRITE_TIMEOUT_S):
-            await self._stream.writev(bufs)
+            if owner is not None and self._owner_write:
+                await self._stream.writev(bufs, owner)
+            else:
+                await self._stream.writev(bufs)
         self._m_sent.inc(sum(len(b) for b in bufs))
 
-    async def _flush_chunked(self, data) -> None:
+    # an owner-aware stream (io_uring) turns a chunked PreEncoded flush
+    # into linked-SQE chains: up to this many chunks per submission share
+    # one timeout window and one kernel handoff
+    _CHAIN_GROUP = 16
+
+    async def _flush_chunked(self, data, owner=None) -> None:
         """Flush an already-framed stream (PreEncoded) in bounded chunks so
         slow links get one timeout window per chunk, not one for the lot."""
         n = len(data)
         chunk = 4 * self._BATCH_COALESCE_LIMIT
         if n <= chunk:
-            await self._flush(data)
+            await self._flush(data, owner)
             return
         view = memoryview(data)
+        if self._owner_write:
+            group = self._CHAIN_GROUP * chunk
+            for base in range(0, n, group):
+                top = min(n, base + group)
+                await self._flush_v(
+                    [view[off:off + chunk]
+                     for off in range(base, top, chunk)], owner)
+            return
         for off in range(0, n, chunk):
             await self._flush(view[off:off + chunk])
 
@@ -529,7 +557,7 @@ class Connection:
                 # handlers (same pattern as the small-frame path below).
                 self._coalescing = True
                 batch.append(item)
-                await self._flush_chunked(payload.data)
+                await self._flush_chunked(payload.data, payload.owner)
                 batch.clear()
                 if done is not None and not done.done():
                     done.set_result(None)
@@ -604,7 +632,7 @@ class Connection:
                     if buf:
                         await self._flush(buf)
                         buf = bytearray()
-                    await self._flush_chunked(data.data)
+                    await self._flush_chunked(data.data, data.owner)
                     i += 1
                     continue
                 n = len(data)

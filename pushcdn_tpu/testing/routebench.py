@@ -225,3 +225,211 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
         cutthrough.ROUTE_IMPL = prev_impl
         cutthrough.ROUTE_INCREMENTAL = prev_inc
         Memory.set_duplex_window(prev_win)
+
+
+async def forward_rate_tcp(io_impl: str, route_impl: str = "auto",
+                           receivers: int = 4, msgs: int = 2_000,
+                           trials: int = 3, payload: int = 512,
+                           batch: int = 64) -> Optional[dict]:
+    """The :func:`forward_rate` loop with user links over REAL loopback
+    TCP — the io-impl (asyncio vs io_uring) A/B seam. ``io_impl`` is
+    ``asyncio`` or ``uring``; returns None when ``uring`` is requested
+    but the kernel denies io_uring (callers emit a skipped row, never a
+    mislabeled one).
+
+    When this process runs under the syscall-attribution preload
+    (``native.syscount``), the result carries per-syscall counter deltas
+    for the measured section and ``syscalls_per_msg`` — counted write +
+    sendto/sendmsg + epoll_wait + io_uring_enter per DELIVERED message.
+    """
+    from pushcdn_tpu.broker.tasks import cutthrough
+    from pushcdn_tpu.broker.test_harness import TestDefinition
+    from pushcdn_tpu.native import routeplan, syscount
+    from pushcdn_tpu.native import uring as nuring
+    from pushcdn_tpu.proto.message import Broadcast, serialize
+    from pushcdn_tpu.proto.transport.base import FrameChunk
+    from pushcdn_tpu.proto.transport import uring as uring_mod
+
+    if io_impl == "uring" and not nuring.available():
+        return None
+    if route_impl == "native" and not routeplan.available():
+        return None
+    prev_impl = cutthrough.ROUTE_IMPL
+    prev_env = os.environ.get("PUSHCDN_IO_IMPL")
+    try:
+        cutthrough.ROUTE_IMPL = route_impl
+        uring_mod.set_io_impl(io_impl)
+        run = await TestDefinition(
+            connected_users=[[]] + [[0]] * receivers, tcp_users=True).run()
+        try:
+            frame = serialize(Broadcast([0], os.urandom(payload)))
+            sender = run.user(0).remote
+            msgs = max(batch, (msgs // batch) * batch)
+
+            async def drain(conn, n):
+                got = 0
+                async with asyncio.timeout(120):
+                    while got < n:
+                        for item in await conn.recv_frames(n - got):
+                            got += item.remaining \
+                                if type(item) is FrameChunk else 1
+                            item.release()
+
+            rates = []
+            counts_before = syscount.snapshot()
+            t_all0 = time.perf_counter()
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                drains = [asyncio.create_task(
+                    drain(run.user(1 + r).remote, msgs))
+                    for r in range(receivers)]
+                for _ in range(msgs // batch):
+                    await sender.send_raw_many([frame] * batch)
+                    await asyncio.sleep(0)
+                await asyncio.gather(*drains)
+                rates.append(msgs / (time.perf_counter() - t0))
+            wall_s = time.perf_counter() - t_all0
+            counts_after = syscount.snapshot()
+            med = statistics.median(rates)
+            out = {"median": med, "trials": rates, "msgs": msgs,
+                   "receivers": receivers, "payload": payload,
+                   "delivered": med * receivers,
+                   "io_impl": io_impl, "wall_s": wall_s}
+            if counts_after:
+                delta = syscount.delta(counts_before, counts_after)
+                delivered_total = trials * msgs * receivers
+                data_calls = sum(delta.get(k, 0) for k in (
+                    "write", "writev", "send", "sendto", "sendmsg",
+                    "epoll_wait", "epoll_pwait", "io_uring_enter"))
+                out["syscalls"] = delta
+                out["syscalls_per_msg"] = data_calls / delivered_total
+            return out
+        finally:
+            await run.shutdown()
+    finally:
+        cutthrough.ROUTE_IMPL = prev_impl
+        if prev_env is None:
+            os.environ.pop("PUSHCDN_IO_IMPL", None)
+            uring_mod._resolved = None
+        else:
+            uring_mod.set_io_impl(prev_env)
+
+
+async def stream_rate(io_impl: str, total_mb: int = 256,
+                      wsize: int = 256 * 1024,
+                      trials: int = 3) -> Optional[dict]:
+    """Raw data-plane throughput A/B: one loopback connection, one
+    producer streaming ``total_mb`` MiB in ``wsize`` writes straight at
+    the :class:`RawStream` layer, one consumer draining ``read_some``.
+    No broker, no framing — this isolates the byte path itself (where
+    the io engine's submission batching and completion coalescing live)
+    from the CPython routing work that dominates ``forward_rate_tcp``.
+    Returns None when ``uring`` is requested but unavailable."""
+    import socket
+
+    from pushcdn_tpu.native import uring as nuring
+    from pushcdn_tpu.proto.transport import uring as uring_mod
+
+    if io_impl == "uring" and not nuring.available():
+        return None
+    total = total_mb * 1024 * 1024
+    payload = bytes(wsize)
+    loop = asyncio.get_running_loop()
+    rates = []
+    for _ in range(trials):
+        if io_impl == "uring":
+            eng = uring_mod.UringEngine.current()
+            lst = uring_mod.uring_bind("127.0.0.1", 0)
+            accept_t = asyncio.create_task(lst.accept())
+            cs = socket.socket()
+            cs.setblocking(False)
+            await loop.sock_connect(cs, ("127.0.0.1", lst.bound_port))
+            cs.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            tx = uring_mod.UringStream(cs, eng)
+            rx_s = uring_mod.UringStream((await accept_t)._sock, eng)
+        else:
+            conn_fut = loop.create_future()
+            server = await asyncio.start_server(
+                lambda r, w: conn_fut.set_result((r, w)),
+                "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            r1, w1 = await asyncio.open_connection("127.0.0.1", port)
+            r2, _w2 = await conn_fut
+
+        async def rx_uring():
+            got = 0
+            while got < total:
+                got += len(await rx_s.read_some(1 << 20))
+
+        async def rx_aio():
+            got = 0
+            while got < total:
+                got += len(await r2.read(1 << 20))
+
+        t0 = time.perf_counter()
+        rt = asyncio.create_task(
+            rx_uring() if io_impl == "uring" else rx_aio())
+        sent = 0
+        if io_impl == "uring":
+            while sent < total:
+                await tx.write(payload)
+                sent += wsize
+        else:
+            while sent < total:
+                w1.write(payload)
+                await w1.drain()
+                sent += wsize
+        await rt
+        rates.append(total / (time.perf_counter() - t0) / 1e6)
+        if io_impl == "uring":
+            await tx.close()
+            await rx_s.close()
+            await lst.close()
+        else:
+            w1.close()
+            _w2.close()
+            server.close()
+            await server.wait_closed()
+        await asyncio.sleep(0.02)
+    return {"median": statistics.median(rates), "trials": rates,
+            "total_mb": total_mb, "write_size": wsize,
+            "io_impl": io_impl, "unit": "MB/s"}
+
+
+def _main() -> None:
+    """Subprocess entry for the syscall-attribution bench row: the parent
+    re-execs ``python -m pushcdn_tpu.testing.routebench`` with
+    ``LD_PRELOAD`` pointing at the interposer and reads one JSON blob
+    from stdout."""
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser(description=_main.__doc__)
+    ap.add_argument("--io-impl", default="asyncio",
+                    choices=("asyncio", "uring"))
+    ap.add_argument("--route-impl", default="auto")
+    ap.add_argument("--receivers", type=int, default=4)
+    ap.add_argument("--msgs", type=int, default=2000)
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--payload", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--stream", action="store_true",
+                    help="run the raw stream-throughput tier instead of "
+                         "broker forwarding")
+    ap.add_argument("--stream-mb", type=int, default=256)
+    args = ap.parse_args()
+    if args.stream:
+        out = asyncio.run(stream_rate(
+            args.io_impl, total_mb=args.stream_mb, trials=args.trials))
+    else:
+        out = asyncio.run(forward_rate_tcp(
+            args.io_impl, route_impl=args.route_impl,
+            receivers=args.receivers, msgs=args.msgs, trials=args.trials,
+            payload=args.payload, batch=args.batch))
+    json.dump(out, sys.stdout)
+    sys.stdout.write("\n")
+
+
+if __name__ == "__main__":
+    _main()

@@ -51,6 +51,7 @@ class ShardTestRun:
     # construction order so tests can mirror a 1-shard TestDefinition
     connected_users: List[Tuple[TestUser, int]] = field(default_factory=list)
     connected_brokers: List[TestBroker] = field(default_factory=list)
+    tcp_listeners: list = field(default_factory=list)  # set by tcp_users
 
     def user(self, i: int) -> TestUser:
         return self.connected_users[i][0]
@@ -72,6 +73,8 @@ class ShardTestRun:
             u.remote.close()
         for b in self.connected_brokers:
             b.remote.close()
+        for listener in self.tcp_listeners:
+            await listener.close()
         for broker in self.brokers:
             await broker.stop()
         detach_inprocess_shards(self.runtimes)
@@ -82,12 +85,16 @@ async def run_sharded(
         num_shards: int = 2,
         connected_brokers: Sequence[Tuple[Sequence[int],
                                           Sequence[bytes]]] = (),
-        ring_bytes: int = 256 * 1024) -> ShardTestRun:
+        ring_bytes: int = 256 * 1024,
+        tcp_users: bool = False) -> ShardTestRun:
     """Build the sharded twin of a ``TestDefinition`` run.
 
     ``user_shards[i] = (shard, topics)`` places injected user i (key
     ``user-<i>``, same naming as the 1-shard harness) on that worker;
-    mesh peer brokers always attach to shard 0 (the link owner)."""
+    mesh peer brokers always attach to shard 0 (the link owner).
+    ``tcp_users`` routes the user links over real loopback TCP (one
+    listener per shard) — the io-impl (asyncio vs io_uring) A/B seam,
+    mirroring ``TestDefinition.tcp_users``."""
     uid = next(_UNIQUE)
     brokers: List[Broker] = []
     for s in range(num_shards):
@@ -115,10 +122,24 @@ async def run_sharded(
         await broker.start()
     run = ShardTestRun(brokers=brokers, runtimes=runtimes)
 
+    listeners = {}
+    if tcp_users:
+        from pushcdn_tpu.proto.transport.tcp import Tcp
     for i, (shard, topics) in enumerate(user_shards):
         key = f"user-{i}".encode()
         broker = brokers[shard]
-        local, remote = await gen_testing_connection_pair(broker.limiter)
+        if tcp_users:
+            listener = listeners.get(shard)
+            if listener is None:
+                listener = await Tcp.bind("127.0.0.1:0")
+                listeners[shard] = listener
+                run.tcp_listeners.append(listener)
+            accept_t = asyncio.create_task(listener.accept())
+            remote = await Tcp.connect(f"127.0.0.1:{listener.bound_port}",
+                                       limiter=broker.limiter)
+            local = await (await accept_t).finalize(broker.limiter)
+        else:
+            local, remote = await gen_testing_connection_pair(broker.limiter)
         task = asyncio.create_task(user_receive_loop(broker, key, local))
         broker.connections.add_user(key, local, list(topics),
                                     AbortOnDropHandle(task))

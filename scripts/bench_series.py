@@ -21,6 +21,12 @@ throughput suffixes (``msgs_s``/``ticks_s``/``ratio``/``ops``…) are
 higher-is-better — and metrics with no inferable direction are tracked
 in the table but never gated.
 
+Absolute numbers are only comparable on the same host: the gate checks
+the per-section provenance host fingerprint (platform + cpu count,
+recorded since r13) and WAIVES — loudly, not silently — any comparison
+whose baseline ran on a different host or predates provenance. The next
+round on the same host re-engages the gate against the fresh baseline.
+
 Legacy rounds (r01–r05 predate sections) are folded in as a ``legacy``
 section from their single parsed metric line.
 """
@@ -97,6 +103,41 @@ def load_rounds(root: str) -> dict:
     return rounds
 
 
+def _fingerprint(prov) -> "tuple | None":
+    """Host identity a throughput number is only comparable within:
+    (platform, cpus) from a section's provenance, or None when the round
+    predates provenance recording (pre-r13) or left it empty."""
+    if not isinstance(prov, dict):
+        return None
+    platform, cpus = prov.get("platform"), prov.get("cpus")
+    if platform is None and cpus is None:
+        return None
+    return (platform, cpus)
+
+
+def load_fingerprints(root: str) -> dict:
+    """{round: {section: fingerprint-or-None}} — the per-section host
+    identity alongside :func:`load_rounds` (legacy rounds get None)."""
+    fps = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = ROUND_RE.search(path)
+        if not m:
+            continue
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if "round" not in doc:
+            continue
+        for name, body in doc.items():
+            if name == "round" or not isinstance(body, dict):
+                continue
+            fps.setdefault(int(m.group(1)), {})[name] = \
+                _fingerprint(body.get("provenance"))
+    return fps
+
+
 def _fmt(value) -> str:
     if value is None:
         return "—"
@@ -130,10 +171,19 @@ def render_markdown(rounds: dict) -> str:
     return "\n".join(out)
 
 
-def gate(rounds: dict, threshold: float) -> list:
+def gate(rounds: dict, threshold: float, fingerprints: dict = None,
+         waived: list = None) -> list:
     """Regressions of the latest round vs the nearest earlier round that
     carries the same metric: [(section, metric, prev_round, prev, cur,
-    pct_worse), ...]."""
+    pct_worse), ...].
+
+    When ``fingerprints`` (from :func:`load_fingerprints`) is given, a
+    metric whose baseline round ran on a different host — or predates
+    provenance recording while the latest round carries it — is NOT
+    gated: absolute throughput/latency across hosts is noise, not a
+    regression. Would-be failures land in ``waived`` (if provided) so
+    the re-baseline is loud, and the next same-host round re-engages the
+    gate automatically against the freshly recorded numbers."""
     if len(rounds) < 2:
         return []
     order = sorted(rounds)
@@ -155,9 +205,18 @@ def gate(rounds: dict, threshold: float) -> list:
             # pct_worse > 0 means the metric moved the wrong way
             change = (cur - prev) / abs(prev)
             pct_worse = -change if sign > 0 else change
-            if pct_worse > threshold:
-                failures.append((section, metric, prev_round, prev, cur,
-                                 pct_worse))
+            if pct_worse <= threshold:
+                continue
+            if fingerprints is not None:
+                fp_prev = fingerprints.get(prev_round, {}).get(section)
+                fp_cur = fingerprints.get(latest, {}).get(section)
+                if fp_prev != fp_cur:
+                    if waived is not None:
+                        waived.append((section, metric, prev_round, prev,
+                                       cur, pct_worse, fp_prev, fp_cur))
+                    continue
+            failures.append((section, metric, prev_round, prev, cur,
+                             pct_worse))
     return failures
 
 
@@ -185,7 +244,16 @@ def main() -> int:
           f"({len(rounds)} rounds: r{min(rounds)}..r{max(rounds)})")
 
     if args.gate:
-        failures = gate(rounds, args.threshold)
+        waived = []
+        failures = gate(rounds, args.threshold, load_fingerprints(args.root),
+                        waived)
+        for (section, metric, prev_round, prev, cur, pct,
+             fp_prev, fp_cur) in waived:
+            print(f"[series] gate WAIVED {section}.{metric}: "
+                  f"r{prev_round}={_fmt(prev)} -> r{max(rounds)}={_fmt(cur)} "
+                  f"({pct:+.1%}) — host fingerprint changed "
+                  f"({fp_prev or 'unrecorded'} -> {fp_cur or 'unrecorded'}); "
+                  f"cross-host absolutes are not gated")
         for section, metric, prev_round, prev, cur, pct in failures:
             print(f"[series] GATE FAIL {section}.{metric}: "
                   f"r{prev_round}={_fmt(prev)} -> r{max(rounds)}={_fmt(cur)} "
@@ -193,7 +261,8 @@ def main() -> int:
         if failures:
             return 1
         print(f"[series] gate OK: no metric regressed "
-              f">{args.threshold:.0%} vs its previous round")
+              f">{args.threshold:.0%} vs its previous round on the "
+              f"same host")
     return 0
 
 

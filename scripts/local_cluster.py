@@ -888,12 +888,24 @@ def main() -> int:
                          "--shards), marshal loss, and a discovery outage "
                          "— each asserted against its composition "
                          "invariant and correlated in the flight recorder")
+    ap.add_argument("--io-impl", choices=("auto", "uring", "asyncio"),
+                    default=None,
+                    help="host I/O engine for every spawned component "
+                         "(exported as PUSHCDN_IO_IMPL; auto demotes to "
+                         "asyncio with a warning when the kernel denies "
+                         "io_uring)")
     ap.add_argument("--chaos-events", default="broker,marshal,discovery",
                     metavar="LIST",
                     help="comma-separated subset of chaos events to run "
                          "(broker, marshal, discovery); the CI smoke tier "
                          "runs one event to stay fast")
     args = ap.parse_args()
+
+    if args.io_impl:
+        # every spawned component inherits the selection (and a --shards
+        # broker's workers inherit it transitively)
+        os.environ["PUSHCDN_IO_IMPL"] = args.io_impl
+        print(f"[cluster] io-impl: {args.io_impl}")
 
     if args.trace_log:
         os.makedirs(args.trace_log, exist_ok=True)

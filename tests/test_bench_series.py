@@ -16,10 +16,11 @@ bench_series = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(bench_series)
 
 
-def _round(path, rnd, section, headline):
+def _round(path, rnd, section, headline, provenance=None):
     with open(path, "w") as fh:
-        json.dump({"round": rnd, section: {"headline": headline,
-                                           "rows": [], "provenance": {}}}, fh)
+        json.dump({"round": rnd,
+                   section: {"headline": headline, "rows": [],
+                             "provenance": provenance or {}}}, fh)
 
 
 def test_direction_inference():
@@ -89,6 +90,40 @@ def test_gate_skips_round_gaps(tmp_path):
     rounds = bench_series.load_rounds(str(tmp_path))
     fails = bench_series.gate(rounds, 0.10)
     assert [(f[0], f[1], f[2]) for f in fails] == [("route", "fwd_msgs_s", 1)]
+
+
+def test_gate_waives_cross_host_comparisons(tmp_path):
+    """A regression vs a round recorded on a different host (or one that
+    predates provenance) is waived — tracked in ``waived``, not a
+    failure — while same-fingerprint regressions still gate."""
+    host_a = {"platform": "Linux-A", "cpus": 8}
+    host_b = {"platform": "Linux-B", "cpus": 1}
+    # r1 has no provenance (legacy), r2 on host A, r3 on host B
+    _round(tmp_path / "BENCH_r1.json", 1, "other", {"auth_ms": 1.0})
+    _round(tmp_path / "BENCH_r2.json", 2, "route",
+           {"fwd_msgs_s": 100.0}, provenance=host_a)
+    _round(tmp_path / "BENCH_r3.json", 3, "route",
+           {"fwd_msgs_s": 50.0}, provenance=host_b)
+    rounds = bench_series.load_rounds(str(tmp_path))
+    fps = bench_series.load_fingerprints(str(tmp_path))
+    waived = []
+    assert bench_series.gate(rounds, 0.10, fps, waived) == []
+    assert [(w[0], w[1], w[2]) for w in waived] == [("route",
+                                                     "fwd_msgs_s", 2)]
+
+    # same host again: the gate re-engages against the host-B baseline
+    _round(tmp_path / "BENCH_r4.json", 4, "route",
+           {"fwd_msgs_s": 25.0}, provenance=host_b)
+    rounds = bench_series.load_rounds(str(tmp_path))
+    fps = bench_series.load_fingerprints(str(tmp_path))
+    fails = bench_series.gate(rounds, 0.10, fps, [])
+    assert [(f[0], f[1], f[2]) for f in fails] == [("route",
+                                                    "fwd_msgs_s", 3)]
+
+    # without fingerprints the cross-host pair still gates (legacy call)
+    (tmp_path / "BENCH_r4.json").unlink()
+    rounds = bench_series.load_rounds(str(tmp_path))
+    assert bench_series.gate(rounds, 0.10) != []
 
 
 def test_cli_gate_exit_codes(tmp_path):

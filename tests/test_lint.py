@@ -42,8 +42,28 @@ ISSUE5_FILES = [
 ]
 
 
+ISSUE13_FILES = [
+    # the io_uring host data plane (ISSUE 13): native layer, ctypes
+    # binding, transport engine, syscall-attribution interposer binding,
+    # and the equivalence/fault suite
+    "pushcdn_tpu/proto/transport/uring.py",
+    "pushcdn_tpu/native/uring.py",
+    "pushcdn_tpu/native/syscount.py",
+    "pushcdn_tpu/testing/routebench.py",
+    "tests/test_uring.py",
+]
+
+
 def test_issue5_files_inside_lint_scope():
     for rel in ISSUE5_FILES:
+        assert os.path.exists(os.path.join(REPO, rel)), rel
+        assert any(rel == scope or rel.startswith(scope + "/")
+                   for scope in RUFF_SCOPE), \
+            f"{rel} is outside the ruff gate's scope {RUFF_SCOPE}"
+
+
+def test_issue13_files_inside_lint_scope():
+    for rel in ISSUE13_FILES:
         assert os.path.exists(os.path.join(REPO, rel)), rel
         assert any(rel == scope or rel.startswith(scope + "/")
                    for scope in RUFF_SCOPE), \
