@@ -6,9 +6,9 @@ brokers, clean vs churn vs chaos, with per-view SLOs gated by
 Per scenario: N consensus nodes run V leader-broadcast → vote-direct →
 quorum views over an in-process cluster (geo-shaped zipf links), every
 message traced (1-in-1) and view-tagged; the span log is aggregated by
-``trace_report`` and the scenario's SLO row lands in BENCH_r13.json:
+``trace_report`` and the scenario's SLO row lands in BENCH_r*.json:
 
-    python benches/consensus_bench.py [--quick] [--out-json BENCH_r13.json]
+    python benches/consensus_bench.py [--quick] [--out-json BENCH_r16.json]
 
 Scenarios:
 
@@ -24,6 +24,11 @@ Scenarios:
   zero-orphan trace gate applies.
 - **marshal_restart** (chaos) — the marshal dies mid-view and comes back:
   no new admissions for a beat, but live consensus links keep serving.
+- **replay_catchup** (chaos, ISSUE 14) — a third of the nodes hard-drop
+  mid-run and rejoin one view later via durable ``subscribe_from``: the
+  in-flight view can only reach quorum on votes triggered by replayed
+  ``Retained`` proposals, so completing every view proves the
+  replay → live handover under real consensus load.
 
 All scenarios assert every view completes (no timeouts) and the chaos
 span logs pass ``trace_report --strict`` (zero orphans, zero stalled
@@ -61,10 +66,12 @@ def _pct_ms(x):
 
 
 async def _run_scenario(name: str, *, num_brokers: int = 1,
-                        chaos_factory=None, sidecar_factory=None,
+                        chaos_factory=None, driver_chaos_factory=None,
+                        sidecar_factory=None,
                         env: dict = None, quick: bool = False,
                         span_dir: str = None,
-                        require_sidecar_sheds: bool = False) -> dict:
+                        require_sidecar_sheds: bool = False,
+                        require_replay: bool = False) -> dict:
     """One scenario: cluster up → (sidecar) → consensus run → strict
     trace gate on the scenario's own span log."""
     from pushcdn_tpu.proto import trace as trace_mod
@@ -102,7 +109,8 @@ async def _run_scenario(name: str, *, num_brokers: int = 1,
         if sidecar_factory is not None:
             sidecar_task = asyncio.ensure_future(
                 sidecar_factory(cluster, stop_sidecar))
-        run = await run_consensus(cluster, cfg, chaos=chaos)
+        run = await run_consensus(cluster, cfg, chaos=chaos,
+                                  driver_chaos=driver_chaos_factory)
     finally:
         stop_sidecar.set()
         sidecar_result = None
@@ -145,6 +153,7 @@ async def _run_scenario(name: str, *, num_brokers: int = 1,
         "view_completion_p99_ms": _pct_ms(completion["p99"]),
         "publish_delivery_p50_ms": _pct_ms(delivery["p50"]),
         "publish_delivery_p99_ms": _pct_ms(delivery["p99"]),
+        "replayed_proposals": run.replayed_proposals,
         "trace_strict_ok": strict_ok,
         "trace_complete_chains": report.get("complete_chains"),
         "trace_orphaned_spans": report.get("orphaned_spans"),
@@ -171,6 +180,10 @@ async def _run_scenario(name: str, *, num_brokers: int = 1,
         assert sidecar_result, \
             f"{name}: the admission layer never shed (sidecar saw 0) — " \
             "the scenario proved nothing"
+    if require_replay:
+        assert run.replayed_proposals > 0, \
+            f"{name}: no Retained proposals were replayed — the rejoin " \
+            "never exercised the durable catch-up path"
     return row
 
 
@@ -238,6 +251,47 @@ def _broker_churn_chaos(cluster, cfg):
     return {kill_at: hook, revive_at: hook}
 
 
+def _replay_catchup_chaos(driver):
+    """ISSUE 14 durable-topics scenario: a third of the nodes hard-drop
+    mid-run and rejoin one view later via ``subscribe_from`` — the view
+    in flight at rejoin time can only reach quorum on votes triggered by
+    REPLAYED (``Retained``) proposals, so completing every view proves
+    the replay → live handover end to end.
+
+    Orphan hygiene (the strict zero-orphan trace gate stays honest):
+    victims are only dropped once their votes for the drop view have
+    LANDED at the leader (no traced frame is in flight toward them), and
+    the next proposal waits until the broker has reaped their
+    connections (no egress span to a corpse). Victims never lead an
+    affected view."""
+    from pushcdn_tpu.testing.cluster import wait_until
+
+    cfg = driver.cfg
+    n = cfg.num_nodes
+    drop_at = cfg.num_views // 3
+    rejoin_at = drop_at + 1
+    leaders = {drop_at % n, rejoin_at % n}
+    victims = [i for i in range(n) if i not in leaders][:max(1, n // 3)]
+
+    async def drop_hook(view: int):
+        await wait_until(
+            lambda: all(i in driver._votes.get(view, set())
+                        for i in victims), timeout=15.0)
+        for i in victims:
+            await driver.drop_node(i)
+        want = n - len(victims)
+        await wait_until(
+            lambda: sum(b.connections.num_users
+                        for b in driver.cluster.brokers) <= want,
+            timeout=15.0)
+
+    async def rejoin_hook(view: int):
+        for i in victims:
+            await driver.rejoin_node(i, from_seq=1)
+
+    return {drop_at: drop_hook, rejoin_at: rejoin_hook}
+
+
 def _marshal_restart_chaos(cluster, cfg):
     kill_at = cfg.num_views // 2
 
@@ -248,7 +302,98 @@ def _marshal_restart_chaos(cluster, cfg):
     return {kill_at: hook}
 
 
-async def amain(quick: bool, out_json: str, scenarios) -> None:
+async def _replay_io_ab(io_impl: str, quick: bool) -> None:
+    """The uring-vs-asyncio A/B row (ISSUE 14 satellite): durable replay
+    over REAL loopback TCP. The consensus scenarios above run on the
+    Memory transport — an io-impl label there would be a lie — so the
+    A/B measures the one consensus-bench path that genuinely crosses
+    sockets: N retained proposals streamed to a late joiner via
+    ``SubscribeFrom``, timed subscribe → last ``Retained`` frame.
+    A kernel that denies io_uring yields a ``skipped`` row, never a
+    mislabeled one."""
+    from pushcdn_tpu.native import uring as nuring
+    from pushcdn_tpu.proto.transport import uring as umod
+
+    n_frames = 256 if quick else 1024
+    payload = 1024
+    impls = [io_impl] if io_impl in ("asyncio", "uring") \
+        else ["asyncio", "uring"]
+    prev = {k: os.environ.get(k)
+            for k in ("PUSHCDN_RETAIN_TOPICS", "PUSHCDN_RETAIN_COUNT",
+                      "PUSHCDN_RETAIN_BYTES", "PUSHCDN_IO_IMPL")}
+    os.environ["PUSHCDN_RETAIN_TOPICS"] = "0"
+    os.environ["PUSHCDN_RETAIN_COUNT"] = str(n_frames)
+    os.environ["PUSHCDN_RETAIN_BYTES"] = str(n_frames * (payload + 64))
+    measured = {}
+    try:
+        for impl in impls:
+            if impl == "uring" and not nuring.available():
+                emit({"bench": "consensus/replay_io_ab", "io_impl": "uring",
+                      "unit": "skipped",
+                      "reason": "io_uring unavailable "
+                                f"({nuring.probe_errname()})"})
+                continue
+            umod.set_io_impl(impl)
+            dt = await _replay_once(n_frames, payload)
+            measured[impl] = dt
+            emit({"bench": "consensus/replay_io_ab", "io_impl": impl,
+                  "transport": "tcp", "frames": n_frames,
+                  "payload_bytes": payload,
+                  "replay_ms": round(dt * 1e3, 3),
+                  "replay_frames_per_s": round(n_frames / dt, 1)})
+        if len(measured) == 2:
+            emit({"bench": "consensus/replay_io_ab", "io_impl": "ab",
+                  "uring_x": round(measured["asyncio"] / measured["uring"],
+                                   3)})
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        umod.set_io_impl(prev.get("PUSHCDN_IO_IMPL") or "auto")
+
+
+async def _replay_once(n_frames: int, payload: int) -> float:
+    """Retain ``n_frames`` broadcasts in one real broker, then time a
+    TCP subscriber's ``subscribe_from(0, 1)`` catch-up."""
+    import time
+
+    from pushcdn_tpu.broker.test_harness import TestDefinition
+    from pushcdn_tpu.proto.message import (KIND_RETAINED, Broadcast,
+                                           SubscribeFrom)
+    from pushcdn_tpu.testing.cluster import wait_until
+
+    # user 0 publishes on topic 0 but only subscribes to 1 → every frame
+    # is retained, none delivered live; user 1 joins cold afterwards
+    run = await TestDefinition(connected_users=((1,), ()),
+                               tcp_users=True).run()
+    try:
+        body = b"r" * payload
+        for _ in range(n_frames):
+            await run.send_message_as(
+                run.user(0), Broadcast(topics=[0], message=body))
+        await wait_until(
+            lambda: run.broker.durable.stats()["ring_entries"]
+            .get(0, 0) >= n_frames,
+            timeout=30.0)
+        late = run.user(1)
+        t0 = time.perf_counter()
+        await late.remote.send_message(SubscribeFrom(topic=0, seq=1),
+                                       flush=True)
+        got = 0
+        while got < n_frames:
+            raw = await asyncio.wait_for(late.remote.recv_raw(), 10.0)
+            if (raw.data[0] & 0x7F) == KIND_RETAINED:
+                got += 1
+            raw.release()
+        return time.perf_counter() - t0
+    finally:
+        await run.shutdown()
+
+
+async def amain(quick: bool, out_json: str, scenarios,
+                io_impl: str = None) -> None:
     span_dir = tempfile.mkdtemp(prefix="consensus-spans-")
     all_scenarios = {
         "clean": dict(),
@@ -261,12 +406,20 @@ async def amain(quick: bool, out_json: str, scenarios) -> None:
         "broker_churn": dict(num_brokers=2,
                              chaos_factory=_broker_churn_chaos),
         "marshal_restart": dict(chaos_factory=_marshal_restart_chaos),
+        "replay_catchup": dict(
+            driver_chaos_factory=_replay_catchup_chaos,
+            require_replay=True,
+            env={"PUSHCDN_RETAIN_TOPICS": "0"}),
     }
     run_list = scenarios or list(all_scenarios)
     rows = {}
     for name in run_list:
         rows[name] = await _run_scenario(
             name, quick=quick, span_dir=span_dir, **all_scenarios[name])
+
+    if io_impl is not None and (scenarios is None
+                                or "replay_catchup" in run_list):
+        await _replay_io_ab(io_impl, quick)
 
     headline = {}
     for key in ("clean", "churn"):
@@ -275,14 +428,32 @@ async def amain(quick: bool, out_json: str, scenarios) -> None:
                 rows[key]["view_completion_p99_ms"]
             headline[f"{key}_delivery_p99_ms"] = \
                 rows[key]["publish_delivery_p99_ms"]
+    if "replay_catchup" in rows:
+        headline["replayed_proposals"] = \
+            rows["replay_catchup"]["replayed_proposals"]
+        # its own series: the rejoin view completes on REPLAYED votes
+        # (drop + reap + re-auth + catch-up inside one view), which is
+        # structurally slower than any live chaos view — folding it into
+        # chaos_view_p99_ms_worst would break that series' round-to-round
+        # comparability
+        headline["replay_catchup_view_p99_ms"] = \
+            rows["replay_catchup"]["view_completion_p99_ms"]
+    ab = [r for r in RESULTS
+          if r.get("bench") == "consensus/replay_io_ab"
+          and "uring_x" in r]
+    if ab:
+        headline["replay_uring_x"] = ab[0]["uring_x"]
     chaos_rows = [r for n, r in rows.items()
                   if n not in ("clean", "churn")]
     if chaos_rows:
         headline["chaos_scenarios"] = len(chaos_rows)
-        headline["chaos_view_p99_ms_worst"] = max(
-            (r["view_completion_p99_ms"] or 0) for r in chaos_rows)
         headline["chaos_strict_ok"] = all(r["trace_strict_ok"]
                                           for r in chaos_rows)
+    live_chaos = [r for n, r in rows.items()
+                  if n not in ("clean", "churn", "replay_catchup")]
+    if live_chaos:
+        headline["chaos_view_p99_ms_worst"] = max(
+            (r["view_completion_p99_ms"] or 0) for r in live_chaos)
     headline["span_dir"] = span_dir
     print(json.dumps({"headline": headline}), flush=True)
 
@@ -300,9 +471,17 @@ def main() -> None:
                          "BENCH_r*.json")
     ap.add_argument("--scenarios", default=None,
                     help="comma-separated subset (default: all)")
+    ap.add_argument("--io-impl", default=None,
+                    choices=("asyncio", "uring", "both"),
+                    help="run the durable-replay io A/B over real TCP "
+                         "with this impl (the Memory-transport scenarios "
+                         "never touch the io engine, so only this row "
+                         "carries an io_impl label; an unavailable "
+                         "kernel yields a skipped row)")
     args = ap.parse_args()
     scenarios = args.scenarios.split(",") if args.scenarios else None
-    asyncio.run(amain(args.quick, args.out_json, scenarios))
+    asyncio.run(amain(args.quick, args.out_json, scenarios,
+                      io_impl=args.io_impl))
 
 
 if __name__ == "__main__":

@@ -1359,7 +1359,7 @@ def write_bench_json(path: str, section: str, headline: dict,
                 doc = json.load(fh)
         except (OSError, ValueError):
             doc = {}
-    doc.setdefault("round", 15)
+    doc.setdefault("round", 16)
     from pushcdn_tpu.testing.provenance import provenance
     doc[section] = {"headline": headline, "rows": rows,
                     "provenance": provenance()}

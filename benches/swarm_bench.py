@@ -228,6 +228,23 @@ async def amain(args) -> int:
     from pushcdn_tpu.proto.transport import Tcp
     from pushcdn_tpu.testing.provenance import provenance
 
+    # io-impl selection rides the env into every child (brokers, the
+    # marshal, the client packs — spawn_binary and Pack both inherit
+    # os.environ) AND the in-process publisher: the whole soak then runs
+    # on one data plane. An explicit uring ask on a kernel that denies it
+    # SKIPS the run rather than mislabeling an asyncio soak.
+    io_impl = None
+    if args.io_impl:
+        from pushcdn_tpu.native import uring as nuring
+        from pushcdn_tpu.proto.transport import uring as umod
+        if args.io_impl == "uring" and not nuring.available():
+            log(f"SKIPPED: --io-impl uring requested but io_uring is "
+                f"unavailable ({nuring.probe_errname()})")
+            return 0
+        umod.set_io_impl(args.io_impl)
+        io_impl = umod.resolve_io_impl()
+        log(f"io-impl: {io_impl} (requested {args.io_impl})")
+
     logdir = tempfile.mkdtemp(prefix="pushcdn-swarm-")
     db = os.path.join(logdir, "cdn.sqlite")
     bp = args.base_port or pick_base_port()
@@ -472,6 +489,8 @@ async def amain(args) -> int:
             "storm_conns_per_s": round(storm["established"] / storm_s, 1),
             "storm_conn_p99_ms": round(max(conn_p99s), 1),
         }
+        if io_impl is not None:
+            headline["io_impl"] = io_impl
         rows = [{"phase": "baseline", "delivered_per_s":
                  round(delivered_per_s, 1)},
                 {"phase": "drain", "target": target,
@@ -551,10 +570,15 @@ def main() -> int:
     ap.add_argument("--storm-wait-s", type=float, default=None)
     ap.add_argument("--settle-s", type=float, default=2.0)
     ap.add_argument("--base-port", type=int, default=0)
+    ap.add_argument("--io-impl", default=None,
+                    choices=("auto", "uring", "asyncio"),
+                    help="pin the TCP data plane for the whole soak "
+                         "(brokers, marshal, packs, publisher); uring on "
+                         "a denying kernel SKIPS instead of mislabeling")
     ap.add_argument("--json", default=None, metavar="PATH",
                     help="merge the swarm_soak section into this "
                          "BENCH_r*.json (relative to the repo root)")
-    ap.add_argument("--round", type=int, default=14)
+    ap.add_argument("--round", type=int, default=16)
     args = ap.parse_args()
 
     defaults = {

@@ -123,6 +123,7 @@ class Broker:
         self._metrics_server = None
         self.device_plane = None
         self.shard_runtime = None  # ShardRuntime when this is one of N workers
+        self.durable = None  # DurableTopics, set in new() (ISSUE 14)
         self.seen_dialing: set[str] = set()  # peers we're currently dialing
         # readiness state (ISSUE 5): listeners-bound latch, cached
         # discovery probe (refreshed by the heartbeat task and, past the
@@ -159,6 +160,11 @@ class Broker:
         # shedding; env-configured, disabled by default
         from pushcdn_tpu.broker.admission import AdmissionControl
         self.admission = AdmissionControl(self)
+        # durable topics (ISSUE 14): retention rings + replay subscribe +
+        # wildcard namespace; env-configured, retention disabled by default
+        # (wildcard SubscribeFrom works either way)
+        from pushcdn_tpu.broker.retention import DurableTopics
+        self.durable = DurableTopics.from_env(self)
 
         # The observability endpoint comes up BEFORE the listeners bind:
         # /readyz must be probe-able (and false) during startup, so an
@@ -375,6 +381,9 @@ class Broker:
             "cutthrough": state.summary() if state is not None else None,
             "admission": (self.admission.summary()
                           if self.admission is not None else None),
+            "durable": (self.durable.stats()
+                        if self.durable is not None and self.durable.enabled
+                        else None),
         }
 
     # -- supervision --------------------------------------------------------
@@ -444,6 +453,8 @@ class Broker:
         if self._tasks:
             await asyncio.gather(*self._tasks, return_exceptions=True)
         self.connections.remove_all()
+        if self.durable is not None:
+            self.durable.close()
         if self.shard_runtime is not None:
             self.shard_runtime.close()
             self.shard_runtime = None

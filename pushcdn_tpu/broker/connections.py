@@ -399,6 +399,29 @@ class Connections:
     # worker processes; these never re-emit to the shard bus (the parent
     # hub already fans deltas to every other worker).
 
+    def add_remote_user_interest(self, public_key: UserPublicKey,
+                                 shard: int, topics: List[Topic]) -> None:
+        """ADDITIVE sibling-shard interest row (durable replay handover,
+        ISSUE 14): the owner shard applying a ``durable_sub`` must see the
+        user's interest BEFORE it snapshots the retention ring, ahead of
+        the authoritative full-list "user" delta still in flight on the
+        bus. Unlike :meth:`set_remote_user` this never clears existing
+        associations (that would open a drop window for the user's other
+        topics) and never evicts a local connection. A local user takes
+        the ordinary subscribe path instead."""
+        if public_key in self.users:
+            self.subscribe_user_to(public_key, list(topics))
+            return
+        if not topics:
+            return
+        self.interest_version += 1
+        self.remote_user_shard.setdefault(public_key, shard)
+        self.user_topics.associate_key_with_values(public_key, list(topics))
+        if self.shard_id == 0:
+            self.direct_map.insert(public_key, self.identity)
+            self._log_route("dmap", public_key)
+        self._log_route("user", public_key)
+
     def set_remote_user(self, public_key: UserPublicKey, shard: int,
                         topics: List[Topic]) -> None:
         """A sibling shard owns (or re-announced) this user. Evicts any

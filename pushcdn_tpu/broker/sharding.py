@@ -267,6 +267,14 @@ class ShardRuntime:
                 for e in [e for e in unacked if e <= epoch]:
                     del unacked[e]
             return
+        if kind in ("durable_pub", "durable_retain", "durable_sub"):
+            # durable-topic data plane (ISSUE 14): owner-shard retention /
+            # replay traffic — like relay, kept out of the interest-delta
+            # counters
+            durable = getattr(self.broker, "durable", None)
+            if durable is not None:
+                durable.apply_shard_event(event)
+            return
         if kind not in ("user", "user_del", "usersync", "mesh_topics",
                         "mesh_broker_del"):
             logger.warning("unknown shard delta %r from shard %d",

@@ -61,6 +61,7 @@ from pushcdn_tpu.proto.message import (
     Broadcast,
     Direct,
     Subscribe,
+    SubscribeFrom,
     TopicSync,
     Unsubscribe,
     UserSync,
@@ -125,6 +126,14 @@ def acquire(broker: "Broker", hook) -> Optional["RouteState"]:
     if impl not in ("auto", "native"):
         return None
     if hook is not no_hook or broker.device_plane is not None:
+        return None
+    durable = broker.durable
+    if durable is not None and durable.enabled \
+            and broker.connections.num_shards > 1:
+        # sharded durable topics route scalar: the owner shard's ordered
+        # drainer pins the replay-vs-live handover, and a chunk plan's
+        # egress would bypass it (unsharded durable brokers keep the
+        # cut-through plane — the retention scan rides the plan seam)
         return None
     state = getattr(broker, "_route_state", None)
     if state is None:
@@ -699,9 +708,18 @@ class RouteState:
             a0 = egress.appended
             pruned, _bad = topics_space.prune(message.topics)
             if pruned:
-                route_broadcast(broker, pruned, raw,
-                                to_users_only=not is_user, egress=egress,
-                                interest_cache=interest_cache)
+                # durable stamp rides the same synchronous block as the
+                # route decision (scalar-twin parity with handlers.py);
+                # on_publish always returns True here — acquire() routes
+                # sharded durable brokers scalar, so this plane only sees
+                # the unsharded retain-and-route-normally case
+                durable = broker.durable
+                if durable is None or durable.on_publish(
+                        pruned, message, raw, to_users_only=not is_user):
+                    route_broadcast(broker, pruned, raw,
+                                    to_users_only=not is_user,
+                                    egress=egress,
+                                    interest_cache=interest_cache)
             if tr is not None:
                 if egress.appended > a0:
                     trace_mod.emit("plan", tr, "residual")
@@ -724,6 +742,16 @@ class RouteState:
             else:
                 pruned, _bad = topics_space.prune(message.topics)
                 broker.connections.unsubscribe_user_from(sender_id, pruned)
+        elif is_user and isinstance(message, SubscribeFrom):
+            # durable replay subscribe (ISSUE 14), scalar-twin parity
+            adm = broker.admission
+            if adm is not None and not adm.allow_subscribe(conn):
+                adm.shed_subscribe(sender_id, conn, egress)
+            else:
+                durable = broker.durable
+                if durable is None or not durable.handle_subscribe_from(
+                        sender_id, message, conn):
+                    return False
         elif not is_user and isinstance(message, UserSync):
             broker.connections.apply_user_sync(message.payload)
             broker.update_metrics()
@@ -863,6 +891,15 @@ class RouteState:
                     metrics_mod.ROUTE_BATCH_SIZE.observe(consumed)
                     metrics_mod.ROUTE_CUTTHROUGH_FRAMES.inc(consumed)
                     self._frames_since_rebuild += consumed
+                    # durable retention seam (ISSUE 14): stamp the consumed
+                    # broadcasts in the same synchronous region as the plan
+                    # (before the first egress await), so a SubscribeFrom
+                    # landing mid-send sees exactly the planned frames in
+                    # its replay snapshot — no gap, no dup
+                    durable = self.broker.durable
+                    if durable is not None and durable.topics:
+                        durable.retain_from_chunk(buf, offs, lens, pos,
+                                                  consumed)
                     await self._send_plan(chunk, offs, lens, peers, frames)
                 pos += consumed
                 if stop == routeplan.STOP_END:

@@ -39,6 +39,7 @@ from pushcdn_tpu.proto.message import (
     Broadcast,
     Direct,
     Subscribe,
+    SubscribeFrom,
     TopicSync,
     Unsubscribe,
     UserSync,
@@ -524,6 +525,15 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                     elif isinstance(message, Broadcast):
                         pruned, _bad = topics.prune(message.topics)
                         if pruned:
+                            # durable topics (ISSUE 14): retention stamp in
+                            # the same synchronous block as the route
+                            # decision; a False return means the owning
+                            # shard fans out through its ordered drainer
+                            durable = broker.durable
+                            if durable is not None and not durable.on_publish(
+                                    pruned, message, raw,
+                                    to_users_only=False):
+                                continue
                             if device is not None:
                                 stage_items.append((message, raw, pruned))
                                 continue
@@ -560,6 +570,21 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                         pruned, _bad = topics.prune(message.topics)
                         broker.connections.unsubscribe_user_from(public_key,
                                                                  pruned)
+                    elif isinstance(message, SubscribeFrom):
+                        # durable replay subscribe (ISSUE 14): registration
+                        # + ring snapshot + replay enqueue in one
+                        # synchronous block (the handover invariant)
+                        adm = broker.admission
+                        if adm is not None and \
+                                not adm.allow_subscribe(connection):
+                            adm.shed_subscribe(public_key, connection,
+                                               egress)
+                            continue
+                        durable = broker.durable
+                        if durable is None or not durable.handle_subscribe_from(
+                                public_key, message, connection):
+                            alive = False
+                            break
                     else:
                         # users may not send auth or sync messages
                         # post-handshake
@@ -694,6 +719,14 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                         # (broker/handler.rs:156-161)
                         pruned, _bad = topics.prune(message.topics)
                         if pruned:
+                            # mesh-forwarded durable broadcasts are retained
+                            # here too, so a user rejoining at THIS broker
+                            # replays mesh-wide history (seqs broker-local)
+                            durable = broker.durable
+                            if durable is not None and not durable.on_publish(
+                                    pruned, message, raw,
+                                    to_users_only=True):
+                                continue
                             if single_shard:
                                 stage_items.append((message, raw, pruned))
                                 continue

@@ -71,13 +71,19 @@ class TestDefinition:
     # forwarding path then crosses real sockets on both ends while the
     # broker internals stay identical
     tcp_users: bool = False
+    # widen the topic space (wildcard/durable scenarios) or shrink the
+    # byte pool (pool-pressure scenarios); None = harness defaults
+    topics: Optional[object] = None
+    pool_bytes: Optional[int] = None
 
     async def run(self) -> "TestRun":
         uid = next(_UNIQUE)
         db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-test-"),
                           "discovery.sqlite")
+        pool_kw = ({"global_memory_pool_size": self.pool_bytes}
+                   if self.pool_bytes is not None else {})
         config = BrokerConfig(
-            run_def=testing_run_def(),
+            run_def=testing_run_def(topics=self.topics),
             keypair=DEFAULT_SCHEME.generate_keypair(seed=uid),
             discovery_endpoint=db,
             public_advertise_endpoint=f"test-pub-{uid}",
@@ -88,6 +94,7 @@ class TestDefinition:
             # keep periodic tasks out of the way for determinism
             heartbeat_interval_s=3600, sync_interval_s=3600,
             whitelist_interval_s=3600,
+            **pool_kw,
         )
         broker = await Broker.new(config)
         await broker.start()

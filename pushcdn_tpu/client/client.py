@@ -34,12 +34,15 @@ from pushcdn_tpu.proto.error import Error, ErrorKind, bail
 from pushcdn_tpu.proto.limiter import Limiter, NO_LIMIT
 from pushcdn_tpu.proto.auth import user as user_auth
 from pushcdn_tpu.proto.message import (
+    SEQ_LAST,
+    SEQ_LIVE,
     AuthenticateResponse,
     Broadcast,
     Direct,
     Message,
     Migrate,
     Subscribe,
+    SubscribeFrom,
     Unsubscribe,
     deserialize_owned,
     serialize,
@@ -508,6 +511,52 @@ class Client:
             self._disconnect_on_error()
             bail(ErrorKind.CONNECTION, "subscribe failed", exc)
         self._topics.update(new)
+
+    async def subscribe_from(self, topic: int, seq: int = 0) -> None:
+        """Durable replay subscribe (ISSUE 14): subscribe to ``topic`` AND
+        replay every retained broadcast with sequence ``>= seq`` as
+        ``Retained`` frames ahead of the live stream (gap-free, dup-free —
+        see broker/retention.py). ``seq=0`` replays everything the broker
+        still retains; :data:`SEQ_LAST` fetches only the last-value-cache
+        entry; :data:`SEQ_LIVE` degrades to a plain subscribe.
+
+        Retained frames surface as typed ``Retained`` messages from the
+        receive calls. Sequence numbers are broker-local: after a re-home
+        to a different broker, resume with ``seq=0`` or ``SEQ_LAST`` (the
+        reconnect handshake replays only a plain ``Subscribe``)."""
+        conn = await self._get_connection()
+        try:
+            await conn.send_message(SubscribeFrom(topic=topic, seq=seq),
+                                    flush=True)
+        except Exception as exc:
+            self._disconnect_on_error()
+            bail(ErrorKind.CONNECTION, "subscribe_from failed", exc)
+        self._topics.add(topic)
+
+    async def last_value(self, topic: int) -> None:
+        """Fetch ``topic``'s last-value-cache entry (and subscribe): sugar
+        for ``subscribe_from(topic, SEQ_LAST)``. The LVC frame arrives as
+        a ``Retained`` message on the next receive call (nothing arrives
+        when the broker retains nothing for the topic)."""
+        await self.subscribe_from(topic, SEQ_LAST)
+
+    async def subscribe_pattern(self, pattern: str,
+                                seq: int = SEQ_LIVE) -> None:
+        """Hierarchical wildcard subscribe (``consensus.view.*``): the
+        broker compiles the pattern against its topic namespace into plain
+        per-topic subscriptions and keeps the union live as names bind and
+        unbind. ``seq`` other than :data:`SEQ_LIVE` additionally replays
+        retained frames for every covered durable topic. The local topic
+        mirror is NOT updated (coverage is broker-side state), so a
+        re-home requires re-sending the pattern."""
+        conn = await self._get_connection()
+        try:
+            await conn.send_message(
+                SubscribeFrom(topic=0, seq=seq, pattern=pattern),
+                flush=True)
+        except Exception as exc:
+            self._disconnect_on_error()
+            bail(ErrorKind.CONNECTION, "subscribe_pattern failed", exc)
 
     async def unsubscribe(self, topics: List[int]) -> None:
         if self._topics_dirty:

@@ -202,7 +202,31 @@ class MemoryPool:
         # latency proxy: permit alloc→release lifetimes (metrics hook)
         self.latency_samples: list[float] = []
         self._latency_cap = 4096
+        # pressure hooks (durable-topic retention, ISSUE 14): callables
+        # fn(deficit_bytes) invoked synchronously when an allocation is
+        # about to wait — a holder of idle permits (retention leases) can
+        # give them back so a blocked reader never deadlocks against
+        # passively-held reservations
+        self._reclaimers: list = []
         LIVE_POOLS.add(self)
+
+    def add_reclaimer(self, fn) -> None:
+        self._reclaimers.append(fn)
+
+    def remove_reclaimer(self, fn) -> None:
+        try:
+            self._reclaimers.remove(fn)
+        except ValueError:
+            pass
+
+    def _run_reclaimers(self, deficit: int) -> None:
+        for fn in list(self._reclaimers):
+            try:
+                fn(deficit)
+            except Exception:  # a broken hook must not wedge the reader
+                pass
+            if self._sem.available >= deficit >= 0:
+                break
 
     async def allocate(self, nbytes: int) -> AllocationPermit:
         """Reserve ``nbytes``; blocks (backpressuring the reader) until the
@@ -211,6 +235,13 @@ class MemoryPool:
         if nbytes > self.capacity:
             bail(ErrorKind.EXCEEDED_SIZE,
                  f"message of {nbytes} B exceeds pool capacity {self.capacity} B")
+        if self._sem.try_acquire(nbytes):
+            return AllocationPermit(self, nbytes)
+        # about to wait: let passive permit holders (retention) release
+        # before the reader blocks — "block the reader, not the router"
+        # must never become "wedge the reader behind idle leases"
+        if self._reclaimers:
+            self._run_reclaimers(nbytes)
         await self._sem.acquire(nbytes)
         return AllocationPermit(self, nbytes)
 

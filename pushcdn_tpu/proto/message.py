@@ -48,6 +48,14 @@ KIND_UNSUBSCRIBE = 7
 KIND_USER_SYNC = 8
 KIND_TOPIC_SYNC = 9
 KIND_MIGRATE = 10
+KIND_SUBSCRIBE_FROM = 11
+KIND_RETAINED = 12
+
+# sequence sentinels for SubscribeFrom (durable topics, ISSUE 14): the
+# top of the u64 range can never be a real retention sequence (rings
+# count up from 1), so the last two values select replay modes instead
+SEQ_LAST = 2**64 - 1     # replay only the last-value-cache entry
+SEQ_LIVE = 2**64 - 2     # no replay: subscribe-only (wildcard patterns)
 
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
@@ -310,6 +318,46 @@ class Migrate:
     kind = KIND_MIGRATE
 
 
+@dataclass(frozen=True, slots=True)
+class SubscribeFrom:
+    """User → broker: subscribe to a durable ``topic`` AND replay its
+    retention ring from sequence ``seq`` (ISSUE 14 durable topics).
+
+    ``seq`` addresses the broker-local per-topic sequence stream stamped
+    at ingress: entries with ``entry.seq >= seq`` are replayed as
+    :class:`Retained` frames on the ordered egress path, then live
+    delivery splices in with no gap and no duplicate (the broker
+    registers the subscription and snapshots the ring in one synchronous
+    step). Sentinels: :data:`SEQ_LAST` replays only the last-value-cache
+    entry; :data:`SEQ_LIVE` skips replay entirely (used with
+    ``pattern``). A non-empty ``pattern`` is a hierarchical wildcard
+    (``consensus.view.*``) compiled broker-side onto the interest mask;
+    ``topic`` is ignored then. Backward compatible: kind 11 was unused —
+    old peers fall through cold-kind decode to the documented
+    unexpected-kind disconnect, exactly like PR 12's ``Migrate``.
+    """
+
+    topic: int
+    seq: int = 0
+    pattern: str = ""
+
+    kind = KIND_SUBSCRIBE_FROM
+
+
+@dataclass(frozen=True, slots=True)
+class Retained:
+    """Broker → user: one replayed retention entry — ``payload`` is the
+    original broadcast body, ``seq`` its broker-local position in
+    ``topic``'s sequence stream. Payload-last layout, so decode is
+    zero-copy like Direct/Broadcast."""
+
+    topic: int
+    seq: int
+    payload: BytesLike
+
+    kind = KIND_RETAINED
+
+
 Message = Union[
     AuthenticateWithKey,
     AuthenticateWithPermit,
@@ -321,6 +369,8 @@ Message = Union[
     UserSync,
     TopicSync,
     Migrate,
+    SubscribeFrom,
+    Retained,
 ]
 
 _ALL_KINDS = {
@@ -334,6 +384,8 @@ _ALL_KINDS = {
     KIND_USER_SYNC,
     KIND_TOPIC_SYNC,
     KIND_MIGRATE,
+    KIND_SUBSCRIBE_FROM,
+    KIND_RETAINED,
 }
 
 
@@ -392,6 +444,12 @@ def serialize(msg: Message) -> bytes:
         elif kind == KIND_MIGRATE:
             tgt = msg.target.encode("utf-8")
             frame = bytes([kind]) + _U64.pack(msg.permit) + _U32.pack(len(tgt)) + tgt
+        elif kind == KIND_SUBSCRIBE_FROM:
+            pat = msg.pattern.encode("utf-8")
+            frame = (bytes([kind, msg.topic]) + _U64.pack(msg.seq) + pat)
+        elif kind == KIND_RETAINED:
+            frame = b"".join((bytes([kind, msg.topic]),
+                              _U64.pack(msg.seq), msg.payload))
         else:  # pragma: no cover - unreachable with the Message union
             bail(ErrorKind.SERIALIZE, f"unknown message kind {kind}")
     except (struct.error, ValueError) as exc:
@@ -485,6 +543,21 @@ def deserialize(frame: BytesLike) -> Message:
             except UnicodeDecodeError as exc:
                 bail(ErrorKind.DESERIALIZE, "Migrate target is not UTF-8", exc)
             return Migrate(target=target, permit=permit)
+        if kind == KIND_SUBSCRIBE_FROM:
+            if n < 10:
+                bail(ErrorKind.DESERIALIZE, "SubscribeFrom truncated")
+            (seq,) = _U64.unpack_from(view, 2)
+            try:
+                pattern = bytes(view[10:]).decode("utf-8")
+            except UnicodeDecodeError as exc:
+                bail(ErrorKind.DESERIALIZE,
+                     "SubscribeFrom pattern is not UTF-8", exc)
+            return SubscribeFrom(topic=view[1], seq=seq, pattern=pattern)
+        if kind == KIND_RETAINED:
+            if n < 10:
+                bail(ErrorKind.DESERIALIZE, "Retained truncated")
+            (seq,) = _U64.unpack_from(view, 2)
+            return Retained(topic=view[1], seq=seq, payload=view[10:])
         if kind in _TRACED_HOT:
             # traced hot frame: 16- or 20-byte trace block (view-tagged)
             # after the kind byte, then the ordinary layout (rare by
@@ -530,6 +603,8 @@ def materialize(msg: Message) -> Message:
     if kind in (KIND_USER_SYNC, KIND_TOPIC_SYNC) and isinstance(msg.payload, memoryview):
         cls = UserSync if kind == KIND_USER_SYNC else TopicSync
         return cls(payload=bytes(msg.payload))
+    if kind == KIND_RETAINED and isinstance(msg.payload, memoryview):
+        return Retained(topic=msg.topic, seq=msg.seq, payload=bytes(msg.payload))
     return msg
 
 

@@ -108,4 +108,132 @@ class TopicSpace:
         return mask
 
 
+class TopicNamespace:
+    """Hierarchical names over the integer topic space (durable topics,
+    ISSUE 14): ``consensus.view.3`` binds to one wire-level u8 topic, and
+    a wildcard pattern (``consensus.view.*``) compiles to the set of
+    bound topics it covers.
+
+    Wildcards never reach the route planes: a wildcard subscription is
+    resolved here into plain per-topic interest-mask updates, and a
+    *watch* keeps it live — every later :meth:`bind` / :meth:`unbind`
+    fires the watch callbacks, so the union is maintained incrementally
+    (the same shape as RaggedInterest page maintenance). The native
+    route-plan kernel and the scalar/sharded twins only ever see the
+    compiled mask.
+
+    Pattern grammar: dot-separated segments; ``*`` matches exactly one
+    segment, except a FINAL ``*`` which matches one or more trailing
+    segments (so ``consensus.view.*`` covers ``consensus.view.3`` and
+    ``consensus.view.3.retry``).
+    """
+
+    __slots__ = ("space", "_by_name", "_by_topic", "_watches", "_next_watch")
+
+    def __init__(self, space: TopicSpace | None = None):
+        self.space = space
+        self._by_name: dict[str, int] = {}
+        self._by_topic: dict[int, str] = {}
+        # watch id -> (pattern segments, on_add, on_remove)
+        self._watches: dict[int, tuple] = {}
+        self._next_watch = 0
+
+    # -- binding --------------------------------------------------------
+
+    def bind(self, name: str, topic: int | None = None) -> int:
+        """Bind ``name`` to ``topic`` (auto-allocates the smallest free
+        valid topic when omitted). Idempotent for an identical re-bind;
+        a conflicting re-bind raises ``ValueError``. Fires matching
+        watches' ``on_add(name, topic)``."""
+        if not name or name != name.strip("."):
+            raise ValueError(f"invalid topic name {name!r}")
+        bound = self._by_name.get(name)
+        if bound is not None:
+            if topic is not None and topic != bound:
+                raise ValueError(
+                    f"{name!r} already bound to topic {bound}, not {topic}")
+            return bound
+        if topic is None:
+            universe = (sorted(self.space.valid) if self.space is not None
+                        else range(256))
+            for cand in universe:
+                if cand not in self._by_topic:
+                    topic = cand
+                    break
+            else:
+                raise ValueError("topic space exhausted")
+        else:
+            if self.space is not None and topic not in self.space.valid:
+                raise ValueError(f"topic {topic} outside the topic space")
+            other = self._by_topic.get(topic)
+            if other is not None:
+                raise ValueError(f"topic {topic} already bound to {other!r}")
+        self._by_name[name] = topic
+        self._by_topic[topic] = name
+        segs = name.split(".")
+        for pat, on_add, _ in list(self._watches.values()):
+            if on_add is not None and self._segs_match(pat, segs):
+                on_add(name, topic)
+        return topic
+
+    def unbind(self, name: str) -> None:
+        """Drop a binding; fires matching watches' ``on_remove``."""
+        topic = self._by_name.pop(name, None)
+        if topic is None:
+            return
+        del self._by_topic[topic]
+        segs = name.split(".")
+        for pat, _, on_remove in list(self._watches.values()):
+            if on_remove is not None and self._segs_match(pat, segs):
+                on_remove(name, topic)
+
+    def topic_of(self, name: str):
+        return self._by_name.get(name)
+
+    def name_of(self, topic: int):
+        return self._by_topic.get(topic)
+
+    def bindings(self) -> dict[str, int]:
+        return dict(self._by_name)
+
+    # -- wildcard compilation -------------------------------------------
+
+    @staticmethod
+    def _segs_match(pat: list, segs: list) -> bool:
+        np = len(pat)
+        if np == 0:
+            return False
+        tail_glob = pat[-1] == "*"
+        if tail_glob:
+            if len(segs) < np:           # final * eats one-or-more
+                return False
+        elif len(segs) != np:
+            return False
+        for p, s in zip(pat[:-1] if tail_glob else pat, segs):
+            if p != "*" and p != s:
+                return False
+        return True
+
+    def match(self, pattern: str) -> tuple:
+        """Compile ``pattern`` to the sorted tuple of bound topics it
+        covers right now (a plain name is its own 1-element pattern)."""
+        pat = pattern.split(".")
+        return tuple(sorted(
+            t for n, t in self._by_name.items()
+            if self._segs_match(pat, n.split("."))))
+
+    # -- live watches ---------------------------------------------------
+
+    def watch(self, pattern: str, on_add=None, on_remove=None) -> int:
+        """Register callbacks fired on every future bind/unbind matching
+        ``pattern``; returns a handle for :meth:`unwatch`."""
+        self._next_watch += 1
+        self._watches[self._next_watch] = (pattern.split("."),
+                                           on_add, on_remove)
+        return self._next_watch
+
+    def unwatch(self, handle: int) -> None:
+        self._watches.pop(handle, None)
+
+
 TEST_TOPIC_SPACE = TopicSpace.from_enum(TestTopic)

@@ -32,7 +32,7 @@ from typing import Awaitable, Callable, Dict, List, Optional
 
 from pushcdn_tpu.proto import trace as trace_mod
 from pushcdn_tpu.proto.error import Error, ErrorKind
-from pushcdn_tpu.proto.message import Broadcast, Direct
+from pushcdn_tpu.proto.message import Broadcast, Direct, Retained
 from pushcdn_tpu.proto.transport.memory import (LinkShape, Memory,
                                                 shaped_memory)
 
@@ -111,6 +111,7 @@ class ConsensusRun:
     proposals_sent: int = 0
     votes_sent: int = 0
     sheds: int = 0
+    replayed_proposals: int = 0   # Retained catch-up frames (ISSUE 14)
 
     @property
     def completed(self) -> int:
@@ -172,6 +173,10 @@ class ConsensusDriver:
         self._quorum_events: Dict[int, asyncio.Event] = {}
         self._view_sent_ns: Dict[int, int] = {}
         self._stopping = False
+        # node index -> highest view whose proposal the node has seen
+        # LIVE (replay_catchup chaos drops a node only once it has voted
+        # the current view, so the drop never orphans a traced frame)
+        self.last_view_seen: Dict[int, int] = {}
 
     # -- lifecycle ------------------------------------------------------
 
@@ -209,6 +214,35 @@ class ConsensusDriver:
                 pass
         for c in self.clients:
             c.close()
+
+    async def drop_node(self, i: int) -> None:
+        """Hard-drop node ``i`` mid-run (replay_catchup chaos): cancel
+        its loop and close its client — no elastic re-dial. The node
+        stops receiving and voting until :meth:`rejoin_node`."""
+        t = self._loops[i]
+        t.cancel()
+        try:
+            await t
+        except (asyncio.CancelledError, Exception):
+            pass
+        self.clients[i].close()
+
+    async def rejoin_node(self, i: int, from_seq: int = 1) -> None:
+        """Re-home node ``i`` on a FRESH client and catch it up through
+        the durable replay path (ISSUE 14): ``subscribe_from(topic,
+        from_seq)`` replays every retained proposal as ``Retained``
+        frames, then live delivery splices in gap-free — so a view in
+        flight at rejoin time can still reach quorum on the rejoined
+        nodes' replayed votes. Requires the serving broker to retain
+        ``cfg.topic`` (``PUSHCDN_RETAIN_TOPICS``)."""
+        cfg = self.cfg
+        c = self.cluster.client(seed=cfg.client_seed_base + i, topics=[],
+                                protocol=cfg.node_protocol(i))
+        c._sampler.every = 1 if cfg.trace else 0
+        await c.ensure_initialized()
+        await c.subscribe_from(cfg.topic, from_seq)
+        self.clients[i] = c
+        self._loops[i] = asyncio.ensure_future(self._node_loop(i, c))
 
     # -- the view loop --------------------------------------------------
 
@@ -274,13 +308,23 @@ class ConsensusDriver:
                 continue
             now = time.time_ns()
             for m in msgs:
-                data = bytes(m.message) if m.message is not None else b""
-                if isinstance(m, Broadcast) and data[:1] == b"P":
+                body = m.payload if isinstance(m, Retained) else m.message
+                data = bytes(body) if body is not None else b""
+                if isinstance(m, (Broadcast, Retained)) and \
+                        data[:1] == b"P":
                     (view,) = _U32.unpack_from(data, 1)
-                    sent = self._view_sent_ns.get(view)
-                    if sent is not None:
-                        self.result.proposal_delivery_s.append(
-                            (now - sent) / 1e9)
+                    if isinstance(m, Retained):
+                        # replayed catch-up: vote (a view in flight at
+                        # rejoin completes on these), but keep the live
+                        # delivery SLO samples honest
+                        self.result.replayed_proposals += 1
+                    else:
+                        sent = self._view_sent_ns.get(view)
+                        if sent is not None:
+                            self.result.proposal_delivery_s.append(
+                                (now - sent) / 1e9)
+                        self.last_view_seen[idx] = max(
+                            view, self.last_view_seen.get(idx, -1))
                     await self._send_vote(idx, client, view)
                 elif isinstance(m, Direct) and data[:1] == b"V":
                     view, node = _VOTE.unpack_from(data, 1)
@@ -314,12 +358,20 @@ class ConsensusDriver:
 
 async def run_consensus(cluster, config: ConsensusConfig,
                         chaos: Optional[Dict[int, ChaosHook]] = None,
-                        drain_s: float = 2.0) -> ConsensusRun:
+                        drain_s: float = 2.0,
+                        driver_chaos=None) -> ConsensusRun:
     """start → run → drain → stop, returning the run stats. The drain
     waits (bounded) for in-flight traced messages to finish delivering so
     the span log closes every chain — ``trace_report --strict``'s
-    zero-orphan gate needs quiescence, not a mid-flight teardown."""
+    zero-orphan gate needs quiescence, not a mid-flight teardown.
+
+    ``driver_chaos`` is the driver-aware twin of ``chaos``: a factory
+    ``fn(driver) -> {view: hook}`` for chaos that manipulates the nodes
+    themselves (drop/rejoin) rather than the cluster."""
     driver = ConsensusDriver(cluster, config, chaos=chaos)
+    if driver_chaos is not None:
+        driver.chaos = dict(driver.chaos)
+        driver.chaos.update(driver_chaos(driver))
     await driver.start()
     try:
         result = await driver.run()

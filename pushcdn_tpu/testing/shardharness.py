@@ -86,7 +86,9 @@ async def run_sharded(
         connected_brokers: Sequence[Tuple[Sequence[int],
                                           Sequence[bytes]]] = (),
         ring_bytes: int = 256 * 1024,
-        tcp_users: bool = False) -> ShardTestRun:
+        tcp_users: bool = False,
+        topics=None,
+        pool_bytes: int | None = None) -> ShardTestRun:
     """Build the sharded twin of a ``TestDefinition`` run.
 
     ``user_shards[i] = (shard, topics)`` places injected user i (key
@@ -97,11 +99,13 @@ async def run_sharded(
     mirroring ``TestDefinition.tcp_users``."""
     uid = next(_UNIQUE)
     brokers: List[Broker] = []
+    pool_kw = ({"global_memory_pool_size": pool_bytes}
+               if pool_bytes is not None else {})
     for s in range(num_shards):
         db = os.path.join(tempfile.mkdtemp(prefix="pushcdn-shardtest-"),
                           "discovery.sqlite")
         config = BrokerConfig(
-            run_def=testing_run_def(),
+            run_def=testing_run_def(topics=topics),
             keypair=DEFAULT_SCHEME.generate_keypair(seed=uid),
             discovery_endpoint=db,
             # ONE identity across all shards; distinct bind endpoints so
@@ -113,6 +117,7 @@ async def run_sharded(
             heartbeat_interval_s=3600, sync_interval_s=3600,
             whitelist_interval_s=3600,
             shard_index=s, num_shards=num_shards,
+            **pool_kw,
         )
         brokers.append(await Broker.new(config))
     runtimes = attach_inprocess_shards(brokers, ring_bytes=ring_bytes)

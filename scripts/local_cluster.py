@@ -375,6 +375,141 @@ def check_load_shed(marshal_port: int, broker_ports: dict) -> bool:
     return asyncio.run(drive())
 
 
+def check_replay(marshal_port: int, broker_ports: dict) -> bool:
+    """--replay (ISSUE 14): durable catch-up through REAL processes —
+    publish on a retained topic, see one frame live, KILL the subscriber,
+    publish more into the ring, then rejoin on a fresh client with
+    ``subscribe_from(topic, 1)`` and assert every frame comes back as an
+    in-order ``Retained`` run followed by live delivery.
+
+    Retention is broker-local (seqs are per-broker), so the rejoining
+    client must land on a broker whose ring is complete: the marshal owns
+    placement, so we redial with fresh seeds until /debug/topology shows
+    co-location with the publisher (2 brokers — a couple of draws). The
+    replay clients run untraced: a broadcast retained with zero live
+    subscribers has no delivery span by design, and the strict
+    zero-orphan gate must stay meaningful for the echo traffic."""
+    import asyncio
+
+    from pushcdn_tpu.bin.common import keypair_from_seed
+    from pushcdn_tpu.client import Client, ClientConfig
+    from pushcdn_tpu.proto.message import Broadcast, Retained
+    from pushcdn_tpu.proto.transport.tcp import Tcp
+    from pushcdn_tpu.proto.util import mnemonic
+
+    K = 5
+    TOPIC = 1  # the echo client broadcasts on 0; topic 1's ring is ours
+
+    def mk(seed: int) -> Client:
+        c = Client(ClientConfig(
+            marshal_endpoint=f"127.0.0.1:{marshal_port}",
+            keypair=keypair_from_seed(seed), protocol=Tcp,
+            subscribed_topics=set()))
+        c._sampler.every = 0
+        return c
+
+    def home_of(key: bytes):
+        wanted = mnemonic(key)
+        for name, port in broker_ports.items():
+            res = http_get(port, "/debug/topology")
+            if res is None or res[0] != 200:
+                continue
+            try:
+                topo = json.loads(res[1])
+            except ValueError:
+                continue
+            if any(u.get("key") == wanted for u in topo.get("users", ())):
+                return name
+        return None
+
+    async def recv_stream(c: Client, want: int, deadline_s: float):
+        out = []
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + deadline_s
+        while len(out) < want and loop.time() < deadline:
+            try:
+                async with asyncio.timeout(
+                        max(0.05, deadline - loop.time())):
+                    msgs = await c.receive_messages()
+            except (TimeoutError, asyncio.TimeoutError):
+                break
+            for m in msgs:
+                if isinstance(m, Retained):
+                    out.append(("retained", m.seq, bytes(m.payload)))
+                elif isinstance(m, Broadcast):
+                    out.append(("live", None, bytes(m.message)))
+        return out
+
+    async def drive() -> bool:
+        pub = mk(96)
+        sub = mk(97)
+        rejoin = None
+        try:
+            async with asyncio.timeout(20):
+                await pub.ensure_initialized()
+            async with asyncio.timeout(20):
+                await sub.ensure_initialized()
+            await sub.subscribe([TOPIC])
+            await asyncio.sleep(0.8)   # interest propagates via the mesh
+            await pub.send_broadcast_message([TOPIC], b"replay-0")
+            first = await recv_stream(sub, 1, 10.0)
+            if first != [("live", None, b"replay-0")]:
+                print(f"[cluster] FAIL: pre-kill subscriber saw {first!r}")
+                return False
+            print("[cluster] replay phase 1: live frame delivered, "
+                  "killing the subscriber")
+            sub.close()
+            await asyncio.sleep(0.5)   # the broker reaps the connection
+            for i in range(1, K):
+                await pub.send_broadcast_message(
+                    [TOPIC], f"replay-{i}".encode())
+            pub_home = home_of(pub.public_key)
+            # rejoin CO-LOCATED with the publisher (complete ring)
+            for seed in range(98, 110):
+                rejoin = mk(seed)
+                try:
+                    async with asyncio.timeout(20):
+                        await rejoin.ensure_initialized()
+                except (TimeoutError, asyncio.TimeoutError):
+                    rejoin.close()
+                    rejoin = None
+                    continue
+                if pub_home is None or home_of(
+                        rejoin.public_key) == pub_home:
+                    break
+                rejoin.close()
+                rejoin = None
+            if rejoin is None:
+                print("[cluster] FAIL: could not co-locate the rejoin "
+                      "client with the publisher")
+                return False
+            await rejoin.subscribe_from(TOPIC, 1)
+            got = await recv_stream(rejoin, K, 15.0)
+            want = [("retained", i + 1, f"replay-{i}".encode())
+                    for i in range(K)]
+            if got != want:
+                print(f"[cluster] FAIL: replay stream {got!r} != {want!r}")
+                return False
+            print(f"[cluster] replay phase 2: {K} retained frames "
+                  "replayed in order (seqs 1..%d)" % K)
+            await pub.send_broadcast_message([TOPIC], b"replay-live")
+            tail = await recv_stream(rejoin, 1, 10.0)
+            if tail != [("live", None, b"replay-live")]:
+                print(f"[cluster] FAIL: post-replay live frame missing "
+                      f"({tail!r})")
+                return False
+            print("[cluster] replay OK: retained 1..%d then live, "
+                  "no gap, no dup" % K)
+            return True
+        finally:
+            pub.close()
+            sub.close()
+            if rejoin is not None:
+                rejoin.close()
+
+    return asyncio.run(drive())
+
+
 # ---------------------------------------------------------------------------
 # scripted chaos (--chaos): kill real processes mid-run and assert the
 # composition invariants — the data plane rides out control-plane loss,
@@ -870,6 +1005,11 @@ def main() -> int:
                          "verifies the typed shed Error, the /readyz "
                          "admission flip + flight-recorder event, and "
                          "recovery")
+    ap.add_argument("--replay", action="store_true",
+                    help="durable-topics check (ISSUE 14): brokers retain "
+                         "topic 1; publish, kill the subscriber, rejoin "
+                         "with subscribe_from and assert the in-order "
+                         "Retained catch-up + live handover")
     ap.add_argument("--rehome", action="store_true",
                     help="elastic drain (ISSUE 12): GET /drain on the "
                          "broker homing the echo client, verify every "
@@ -920,20 +1060,35 @@ def main() -> int:
     db = os.path.join(logdir, "cdn.sqlite")
     bp = args.base_port
     if bp == 0:
-        # bind one free port and take the following ~200 as the range —
-        # racy in principle, but ephemeral allocations are sparse and the
-        # components fail loudly on a collision. The range must ALSO cover
-        # each broker's per-shard worker metrics endpoints (parent port +
-        # 1 + shard), so a clamped pick near the top of the port space is
-        # re-drawn instead of silently colliding (ISSUE 6 satellite).
+        # pick the range BELOW the kernel's ephemeral floor: a listener
+        # inside the ephemeral range races the outgoing-port allocator
+        # (EADDRINUSE even with SO_REUSEADDR while a live connection —
+        # ours or another suite's — holds the port locally). Below the
+        # floor the kernel never hands the ports out, so only another
+        # explicit listener can collide; probe every offset the cluster
+        # derives (broker pub/priv, marshal, metrics blocks incl.
+        # per-shard worker endpoints at parent + 1 + shard) and redraw.
+        import random
         import socket
+        try:
+            with open("/proc/sys/net/ipv4/ip_local_port_range") as fh:
+                eph_lo = int(fh.read().split()[0])
+        except (OSError, ValueError, IndexError):
+            eph_lo = 32768
+        hi = max(10_001, min(eph_lo, 65_000) - 200)
+        offsets = [*range(0, 4), 50, *range(100, 143)]
         while True:
-            with socket.socket() as s:
-                s.bind(("127.0.0.1", 0))
-                candidate = s.getsockname()[1]
-            if candidate <= 65000 - 200:
-                bp = candidate
-                break
+            candidate = random.randrange(10_000, hi)
+            try:
+                for off in offsets:
+                    with socket.socket() as s:
+                        s.setsockopt(socket.SOL_SOCKET,
+                                     socket.SO_REUSEADDR, 1)
+                        s.bind(("127.0.0.1", candidate + off))
+            except OSError:
+                continue
+            bp = candidate
+            break
     # metrics layout: each broker parent gets a 20-port block so its
     # per-shard worker endpoints (parent + 1 + shard) never collide with
     # the next component even when both brokers spawn workers
@@ -954,6 +1109,8 @@ def main() -> int:
     def spawn_broker(i: int, first_boot: bool = False) -> subprocess.Popen:
         env = {**trace_env(f"broker{i}"),
                "PUSHCDN_DRAIN_GRACE_S": str(DRAIN_GRACE_S)}
+        if args.replay:
+            env["PUSHCDN_RETAIN_TOPICS"] = "1"
         if args.churn:
             # tiny per-connection subscribe budget so the churn driver
             # forces shedding quickly; the ready window is generous so
@@ -1086,6 +1243,11 @@ def main() -> int:
             # BEFORE the trace checks so trace_report --strict also
             # covers post-migration delivery chains
             ok = check_rehome(broker_ports, EchoWatch(client)) and ok
+        if args.replay:
+            # ---- durable topics (ISSUE 14): retained ring replay +
+            # live handover through real processes; BEFORE the trace
+            # checks so --strict also covers chains delivered alongside
+            ok = check_replay(bp + 50, broker_ports) and ok
         if args.shards > 1:
             # ---- sharded data plane (ISSUE 6): users on 2+ workers and
             # cross-shard directs carried by the handoff rings

@@ -62,6 +62,25 @@ def test_issue5_files_inside_lint_scope():
             f"{rel} is outside the ruff gate's scope {RUFF_SCOPE}"
 
 
+ISSUE14_FILES = [
+    # durable topics (ISSUE 14): retention rings + replay subscribe +
+    # wildcard namespace, the seeded handover/lease suite, and the
+    # consensus replay_catchup scenario wiring
+    "pushcdn_tpu/broker/retention.py",
+    "pushcdn_tpu/proto/topic.py",
+    "tests/test_retention.py",
+    "benches/consensus_bench.py",
+]
+
+
+def test_issue14_files_inside_lint_scope():
+    for rel in ISSUE14_FILES:
+        assert os.path.exists(os.path.join(REPO, rel)), rel
+        assert any(rel == scope or rel.startswith(scope + "/")
+                   for scope in RUFF_SCOPE), \
+            f"{rel} is outside the ruff gate's scope {RUFF_SCOPE}"
+
+
 def test_issue13_files_inside_lint_scope():
     for rel in ISSUE13_FILES:
         assert os.path.exists(os.path.join(REPO, rel)), rel

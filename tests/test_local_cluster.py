@@ -276,6 +276,35 @@ def test_local_cluster_rehome(tmp_path):
     assert "FAIL" not in out, out[-6000:]
 
 
+@pytest.mark.skipif(os.environ.get("PUSHCDN_SKIP_CLUSTER_TEST") == "1",
+                    reason="PUSHCDN_SKIP_CLUSTER_TEST=1")
+@pytest.mark.skipif(not _loopback_available(),
+                    reason="no loopback TCP in this sandbox")
+def test_local_cluster_replay(tmp_path):
+    """ISSUE 14: durable-topics catch-up against REAL broker processes —
+    publish on a retained topic, one frame delivered live, the
+    subscriber killed, more frames published into the ring, then a fresh
+    client rejoins with ``subscribe_from(topic, 1)`` and receives the
+    full history as an in-order ``Retained`` run followed by live
+    delivery (no gap, no dup); trace_report --strict still sees zero
+    orphans across the run."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    trace_dir = str(tmp_path / "spans")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--duration", "10", "--base-port", "0",
+         "--replay", "--trace-log", trace_dir],
+        env=env, capture_output=True, text=True, timeout=180)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"replay local_cluster failed:\n{out[-6000:]}"
+    assert "replay phase 1: live frame delivered" in out, out[-6000:]
+    assert "retained frames replayed in order" in out, out[-6000:]
+    assert "replay OK: retained 1..5 then live" in out, out[-6000:]
+    assert "trace report OK" in out, out[-6000:]
+    assert "0 orphaned spans" in out, out[-6000:]
+    assert "FAIL" not in out, out[-6000:]
+
+
 @pytest.mark.slow
 @pytest.mark.skipif(os.environ.get("PUSHCDN_SKIP_CLUSTER_TEST") == "1",
                     reason="PUSHCDN_SKIP_CLUSTER_TEST=1")

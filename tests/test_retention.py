@@ -1,0 +1,569 @@
+"""Durable topics (ISSUE 14): retention rings, replay subscribe,
+last-value cache, and wildcard interest.
+
+Five tiers:
+
+1. **Wire** — ``SubscribeFrom``/``Retained`` round-trips (serialize /
+   deserialize / materialize) and the sequence sentinels.
+2. **Namespace** — hierarchical name binding, wildcard compilation
+   (``*`` = exactly one segment, final ``*`` = one-or-more), and live
+   watches.
+3. **Rings** — count/bytes/age eviction, LVC survival past eviction,
+   snapshot addressing, and the pool-lease discipline: retained leases
+   NEVER deadlock pool-permit reclamation (the reclaimer materializes
+   oldest-first, synchronously), and the pooled clamp bounds idle leases
+   to a quarter of the pool.
+4. **Handover** — the acceptance property: a drop/rejoin via
+   ``SubscribeFrom`` receives the retained prefix then the live tail
+   with NO gap and NO duplicate, across both route impls and on a
+   2-shard worker group (cross-shard replay handoff + owner-drainer
+   ordering), with the byte pools balanced after shutdown.
+5. **Wildcards** — a pattern subscription compiles onto the interest
+   mask BIT-IDENTICALLY to the equivalent explicit topic set (native
+   plan fan-out compared frame by frame), and stays identical as
+   bindings come and go.
+"""
+
+import asyncio
+import gc
+
+import numpy as np
+import pytest
+
+from pushcdn_tpu.broker.tasks import cutthrough
+from pushcdn_tpu.broker.test_harness import TestDefinition
+from pushcdn_tpu.native import routeplan
+from pushcdn_tpu.proto.error import Error
+from pushcdn_tpu.proto.limiter import Bytes, Limiter
+from pushcdn_tpu.proto.message import (
+    SEQ_LAST,
+    SEQ_LIVE,
+    Broadcast,
+    Retained,
+    Subscribe,
+    SubscribeFrom,
+    deserialize,
+    deserialize_owned,
+    serialize,
+)
+from pushcdn_tpu.proto.topic import TopicNamespace, TopicSpace
+
+ROUTE_IMPLS = [
+    "python",
+    pytest.param("native", marks=pytest.mark.skipif(
+        not routeplan.available(),
+        reason="native route-plan kernel unavailable")),
+]
+
+
+@pytest.fixture(autouse=True)
+def _route_impl_state():
+    saved = cutthrough.ROUTE_IMPL
+    yield
+    cutthrough.ROUTE_IMPL = saved
+
+
+# ---------------------------------------------------------------------------
+# tier 1: wire round-trips
+# ---------------------------------------------------------------------------
+
+def test_subscribe_from_round_trip():
+    for msg in (SubscribeFrom(topic=7, seq=0),
+                SubscribeFrom(topic=0, seq=SEQ_LAST),
+                SubscribeFrom(topic=3, seq=SEQ_LIVE, pattern="a.b.*"),
+                SubscribeFrom(topic=255, seq=2**63, pattern="x")):
+        raw = serialize(msg)
+        for decode in (deserialize, deserialize_owned):
+            got = decode(raw)
+            assert isinstance(got, SubscribeFrom)
+            assert (got.topic, got.seq, got.pattern) == \
+                (msg.topic, msg.seq, msg.pattern)
+
+
+def test_retained_round_trip():
+    for payload in (b"", b"x", b"y" * 70_000):
+        raw = serialize(Retained(topic=9, seq=41, payload=payload))
+        got = deserialize(raw)
+        assert isinstance(got, Retained)
+        assert (got.topic, got.seq, bytes(got.payload)) == (9, 41, payload)
+        owned = deserialize_owned(raw)
+        assert bytes(owned.payload) == payload
+        assert not isinstance(owned.payload, memoryview)
+
+
+def test_malformed_durable_frames_raise():
+    for bad in (bytes([11]), bytes([11, 3, 0, 0]),     # truncated seq
+                bytes([12, 3, 1, 2, 3])):              # truncated seq
+        with pytest.raises(Error):
+            deserialize(bad)
+
+
+# ---------------------------------------------------------------------------
+# tier 2: hierarchical namespace
+# ---------------------------------------------------------------------------
+
+def test_namespace_bind_and_conflicts():
+    ns = TopicNamespace(TopicSpace.range(4))
+    assert ns.bind("a.b", 2) == 2
+    assert ns.bind("a.b") == 2          # idempotent re-bind
+    assert ns.bind("a.c") == 0          # auto-alloc: smallest free valid
+    assert ns.bind("a.d") == 1
+    with pytest.raises(ValueError):
+        ns.bind("a.b", 3)               # conflicting re-bind
+    with pytest.raises(ValueError):
+        ns.bind("other", 2)             # topic already bound
+    with pytest.raises(ValueError):
+        ns.bind("oob", 9)               # outside the space
+    with pytest.raises(ValueError):
+        ns.bind(".leading")
+    assert ns.bind("last") == 3
+    with pytest.raises(ValueError):
+        ns.bind("overflow")             # space exhausted
+    ns.unbind("a.b")
+    assert ns.topic_of("a.b") is None
+    assert ns.bind("fresh") == 2        # freed topic is reusable
+
+
+def test_namespace_wildcard_match_semantics():
+    ns = TopicNamespace(TopicSpace.range(16))
+    t = {n: ns.bind(n) for n in (
+        "c.view.1", "c.view.2", "c.view.2.retry", "c.vote.1", "c", "d.x")}
+    # mid `*` matches exactly one segment
+    assert ns.match("c.*.1") == tuple(sorted(
+        (t["c.view.1"], t["c.vote.1"])))
+    # final `*` matches one-or-more trailing segments
+    assert ns.match("c.view.*") == tuple(sorted(
+        (t["c.view.1"], t["c.view.2"], t["c.view.2.retry"])))
+    assert ns.match("c.*") == tuple(sorted(
+        (t["c.view.1"], t["c.view.2"], t["c.view.2.retry"],
+         t["c.vote.1"])))               # NOT bare "c" (one-or-more)
+    assert ns.match("c") == (t["c"],)   # plain name = its own pattern
+    assert ns.match("*") == tuple(sorted(t.values()))
+    assert ns.match("nope.*") == ()
+
+
+def test_namespace_watch_lifecycle():
+    ns = TopicNamespace(TopicSpace.range(8))
+    added, removed = [], []
+    h = ns.watch("a.*", on_add=lambda n, t: added.append((n, t)),
+                 on_remove=lambda n, t: removed.append((n, t)))
+    ta = ns.bind("a.one")
+    ns.bind("b.one")                    # no match, no event
+    assert added == [("a.one", ta)]
+    ns.unbind("a.one")
+    assert removed == [("a.one", ta)]
+    ns.unwatch(h)
+    ns.bind("a.two")
+    assert added == [("a.one", ta)]     # no events after unwatch
+
+
+# ---------------------------------------------------------------------------
+# tier 3: rings, LVC, leases
+# ---------------------------------------------------------------------------
+
+class _FakeBroker:
+    """Just enough broker surface for a standalone DurableTopics."""
+
+    def __init__(self, pool_bytes=None, topics=TopicSpace.range(8)):
+        from pushcdn_tpu.broker.connections import Connections
+        from pushcdn_tpu.proto.def_ import testing_run_def
+        self.connections = Connections("pub:me/priv:me")
+        self.run_def = testing_run_def(topics=topics)
+        self.limiter = Limiter(global_pool_bytes=pool_bytes)
+        self.shard_runtime = None
+        self.durable = None
+
+
+def _durable(**kw):
+    from pushcdn_tpu.broker.retention import DurableTopics
+    broker = _FakeBroker(pool_bytes=kw.pop("pool_bytes", None))
+    d = DurableTopics(broker, **kw)
+    broker.durable = d
+    return d
+
+
+def test_ring_count_eviction_and_snapshot():
+    d = _durable(topics=[0], max_count=4)
+    for i in range(10):
+        d._retain([0], b"p%d" % i, None)
+    assert [e.seq for e in d.snapshot(0, 0)] == [7, 8, 9, 10]
+    assert [bytes(e.payload) for e in d.snapshot(0, 9)] == [b"p8", b"p9"]
+    assert d.snapshot(0, SEQ_LIVE) == []
+    assert d.evicted_entries == 6
+    assert d.stats()["next_seq"][0] == 11
+
+
+def test_ring_bytes_eviction():
+    d = _durable(topics=[0], max_count=1000, max_bytes=100)
+    for i in range(10):
+        d._retain([0], bytes(40), None)
+    # at most 100 bytes retained => 2 entries of 40
+    assert len(d.snapshot(0, 0)) == 2
+    assert d._rings[0].nbytes <= 100
+
+
+def test_ring_age_eviction():
+    d = _durable(topics=[0], max_age_s=0.03)
+    d._retain([0], b"old", None)
+    import time
+    time.sleep(0.05)
+    d._retain([0], b"new", None)
+    assert [bytes(e.payload) for e in d.snapshot(0, 0)] == [b"new"]
+    # the LVC entry survives aging out of the ring
+    d._rings[0].entries.clear  # (no-op sanity: snapshot already evicted)
+    time.sleep(0.05)
+    assert d.snapshot(0, 0) == []
+    assert bytes(d.snapshot(0, SEQ_LAST)[0].payload) == b"new"
+
+
+def test_lvc_survives_eviction_and_tracks_latest():
+    d = _durable(topics=[0, 1], max_count=2)
+    for i in range(6):
+        d._retain([0], b"v%d" % i, None)
+    last = d.snapshot(0, SEQ_LAST)
+    assert len(last) == 1 and bytes(last[0].payload) == b"v5"
+    assert last[0].seq == 6
+    assert d.snapshot(1, SEQ_LAST) == []   # untouched topic: no LVC
+
+
+async def test_retained_leases_never_deadlock_pool_reclaim():
+    """The acceptance property for the lease discipline: retention holds
+    pooled leases, the pool is then exhausted by a new allocation, and
+    the allocation MUST complete (reclaimer materializes retention's
+    leases synchronously) instead of deadlocking."""
+    d = _durable(topics=[0], pool_bytes=1024, max_count=1000,
+                 max_bytes=1 << 20)
+    pool = d.broker.limiter.pool
+    # seed the ring with leased entries: ~200 pooled bytes held by
+    # retention (under the 256-byte pooled clamp)
+    for i in range(4):
+        b = Bytes(bytes([i]) * 50, await pool.allocate(50))
+        d._retain([0], b.data, b)
+        b.release()                     # retention's clone keeps the lease
+    held = d.stats()["pooled_bytes"]
+    assert held == 200, held
+    assert pool.available == 1024 - 200
+    # exhaust: this allocation needs more than is free -> without the
+    # reclaimer it would block forever on retention's idle leases
+    permit = await asyncio.wait_for(pool.allocate(1000), timeout=2)
+    permit.release()
+    assert d.pool_reclaims >= 1
+    assert d.materialized_entries >= 1
+    assert d.stats()["pooled_bytes"] < held
+    # materialization preserved every payload
+    assert [bytes(e.payload) for e in d.snapshot(0, 0)] == \
+        [bytes([i]) * 50 for i in range(4)]
+
+
+async def test_pooled_clamp_bounds_idle_leases():
+    """Retention may pin at most a quarter of the pool: pushing more
+    leased bytes than the budget materializes oldest-first inline."""
+    d = _durable(topics=[0], pool_bytes=400, max_count=1000,
+                 max_bytes=1 << 20)
+    pool = d.broker.limiter.pool
+    for i in range(8):                  # 8 x 50 = 400 leased bytes offered
+        b = Bytes(bytes([i]) * 50, await pool.allocate(50))
+        d._retain([0], b.data, b)
+        b.release()
+    assert d.stats()["pooled_bytes"] <= 100   # capacity // 4
+    assert d.materialized_entries >= 6
+    assert pool.available >= 300
+    assert [bytes(e.payload) for e in d.snapshot(0, 0)] == \
+        [bytes([i]) * 50 for i in range(8)]   # nothing lost, only copied
+
+
+def test_close_releases_everything():
+    d = _durable(topics=[0, 1], max_count=100)
+    for i in range(5):
+        d._retain([0, 1], b"x%d" % i, None)
+    d.close()
+    assert d.stats()["pooled_bytes"] == 0
+    assert all(n == 0 for n in d.stats()["ring_entries"].values())
+
+
+# ---------------------------------------------------------------------------
+# tier 4: replay -> live handover (the acceptance property)
+# ---------------------------------------------------------------------------
+
+def _pool_balanced(broker, what):
+    gc.collect()
+    pool = broker.limiter.pool
+    if pool is not None:
+        assert pool.available == pool.capacity, (
+            f"{what}: {pool.capacity - pool.available} pooled bytes leaked")
+
+
+async def _drain_stream(entity, quiet=0.4):
+    """Everything the entity receives, in order, as typed messages."""
+    out = []
+    while True:
+        try:
+            raw = await asyncio.wait_for(entity.remote.recv_raw(), quiet)
+        except (asyncio.TimeoutError, Exception):
+            return out
+        msg = deserialize(raw.data)
+        if isinstance(msg, Retained):
+            out.append(("retained", msg.seq, bytes(msg.payload)))
+        elif isinstance(msg, Broadcast):
+            out.append(("live", None, bytes(msg.message)))
+        else:
+            out.append((type(msg).__name__, None, None))
+        if hasattr(raw, "release"):
+            raw.release()
+
+
+def _assert_handover(stream, published, what):
+    """The gap/dup-free contract: the receiver's stream is a run of
+    Retained frames followed by a run of live Broadcasts, and the
+    concatenated payloads equal the FULL publish history exactly once,
+    in order. (Where the split lands depends on scheduling; that it is a
+    clean, complete, duplicate-free splice does not.)"""
+    kinds = [k for k, _, _ in stream]
+    split = kinds.index("live") if "live" in kinds else len(stream)
+    assert all(k == "retained" for k in kinds[:split]), (what, kinds)
+    assert all(k == "live" for k in kinds[split:]), (what, kinds)
+    replay_seqs = [s for _, s, _ in stream[:split]]
+    assert replay_seqs == list(range(1, split + 1)), (what, replay_seqs)
+    payloads = [p for _, _, p in stream]
+    assert payloads == published, (
+        f"{what}: handover gap/dup — got {payloads}, want {published}")
+
+
+@pytest.mark.parametrize("impl", ROUTE_IMPLS)
+@pytest.mark.parametrize("seed", [0, 1])
+async def test_replay_live_handover_one_shard(impl, seed, monkeypatch):
+    monkeypatch.setenv("PUSHCDN_RETAIN_TOPICS", "0")
+    cutthrough.ROUTE_IMPL = impl
+    rng = np.random.default_rng(1400 + seed)
+    k1, k2 = int(rng.integers(3, 9)), int(rng.integers(3, 9))
+    published = [b"m%03d" % i for i in range(k1 + k2)]
+    run = await TestDefinition(connected_users=((1,), ())).run()
+    try:
+        assert run.broker.durable.enabled
+        sender, rx = run.user(0), run.user(1)
+        for p in published[:k1]:
+            await run.send_message_as(sender, Broadcast([0], p))
+        await asyncio.sleep(0.1)        # phase 1 fully retained
+        # phase 2: rejoin AND keep publishing, interleaved
+        await rx.remote.send_message(SubscribeFrom(topic=0, seq=1),
+                                     flush=True)
+        for p in published[k1:]:
+            await run.send_message_as(sender, Broadcast([0], p))
+        stream = await _drain_stream(rx)
+        _assert_handover(stream, published, f"1-shard/{impl}/s{seed}")
+        assert run.broker.durable.replayed_frames >= k1
+    finally:
+        await run.shutdown()
+    _pool_balanced(run.broker, f"1-shard/{impl}/s{seed}")
+
+
+@pytest.mark.parametrize("impl", ROUTE_IMPLS)
+@pytest.mark.parametrize("topic", [0, 1])
+async def test_replay_live_handover_two_shards(impl, topic, monkeypatch):
+    """2-shard flavor. ``topic`` selects the owner shard (topic % 2):
+    topic 0 is owned by the receiver's shard, topic 1 by the sender's —
+    the latter exercises the cross-shard replay handoff ring AND the
+    owner-drainer live path in one run."""
+    from pushcdn_tpu.testing.shardharness import run_sharded
+    monkeypatch.setenv("PUSHCDN_RETAIN_TOPICS", "0,1")
+    cutthrough.ROUTE_IMPL = impl
+    published = [b"s%03d" % i for i in range(10)]
+    run = await run_sharded([(0, ()), (1, (topic,))], num_shards=2)
+    try:
+        assert all(b.durable.enabled for b in run.brokers)
+        rx, sender = run.user(0), run.user(1)
+        for p in published[:5]:
+            await sender.remote.send_message(Broadcast([topic], p),
+                                             flush=True)
+        await run.settle()
+        await rx.remote.send_message(SubscribeFrom(topic=topic, seq=1),
+                                     flush=True)
+        for p in published[5:]:
+            await sender.remote.send_message(Broadcast([topic], p),
+                                             flush=True)
+        await run.settle()
+        stream = await _drain_stream(rx)
+        _assert_handover(stream, published, f"2-shard/{impl}/t{topic}")
+        owner = run.brokers[topic % 2]
+        assert owner.durable.replayed_frames >= 5
+        assert owner.durable.stats()["ring_entries"][topic] == 10
+    finally:
+        await run.shutdown()
+    for i, b in enumerate(run.brokers):
+        _pool_balanced(b, f"2-shard/{impl}/t{topic} shard{i}")
+
+
+@pytest.mark.parametrize("impl", ROUTE_IMPLS)
+async def test_seq_last_and_live_sentinels_through_broker(impl,
+                                                          monkeypatch):
+    monkeypatch.setenv("PUSHCDN_RETAIN_TOPICS", "0")
+    cutthrough.ROUTE_IMPL = impl
+    run = await TestDefinition(connected_users=((1,), (), ())).run()
+    try:
+        sender, lvc_rx, live_rx = run.user(0), run.user(1), run.user(2)
+        for i in range(4):
+            await run.send_message_as(sender, Broadcast([0], b"b%d" % i))
+        await asyncio.sleep(0.1)
+        await lvc_rx.remote.send_message(
+            SubscribeFrom(topic=0, seq=SEQ_LAST), flush=True)
+        await live_rx.remote.send_message(
+            SubscribeFrom(topic=0, seq=SEQ_LIVE), flush=True)
+        await asyncio.sleep(0.1)
+        await run.send_message_as(sender, Broadcast([0], b"tail"))
+        lvc = await _drain_stream(lvc_rx)
+        live = await _drain_stream(live_rx)
+        # LVC: exactly the newest retained entry, then the live tail
+        assert lvc == [("retained", 4, b"b3"), ("live", None, b"tail")]
+        # SEQ_LIVE: no replay at all
+        assert live == [("live", None, b"tail")]
+    finally:
+        await run.shutdown()
+
+
+async def test_subscribe_from_unknown_topic_disconnects(monkeypatch):
+    monkeypatch.setenv("PUSHCDN_RETAIN_TOPICS", "0")
+    run = await TestDefinition(connected_users=((0,),)).run()
+    try:
+        u = run.user(0)
+        await u.remote.send_message(SubscribeFrom(topic=77, seq=0),
+                                    flush=True)
+        await asyncio.sleep(0.1)
+        assert not run.broker.connections.has_user(u.public_key)
+    finally:
+        await run.shutdown()
+
+
+async def test_pool_pressure_through_broker(monkeypatch):
+    """Integration twin of the lease test: a SMALL pool, retention on,
+    and a publish volume well past pool capacity — every frame must
+    still deliver (no allocate ever deadlocks on retention's leases)
+    and the pool must balance after shutdown."""
+    monkeypatch.setenv("PUSHCDN_RETAIN_TOPICS", "0")
+    monkeypatch.setenv("PUSHCDN_RETAIN_BYTES", str(1 << 20))
+    for impl in ("python", "native") if routeplan.available() \
+            else ("python",):
+        cutthrough.ROUTE_IMPL = impl
+        run = await TestDefinition(connected_users=((1,), (0,)),
+                                   pool_bytes=64 * 1024).run()
+        try:
+            sender, rx = run.user(0), run.user(1)
+            payload = bytes(2048)
+            # drain concurrently: the pool pressure must come from
+            # retention's idle leases plus transient in-flight frames,
+            # not from an intentionally wedged receiver queue
+            drain = asyncio.create_task(_drain_stream(rx, quiet=1.0))
+            for _ in range(64):         # 128 KiB through a 64 KiB pool
+                await run.send_message_as(sender,
+                                          Broadcast([0], payload))
+            got = await asyncio.wait_for(drain, timeout=30)
+            assert len([g for g in got if g[0] == "live"]) == 64
+            assert run.broker.durable.stats()["ring_entries"][0] == 64
+        finally:
+            await run.shutdown()
+        _pool_balanced(run.broker, f"pool-pressure/{impl}")
+
+
+# ---------------------------------------------------------------------------
+# tier 5: wildcard interest == explicit interest, bit-identically
+# ---------------------------------------------------------------------------
+
+def _plan_fanout(broker, frames):
+    """{identity: (frame indices...)} for one native plan over
+    ``frames`` (mirrors test_route_state's contract comparison)."""
+    state = cutthrough.RouteState(broker, routeplan.RoutePlanner.create())
+    assert state._refresh()
+    buf = bytearray()
+    offs, lens = [], []
+    for f in frames:
+        offs.append(len(buf) + 4)
+        lens.append(len(f))
+        buf += len(f).to_bytes(4, "big") + f
+    offs = np.asarray(offs, np.int64)
+    lens = np.asarray(lens, np.int64)
+    out: dict = {}
+    pos, n = 0, len(lens)
+    while pos < n:
+        consumed, stop, peers, fidx = state.planner.plan(
+            bytes(buf), offs, lens, pos, 0)
+        for p, f in zip(peers.tolist(), fidx.tolist()):
+            key = (state.slot_user[p] if p < state.user_cap
+                   else state.slot_broker[p - state.user_cap])
+            out.setdefault(key, []).append(f)
+        pos += consumed
+        if stop == routeplan.STOP_RESIDUAL:
+            pos += 1
+    return {k: tuple(v) for k, v in out.items()}
+
+
+@pytest.mark.skipif(not routeplan.available(),
+                    reason="native route-plan kernel unavailable")
+async def test_wildcard_plan_bit_identical_to_explicit(monkeypatch):
+    """A wildcard subscriber and an explicit-set subscriber must be
+    indistinguishable to the route plane: the native plan's fan-out for
+    every probe topic is identical for both users, before AND after
+    incremental bind/unbind churn."""
+    monkeypatch.setenv("PUSHCDN_RETAIN_TOPICS", "1,2")
+    run = await TestDefinition(connected_users=((), ()),
+                               topics=TopicSpace.range(8)).run()
+    try:
+        broker = run.broker
+        ns = broker.durable.namespace
+        for name, t in (("c.view.1", 1), ("c.view.2", 2), ("other.x", 3)):
+            ns.bind(name, t)
+        wild, expl = run.user(0), run.user(1)
+        await wild.remote.send_message(
+            SubscribeFrom(topic=0, seq=SEQ_LIVE, pattern="c.view.*"),
+            flush=True)
+        await expl.remote.send_message(Subscribe([1, 2]), flush=True)
+        await asyncio.sleep(0.1)
+        conns = broker.connections
+        assert (set(conns.user_topics.get_values_of_key(wild.public_key)) ==
+                set(conns.user_topics.get_values_of_key(expl.public_key)) ==
+                {1, 2})
+        probe = [serialize(Broadcast([t], b"probe%d" % t))
+                 for t in range(8)]
+
+        def check(what):
+            fan = _plan_fanout(broker, probe)
+            assert fan.get(wild.public_key) == fan.get(expl.public_key), (
+                what, fan)
+        check("initial")
+        # incremental: a NEW binding covered by the pattern must reach the
+        # wildcard user through the watch; mirror it explicitly on the twin
+        ns.bind("c.view.9", 4)
+        conns.subscribe_user_to(expl.public_key, [4])
+        await asyncio.sleep(0.05)
+        assert 4 in set(conns.user_topics.get_values_of_key(wild.public_key))
+        check("after bind")
+        ns.unbind("c.view.1")
+        conns.unsubscribe_user_from(expl.public_key, [1])
+        check("after unbind")
+        # and the delivered traffic agrees with the plan
+        await run.send_message_as(run.user(1), Broadcast([4], b"hit"))
+        got = await _drain_stream(wild)
+        assert (("live", None, b"hit") in got), got
+    finally:
+        await run.shutdown()
+
+
+async def test_wildcard_pattern_with_replay(monkeypatch):
+    """A pattern + a real from-seq: every durable topic the pattern
+    covers replays its ring, then live frames follow."""
+    monkeypatch.setenv("PUSHCDN_RETAIN_TOPICS", "1,2")
+    run = await TestDefinition(connected_users=((1, 2), ()),
+                               topics=TopicSpace.range(8)).run()
+    try:
+        ns = run.broker.durable.namespace
+        ns.bind("v.1", 1)
+        ns.bind("v.2", 2)
+        sender, rx = run.user(0), run.user(1)
+        await run.send_message_as(sender, Broadcast([1], b"one"))
+        await run.send_message_as(sender, Broadcast([2], b"two"))
+        await asyncio.sleep(0.1)
+        await rx.remote.send_message(
+            SubscribeFrom(topic=0, seq=1, pattern="v.*"), flush=True)
+        got = await _drain_stream(rx)
+        replayed = {(s, p) for k, s, p in got if k == "retained"}
+        assert replayed == {(1, b"one"), (1, b"two")}, got
+    finally:
+        await run.shutdown()

@@ -172,7 +172,7 @@ def test_route_bench_smoke(tmp_path):
     # with the headline block (the BENCH_r10.json producer)
     with open(out_json) as fh:
         doc = json.load(fh)
-    assert doc["round"] == 15
+    assert doc["round"] == 16
     assert "route_bench" in doc
     assert isinstance(doc["route_bench"]["rows"], list)
     assert "headline" in doc["route_bench"]
