@@ -1245,6 +1245,117 @@ async def bench_io_plane(quick: bool) -> dict:
     return stats
 
 
+async def bench_pump_attribution(quick: bool) -> dict:
+    """ISSUE 17 rows: the fused data-plane pump A/B + attribution.
+
+    Both legs run io_uring + the native planner over real loopback TCP
+    in fresh measurement children (same isolation rationale as
+    :func:`bench_io_plane`), flipping exactly one variable — the pump:
+
+    - ``route/pump_forward``: the 8-receiver forwarding row, pump
+      off vs on.  End-to-end on a shared core this UNDERSTATES the
+      broker-side win: the bench publisher and all 8 receivers are
+      Python on the same core, so their drain cost bounds the rate
+      (Amdahl) — which is exactly what the attribution rows below are
+      for.
+    - ``route/pump_attribution``: counted interpreter call transitions
+      per 1k delivered messages (``sys.setprofile`` over one unmeasured
+      wave), counted data-plane syscalls per 1k messages (LD_PRELOAD
+      interposer), and the pump-hit vs residual-escalation split from
+      the route plane's own counters.
+
+    Every row is honestly skipped when the kernel denies io_uring or
+    the composition can't engage — never a residual-path run mislabeled
+    as a pump run (the measurement child refuses to report a "pump" leg
+    whose pump never sent a frame)."""
+    import subprocess
+
+    from pushcdn_tpu.native import routeplan, syscount
+    from pushcdn_tpu.native import uring as nuring
+
+    stats: dict = {}
+    reason = None
+    if not nuring.available():
+        reason = f"io_uring unavailable ({nuring.probe_errname()})"
+    elif not routeplan.available():
+        reason = "route-plan kernel unavailable"
+    if reason is not None:
+        for row in ("route/pump_forward", "route/pump_attribution"):
+            emit(row, 0, "skipped", pump="auto", reason=reason)
+        stats["pump_engaged"] = False
+        return stats
+
+    lib = syscount.build()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def child(pump: str) -> Optional[dict]:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        if lib is not None:
+            env["LD_PRELOAD"] = str(lib)
+        argv = [sys.executable, "-m", "pushcdn_tpu.testing.routebench",
+                "--io-impl", "uring", "--route-impl", "native",
+                "--pump", pump, "--receivers", "8", "--transitions",
+                "--msgs", str(1_000 if quick else 4_000),
+                "--trials", str(2 if quick else 5)]
+        try:
+            out = subprocess.run(
+                argv, capture_output=True, text=True, timeout=600,
+                env=env, cwd=repo).stdout.strip()
+            return json.loads(out.splitlines()[-1])
+        except (subprocess.SubprocessError, ValueError, IndexError):
+            return None
+
+    fwd: dict = {}
+    for pump in ("off", "auto"):
+        res = child(pump)
+        if res is None:
+            emit("route/pump_forward", 0, "skipped", pump=pump,
+                 reason="measurement child failed (or pump never "
+                        "engaged)" if pump == "auto"
+                 else "measurement child failed")
+            continue
+        fwd[pump] = res
+        label = "off" if pump == "off" else "on"
+        emit("route/pump_forward", res["median"], "msgs/s", pump=label,
+             io_impl="uring", route_impl="native",
+             receivers=res["receivers"], msgs=res["msgs"],
+             payload=res["payload"],
+             delivered_msgs_s=round(res["delivered"], 1),
+             trials=[round(r, 1) for r in res["trials"]])
+        if "transitions_per_kmsg" in res:
+            emit("route/pump_attribution", res["transitions_per_kmsg"],
+                 "transitions/kmsg", pump=label)
+        if "syscalls_per_msg" in res:
+            emit("route/pump_attribution", res["syscalls_per_msg"] * 1e3,
+                 "calls/kmsg", pump=label,
+                 syscalls={k: v for k, v in res["syscalls"].items() if v})
+    on = fwd.get("auto")
+    if on is not None and on.get("pump_summary"):
+        ps = on["pump_summary"]
+        esc = sum(ps.get("escalations", {}).values())
+        hit = ps.get("pump_frames", 0)
+        emit("route/pump_attribution",
+             hit / max(hit + esc, 1), "hit-ratio",
+             pump_frames=hit, escalated_frames=esc,
+             escalations=ps.get("escalations", {}),
+             plan_calls=ps.get("pump_calls", 0))
+        stats["pump_hit_ratio"] = round(hit / max(hit + esc, 1), 4)
+        stats["pump_engaged"] = True
+    if fwd.get("auto") and fwd.get("off"):
+        r = fwd["auto"]["median"] / fwd["off"]["median"]
+        emit("route/pump_ratio", r, "x", tier="forward_tcp",
+             note="end-to-end on a shared core; bench clients bound "
+                  "the rate, see route/pump_attribution")
+        stats["pump_forward_x"] = round(r, 2)
+        to = fwd["off"].get("transitions_per_kmsg")
+        tn = fwd["auto"].get("transitions_per_kmsg")
+        if to and tn:
+            emit("route/pump_ratio", to / tn, "x",
+                 tier="transitions_per_kmsg")
+            stats["pump_transition_reduction_x"] = round(to / tn, 2)
+    return stats
+
+
 async def amain(quick: bool, impl_arg: str,
                 out_json: Optional[str] = None,
                 shard_rows: Optional[str] = None,
@@ -1299,6 +1410,13 @@ async def amain(quick: bool, impl_arg: str,
     # syscalls-per-message
     if io_rows:
         stats.update(await bench_io_plane(quick))
+        gc.collect()
+
+    # ISSUE 17: the fused data-plane pump A/B (pump off vs on at
+    # io_uring + native planner) with syscall / interpreter-transition
+    # attribution
+    if io_rows:
+        stats.update(await bench_pump_attribution(quick))
         gc.collect()
 
     # ISSUE 8: the device data plane — dense-vs-ragged delivery A/B on
@@ -1359,7 +1477,7 @@ def write_bench_json(path: str, section: str, headline: dict,
                 doc = json.load(fh)
         except (OSError, ValueError):
             doc = {}
-    doc.setdefault("round", 16)
+    doc.setdefault("round", 17)
     from pushcdn_tpu.testing.provenance import provenance
     doc[section] = {"headline": headline, "rows": rows,
                     "provenance": provenance()}

@@ -14,7 +14,9 @@ import os
 
 from pushcdn_tpu.bin.common import (
     add_io_impl_flag,
+    add_pump_flag,
     apply_io_impl,
+    apply_pump,
     drain_grace_s,
     init_logging,
     install_drain_signals,
@@ -92,6 +94,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "attaches to --mesh-shard (default: first local)")
     p.add_argument("--mesh-shard", type=int, default=None)
     add_io_impl_flag(p)
+    add_pump_flag(p)
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p
 
@@ -333,6 +336,7 @@ def main() -> None:
     args = build_parser().parse_args()
     init_logging(args.verbose)
     apply_io_impl(args)
+    apply_pump(args)
     tune_gc()
     try:
         asyncio.run(amain(args))

@@ -75,6 +75,27 @@ def apply_io_impl(args) -> None:
         set_io_impl(args.io_impl)
 
 
+def add_pump_flag(p) -> None:
+    """The fused data-plane pump selector (broker-side, ISSUE 17):
+    ``auto`` engages the native recv→plan→send pump whenever BOTH the
+    io_uring engine and the native route planner are live (demoting
+    loudly once otherwise), ``off`` disables it unconditionally."""
+    p.add_argument("--pump", choices=("auto", "off"), default=None,
+                   help="fused native data-plane pump: auto (engage when "
+                        "io_uring + the native planner are both live), "
+                        "off (always per-chunk Python routing; also "
+                        "inherited via PUSHCDN_PUMP)")
+
+
+def apply_pump(args) -> None:
+    """Write the selection into PUSHCDN_PUMP so shard workers inherit
+    the same composition decision."""
+    if getattr(args, "pump", None):
+        from pushcdn_tpu.proto.transport.pump import set_pump_impl
+        os.environ["PUSHCDN_PUMP"] = args.pump
+        set_pump_impl(args.pump)
+
+
 def init_logging(verbosity: int = 0) -> None:
     """Env-driven log format: ``PUSHCDN_LOG_FORMAT=json`` switches to
     structured JSON lines (reference: RUST_LOG_FORMAT=json)."""

@@ -183,7 +183,7 @@ class RouteState:
                  "deltas_applied", "rebuild_counts", "last_delta_apply_s",
                  "_applies_since_compact_check", "_rebuild_reason",
                  "_frames_since_rebuild", "_skip_rebuilds",
-                 "built_at")
+                 "built_at", "_pump_state", "_pump_off")
 
     def __init__(self, broker: "Broker", planner):
         self.broker = broker
@@ -221,6 +221,12 @@ class RouteState:
         self._frames_since_rebuild = 1 << 30
         self._skip_rebuilds = 0
         self.built_at: Optional[float] = None  # monotonic, last rebuild
+        # fused data-plane pump (ISSUE 15): PumpState on this loop's
+        # uring engine, or None. _pump_off latches when the composition
+        # can never engage (env off / asyncio io impl / lib missing);
+        # a transient None (engine not up yet) keeps retrying.
+        self._pump_state = None
+        self._pump_off = False
 
     def summary(self) -> dict:
         """Operator-facing snapshot state for ``/debug/topology``."""
@@ -245,7 +251,44 @@ class RouteState:
                           "cursor": self.log_seq},
             "rebuilds": dict(self.rebuild_counts),
             "index": self.planner.stats() if self.usable else None,
+            "pump": (self._pump_state.summary()
+                     if self._pump_state is not None
+                     and not self._pump_state.closed else None),
         }
+
+    def _get_pump(self):
+        """The fused pump for this loop's uring engine, engaging lazily
+        (the engine exists only once a uring transport served a
+        connection). Returns None when the composition cannot engage —
+        every such call is counted by the pump module, never silent."""
+        ps = self._pump_state
+        if ps is not None:
+            if not ps.closed:
+                return ps
+            self._pump_state = None  # engine died; it may come back
+        if self._pump_off:
+            return None
+        from pushcdn_tpu.proto.transport import pump as pump_mod
+        ok, _why = pump_mod.resolve_pump()
+        if not ok:
+            # permanent for this process config: env off, io impl not
+            # uring, or a native layer failed to build/probe
+            self._pump_off = True
+            return None
+        from pushcdn_tpu.proto.transport import uring as umod
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return None
+        ent = umod.UringEngine._engines.get(id(loop))
+        eng = ent[1] if ent is not None else None
+        if eng is None or eng.closed:
+            return None  # no engine on this loop (yet): plain cut-through
+        ps = pump_mod.PumpState.create(eng, self.broker, owner=self)
+        self._pump_state = ps  # None if another broker owns the engine
+        if ps is None:
+            self._pump_off = True
+        return ps
 
     # -- snapshot ------------------------------------------------------------
 
@@ -880,8 +923,18 @@ class RouteState:
                         sender_id, chunk, offs, lens, pos, is_user,
                         egress, interest_cache, conn)
                 t0 = time.perf_counter()
-                consumed, stop, peers, frames = planner.plan(
-                    buf, offs, lens, pos, mode)
+                pump = self._get_pump()
+                if pump is not None:
+                    # fused path (ISSUE 15): plan + native linked send
+                    # SQEs in ONE C call; escalated (peer, frame) pairs
+                    # come back and ride the normal _send_plan below
+                    consumed, stop, peers, frames, pumped = \
+                        pump.plan_and_pump(self, chunk, buf, offs, lens,
+                                           pos, mode)
+                else:
+                    pumped = 0
+                    consumed, stop, peers, frames = planner.plan(
+                        buf, offs, lens, pos, mode)
                 # one perf_counter pair + locked add per CHUNK-level plan
                 # call — the latency-attribution seam /metrics exposes as
                 # cdn_native_seconds{kernel="route_plan"}
@@ -889,7 +942,10 @@ class RouteState:
                     time.perf_counter() - t0)
                 if consumed:
                     metrics_mod.ROUTE_BATCH_SIZE.observe(consumed)
-                    metrics_mod.ROUTE_CUTTHROUGH_FRAMES.inc(consumed)
+                    if pumped:
+                        metrics_mod.ROUTE_PUMP_FRAMES.inc(consumed)
+                    else:
+                        metrics_mod.ROUTE_CUTTHROUGH_FRAMES.inc(consumed)
                     self._frames_since_rebuild += consumed
                     # durable retention seam (ISSUE 14): stamp the consumed
                     # broadcasts in the same synchronous region as the plan

@@ -290,6 +290,22 @@ ROUTE_FRAMES = Counter(
 ROUTE_CUTTHROUGH_FRAMES = ROUTE_FRAMES.labels(path="cutthrough")
 ROUTE_RESIDUAL_FRAMES = ROUTE_FRAMES.labels(path="residual")
 ROUTE_SCALAR_FRAMES = ROUTE_FRAMES.labels(path="scalar")
+# path=pump: the fused native pump planned AND sent the batch's hot
+# frames (linked send SQEs prepped in C — zero Python per frame); a
+# batch where every pair escalated still counts under path=cutthrough
+ROUTE_PUMP_FRAMES = ROUTE_FRAMES.labels(path="pump")
+PUMP_ESCALATIONS = Counter(
+    "cdn_pump_escalations",
+    "Frames (or whole batches, reason=control) the fused data-plane "
+    "pump handed back to the Python path, by reason: unengaged = peer "
+    "has no native slot (engagement is requested and happens at its "
+    "next idle), fenced = a Python writer queue owns the peer's "
+    "ordering right now, peer_error = a previous pumped chain errored, "
+    "peer_error_event = a chain error disengaged a peer, chunk_slots = "
+    "all native chunk-lease slots busy, control = a control/traced/"
+    "malformed frame stopped the batch (scalar semantics), capacity = "
+    "native peer table full at engagement",
+    labels=("reason",))
 ROUTE_TABLE_REBUILDS = Counter(
     "cdn_route_table_rebuilds",
     "Cut-through snapshot FULL rebuilds, by reason: first_build = cold "

@@ -373,6 +373,13 @@ class Connection:
     def _ensure_writer(self) -> None:
         if self._writer_task is None:
             self._writer_task = asyncio.create_task(self._writer_loop())
+        # fused-pump fence (transport/pump.py): a frame just entered the
+        # Python writer queue, so until it drains this peer's planned
+        # frames must route through the queue too — fencing here is
+        # SYNCHRONOUS with the enqueue, before the route task can plan
+        b = getattr(self._stream, "_pump_binding", None)
+        if b is not None:
+            b.fence()
 
     def queue_stats(self) -> tuple:
         """``(entries, bytes)`` waiting in the send queue — the topology

@@ -238,6 +238,9 @@ class UringEngine:
         self._kick_scheduled = False
         self._need_submit = False
         self.closed = False
+        # fused data-plane pump (transport/pump.py): ONE per engine,
+        # claimed by the first RouteState on this loop
+        self.pump_state = None
         # fixed-buffer registration: id(buffer) -> slot, with strong refs
         # so a registered buffer's pages can never be freed while the
         # kernel holds the pin
@@ -320,6 +323,14 @@ class UringEngine:
             self._loop.remove_reader(self._efd)
         except Exception:
             pass
+        ps = self.pump_state
+        if ps is not None:
+            # the pump preps SQEs on this ring's mapped memory — it
+            # must die before the ring fd drops
+            try:
+                ps.engine_dead()
+            except Exception:
+                pass
         dead: list = []
         for ud, e in list(self._pending.items()):
             if isinstance(e, _Send):
@@ -430,6 +441,13 @@ class UringEngine:
             self._kick()
 
     def _drain(self) -> None:
+        ps = self.pump_state
+        if ps is not None and not ps.closed:
+            # pump-aware drain: native code walks the CQ, consumes
+            # pump-tagged CQEs (chain advance + starved-chain prep) and
+            # hands everything else back for the dispatch below
+            ps.drain()
+            return
         ring = self.ring
         while True:
             cqes = ring.peek_cqes()
@@ -579,6 +597,10 @@ class UringStream(RawStream):
         self._tx_waiter: Optional[asyncio.Future] = None
         self._tx_idle: Optional[asyncio.Future] = None
         self._closed = False
+        # fused pump (transport/pump.py): set when this stream is
+        # pump-engaged (binding) or engagement is pending (state)
+        self._pump_state = None
+        self._pump_binding = None
         self._arm()
 
     # -- receive plumbing (engine callbacks) --
@@ -626,6 +648,8 @@ class UringStream(RawStream):
         self._waiter = None
 
     def _engine_dead(self) -> None:
+        self._pump_binding = None
+        self._pump_state = None
         self._recv_ud = None
         if self._rx_err is None and not self._eof:
             self._rx_err = ConnectionResetError(
@@ -763,8 +787,13 @@ class UringStream(RawStream):
                 self._wake_tx(None)
             if self._tx:
                 self._pump()
-            elif self._tx_idle is not None and not self._tx_idle.done():
-                self._tx_idle.set_result(None)
+            else:
+                if self._tx_idle is not None and not self._tx_idle.done():
+                    self._tx_idle.set_result(None)
+                ps = self._pump_state
+                if ps is not None:
+                    # TX-idle transition: the pump's engage/unfence hook
+                    ps.on_stream_idle(self)
 
     def _tx_fail(self, err: BaseException) -> None:
         self._tx_err = err
@@ -860,6 +889,15 @@ class UringStream(RawStream):
             raise ConnectionResetError(errno.EBADF, "stream closed")
         if len(data) == 0:
             return
+        b = self._pump_binding
+        if b is not None:
+            # fence + wait out queued native runs: a Python write must
+            # never interleave with a pumped chain on the same fd
+            await b.write_gate()
+            if self._tx_err is not None:
+                raise self._tx_err
+            if self._closed:
+                raise ConnectionResetError(errno.EBADF, "stream closed")
         self._queue_tx(data, owner)
         if not self._tx_flight:
             self._pump()
@@ -871,6 +909,13 @@ class UringStream(RawStream):
             raise self._tx_err
         if self._closed:
             raise ConnectionResetError(errno.EBADF, "stream closed")
+        b = self._pump_binding
+        if b is not None:
+            await b.write_gate()
+            if self._tx_err is not None:
+                raise self._tx_err
+            if self._closed:
+                raise ConnectionResetError(errno.EBADF, "stream closed")
         queued = False
         for b in bufs:
             if len(b):
@@ -885,6 +930,12 @@ class UringStream(RawStream):
         if self._closed:
             return
         eng = self._engine
+        b = self._pump_binding
+        if b is not None:
+            # let queued native runs reach the wire before the FIN
+            await b.quiesce_and_drop()
+            if self._closed:
+                return
         # flush: wait for the TX queue to drain (bounded) before FIN —
         # asyncio's close() flushes its transport buffer the same way
         if (self._tx or self._tx_flight) and self._tx_err is None \
@@ -918,6 +969,9 @@ class UringStream(RawStream):
         if self._closed:
             return
         self._closed = True
+        b = self._pump_binding
+        if b is not None:
+            b.drop_now()
         # drop everything queued but not yet in flight (their lease refs
         # release); in-flight entries stay anchored by the engine's
         # pending table until their terminal CQEs

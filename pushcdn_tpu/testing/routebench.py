@@ -230,7 +230,9 @@ async def forward_rate(impl: str, receivers: int = 8, msgs: int = 2_000,
 async def forward_rate_tcp(io_impl: str, route_impl: str = "auto",
                            receivers: int = 4, msgs: int = 2_000,
                            trials: int = 3, payload: int = 512,
-                           batch: int = 64) -> Optional[dict]:
+                           batch: int = 64, pump: str = "off",
+                           count_transitions: bool = False
+                           ) -> Optional[dict]:
     """The :func:`forward_rate` loop with user links over REAL loopback
     TCP — the io-impl (asyncio vs io_uring) A/B seam. ``io_impl`` is
     ``asyncio`` or ``uring``; returns None when ``uring`` is requested
@@ -241,24 +243,47 @@ async def forward_rate_tcp(io_impl: str, route_impl: str = "auto",
     (``native.syscount``), the result carries per-syscall counter deltas
     for the measured section and ``syscalls_per_msg`` — counted write +
     sendto/sendmsg + epoll_wait + io_uring_enter per DELIVERED message.
-    """
+
+    ``pump`` controls the ISSUE 17 fused data-plane pump for this run
+    (``off``/``auto``) INDEPENDENTLY of the process environment, so the
+    r15 io-impl rows keep measuring the io engine alone and the pump A/B
+    flips exactly one variable. ``pump="auto"`` returns None when the
+    composition can't engage (the caller emits a skipped row, never an
+    unlabeled python-path run sold as a pump run); an engaged run
+    carries the route-plane ``pump`` summary (pump-hit vs escalation
+    counts) and runs one unmeasured warmup wave first — engagement
+    completes at the first TX-idle transition, so without the warmup
+    trial 1 would silently measure the residual path.
+
+    ``count_transitions=True`` appends one extra UNMEASURED wave run
+    under a ``sys.setprofile`` hook and reports
+    ``transitions_per_kmsg`` — Python-interpreter call transitions per
+    1k delivered messages across the whole process (broker AND bench
+    clients; the hook costs ~3x in rate, which is why it never overlaps
+    the timed trials)."""
     from pushcdn_tpu.broker.tasks import cutthrough
     from pushcdn_tpu.broker.test_harness import TestDefinition
     from pushcdn_tpu.native import routeplan, syscount
     from pushcdn_tpu.native import uring as nuring
     from pushcdn_tpu.proto.message import Broadcast, serialize
     from pushcdn_tpu.proto.transport.base import FrameChunk
+    from pushcdn_tpu.proto.transport import pump as pump_mod
     from pushcdn_tpu.proto.transport import uring as uring_mod
 
     if io_impl == "uring" and not nuring.available():
         return None
     if route_impl == "native" and not routeplan.available():
         return None
+    if pump != "off" and (io_impl != "uring"
+                          or not routeplan.available()):
+        return None
     prev_impl = cutthrough.ROUTE_IMPL
     prev_env = os.environ.get("PUSHCDN_IO_IMPL")
+    prev_pump = pump_mod.PUMP_IMPL
     try:
         cutthrough.ROUTE_IMPL = route_impl
         uring_mod.set_io_impl(io_impl)
+        pump_mod.set_pump_impl(pump)
         run = await TestDefinition(
             connected_users=[[]] + [[0]] * receivers, tcp_users=True).run()
         try:
@@ -275,18 +300,29 @@ async def forward_rate_tcp(io_impl: str, route_impl: str = "auto",
                                 if type(item) is FrameChunk else 1
                             item.release()
 
+            async def wave(n):
+                drains = [asyncio.create_task(
+                    drain(run.user(1 + r).remote, n))
+                    for r in range(receivers)]
+                for _ in range(n // batch):
+                    await sender.send_raw_many([frame] * batch)
+                    await asyncio.sleep(0)
+                await asyncio.gather(*drains)
+
+            if pump != "off":
+                # unmeasured warmup: pump engagement completes at each
+                # receiver stream's first TX-idle transition, which only
+                # happens after a wave drains — run one so the timed
+                # trials measure the engaged path, not the residual one
+                await wave(max(batch, min(msgs, 4 * batch)))
+                await asyncio.sleep(0.05)
+
             rates = []
             counts_before = syscount.snapshot()
             t_all0 = time.perf_counter()
             for _ in range(trials):
                 t0 = time.perf_counter()
-                drains = [asyncio.create_task(
-                    drain(run.user(1 + r).remote, msgs))
-                    for r in range(receivers)]
-                for _ in range(msgs // batch):
-                    await sender.send_raw_many([frame] * batch)
-                    await asyncio.sleep(0)
-                await asyncio.gather(*drains)
+                await wave(msgs)
                 rates.append(msgs / (time.perf_counter() - t0))
             wall_s = time.perf_counter() - t_all0
             counts_after = syscount.snapshot()
@@ -294,7 +330,7 @@ async def forward_rate_tcp(io_impl: str, route_impl: str = "auto",
             out = {"median": med, "trials": rates, "msgs": msgs,
                    "receivers": receivers, "payload": payload,
                    "delivered": med * receivers,
-                   "io_impl": io_impl, "wall_s": wall_s}
+                   "io_impl": io_impl, "pump": pump, "wall_s": wall_s}
             if counts_after:
                 delta = syscount.delta(counts_before, counts_after)
                 delivered_total = trials * msgs * receivers
@@ -303,11 +339,37 @@ async def forward_rate_tcp(io_impl: str, route_impl: str = "auto",
                     "epoll_wait", "epoll_pwait", "io_uring_enter"))
                 out["syscalls"] = delta
                 out["syscalls_per_msg"] = data_calls / delivered_total
+            if count_transitions:
+                import sys as _sys
+                n_calls = [0]
+
+                def _hook(frame_, event, arg, _n=n_calls):
+                    if event == "call":
+                        _n[0] += 1
+
+                _sys.setprofile(_hook)
+                try:
+                    await wave(msgs)
+                finally:
+                    _sys.setprofile(None)
+                out["transitions_per_kmsg"] = \
+                    n_calls[0] / (msgs * receivers) * 1e3
+            state = getattr(run.broker, "_route_state", None)
+            ps = getattr(state, "_pump_state", None)
+            if ps is not None and not ps.closed:
+                out["pump_summary"] = ps.summary()
+            if pump != "off" and (ps is None or ps.closed
+                                  or not ps.summary()["pump_frames"]):
+                # the composition never engaged (or never pumped a
+                # frame): a "pump" row from this run would be the
+                # residual path mislabeled — refuse to report it
+                return None
             return out
         finally:
             await run.shutdown()
     finally:
         cutthrough.ROUTE_IMPL = prev_impl
+        pump_mod.set_pump_impl(prev_pump)
         if prev_env is None:
             os.environ.pop("PUSHCDN_IO_IMPL", None)
             uring_mod._resolved = None
@@ -409,6 +471,12 @@ def _main() -> None:
     ap.add_argument("--io-impl", default="asyncio",
                     choices=("asyncio", "uring"))
     ap.add_argument("--route-impl", default="auto")
+    ap.add_argument("--pump", default="off", choices=("off", "auto"),
+                    help="ISSUE 17 fused data-plane pump for this run "
+                         "(independent of the process environment)")
+    ap.add_argument("--transitions", action="store_true",
+                    help="append an unmeasured sys.setprofile wave and "
+                         "report interpreter transitions per kmsg")
     ap.add_argument("--receivers", type=int, default=4)
     ap.add_argument("--msgs", type=int, default=2000)
     ap.add_argument("--trials", type=int, default=3)
@@ -426,7 +494,8 @@ def _main() -> None:
         out = asyncio.run(forward_rate_tcp(
             args.io_impl, route_impl=args.route_impl,
             receivers=args.receivers, msgs=args.msgs, trials=args.trials,
-            payload=args.payload, batch=args.batch))
+            payload=args.payload, batch=args.batch, pump=args.pump,
+            count_transitions=args.transitions))
     json.dump(out, sys.stdout)
     sys.stdout.write("\n")
 

@@ -211,6 +211,36 @@ def check_topology(broker_ports: dict, expected_users: int = 1) -> bool:
     return True
 
 
+def check_pump(broker_ports: dict) -> bool:
+    """``--pump auto``: poll each broker's topology until the fused
+    data-plane pump reports engaged peers AND natively pumped frames
+    (the echo client keeps publishing in the background, so frames keep
+    arriving while we poll), or report an honest skip when the
+    composition cannot engage on this host — never a silent demotion."""
+    deadline = time.time() + 12.0
+    engaged = {}
+    while time.time() < deadline:
+        for name, port in broker_ports.items():
+            topo = fetch_topology(port)
+            ps = ((topo or {}).get("cutthrough") or {}).get("pump")
+            if ps:
+                engaged[name] = ps
+                if ps.get("pump_frames", 0) > 0:
+                    print(f"[cluster] pump OK ({name}: engaged_peers="
+                          f"{ps['engaged_peers']}, pump_frames="
+                          f"{ps['pump_frames']}, escalated="
+                          f"{sum(ps.get('escalations', {}).values())})")
+                    return True
+        time.sleep(0.3)
+    if engaged:
+        print(f"[cluster] FAIL: pump engaged but never pumped a frame: "
+              f"{engaged}")
+        return False
+    print("[cluster] pump skipped (composition not engaged on this host: "
+          "io_uring or the native route planner unavailable)")
+    return True
+
+
 def check_shard_plane(port: int, num_shards: int) -> bool:
     """Sharded broker0: the merged topology must show users spread across
     2+ worker shards and the handoff rings having carried records — the
@@ -1034,6 +1064,11 @@ def main() -> int:
                          "(exported as PUSHCDN_IO_IMPL; auto demotes to "
                          "asyncio with a warning when the kernel denies "
                          "io_uring)")
+    ap.add_argument("--pump", choices=("auto", "off"), default=None,
+                    help="fused native data-plane pump for every broker "
+                         "(exported as PUSHCDN_PUMP; auto engages when "
+                         "io_uring + the native planner are both live, "
+                         "with an honest skip otherwise)")
     ap.add_argument("--chaos-events", default="broker,marshal,discovery",
                     metavar="LIST",
                     help="comma-separated subset of chaos events to run "
@@ -1046,6 +1081,10 @@ def main() -> int:
         # broker's workers inherit it transitively)
         os.environ["PUSHCDN_IO_IMPL"] = args.io_impl
         print(f"[cluster] io-impl: {args.io_impl}")
+
+    if args.pump:
+        os.environ["PUSHCDN_PUMP"] = args.pump
+        print(f"[cluster] pump: {args.pump}")
 
     if args.trace_log:
         os.makedirs(args.trace_log, exist_ok=True)
@@ -1237,6 +1276,10 @@ def main() -> int:
         ok = check_topology(broker_ports,
                             expected_users=2 if args.shards > 1 else 1) \
             and ok
+        if args.pump == "auto":
+            # ---- fused data-plane pump (ISSUE 17): engaged with real
+            # pumped frames on a capable kernel, honest skip otherwise
+            ok = check_pump(broker_ports) and ok
         if args.rehome:
             # ---- elastic membership (ISSUE 12): operator /drain actively
             # re-homes the echo client to the surviving broker; runs

@@ -360,3 +360,54 @@ async def test_health_endpoints_never_import_jax(path):
         server.close()
         await server.wait_closed()
     assert ("jax" in sys.modules) == had_jax
+
+
+async def test_topology_reports_pump_state():
+    """ISSUE 17 observability: with the fused pump engaged, the
+    ``/debug/topology`` cut-through block carries the pump summary —
+    engaged peers, natively pumped frames, and the escalation
+    taxonomy — so an operator can see WHY frames left the native path."""
+    from pushcdn_tpu.broker.tasks import cutthrough
+    from pushcdn_tpu.broker.test_harness import TestDefinition
+    from pushcdn_tpu.native import pump as npump
+    from pushcdn_tpu.native import uring as nuring
+    from pushcdn_tpu.proto.message import Broadcast, serialize
+    from pushcdn_tpu.proto.transport import pump as pump_mod
+    from pushcdn_tpu.proto.transport import uring as umod
+
+    if not (nuring.available() and npump.available()
+            and cutthrough.routeplan.available()):
+        pytest.skip("fused pump unavailable on this host")
+
+    saved = (umod._resolved, umod._warned_demote, cutthrough.ROUTE_IMPL,
+             pump_mod.PUMP_IMPL, pump_mod._warned_demote)
+    umod.set_io_impl("uring")
+    cutthrough.ROUTE_IMPL = "native"
+    pump_mod.set_pump_impl("auto")
+    try:
+        run = await TestDefinition(
+            connected_users=[[], [0], [0]], tcp_users=True,
+            metrics_bind_endpoint="127.0.0.1:0").run()
+        try:
+            port = run.broker._metrics_server.sockets[0].getsockname()[1]
+            sender = run.user(0).remote
+            frame = serialize(Broadcast([0], b"topology-pump"))
+            for _ in range(3):
+                await sender.send_raw_many([frame] * 16)
+                await asyncio.sleep(0.15)
+            status, body = await _get(port, "/debug/topology")
+            assert status == 200
+            topo = json.loads(body)
+        finally:
+            await run.shutdown()
+            umod.UringEngine.shutdown()
+    finally:
+        (umod._resolved, umod._warned_demote, cutthrough.ROUTE_IMPL,
+         pump_mod.PUMP_IMPL, pump_mod._warned_demote) = saved
+
+    pump = topo["cutthrough"]["pump"]
+    assert pump is not None, "pump engaged but absent from topology"
+    assert pump["engaged_peers"] >= 2, pump
+    assert pump["pump_frames"] > 0, pump
+    assert isinstance(pump["escalations"], dict)
+    assert "native" in pump and "parked_leases" in pump

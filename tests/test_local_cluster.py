@@ -77,22 +77,32 @@ def test_local_cluster_end_to_end_echo_and_clean_shutdown(tmp_path):
 @pytest.mark.skipif(not _loopback_available(),
                     reason="no loopback TCP in this sandbox")
 def test_local_cluster_io_impl_auto(tmp_path):
-    """ISSUE 13: the same real-process cluster with ``--io-impl auto`` —
-    every component resolves the host I/O engine (io_uring where the
-    kernel allows, honest demotion otherwise), the echo still completes,
-    and ``trace_report --strict`` still sees complete span chains with
-    zero orphans: the data-plane swap is invisible to the tracing and
-    delivery contracts."""
+    """ISSUE 13 + 17: the same real-process cluster with ``--io-impl
+    auto --pump auto`` — every component resolves the host I/O engine
+    (io_uring where the kernel allows, honest demotion otherwise), the
+    fused data-plane pump engages and natively pumps real frames (or
+    skips loudly when the composition can't engage), the echo still
+    completes, and ``trace_report --strict`` still sees complete span
+    chains with zero orphans: traced frames escalate off the pump and
+    chain exactly as before."""
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     trace_dir = str(tmp_path / "spans")
     proc = subprocess.run(
         [sys.executable, SCRIPT, "--duration", "10", "--base-port", "0",
-         "--io-impl", "auto", "--trace-log", trace_dir],
+         "--io-impl", "auto", "--pump", "auto", "--trace-log", trace_dir],
         env=env, capture_output=True, text=True, timeout=180)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, f"io-impl cluster failed:\n{out[-6000:]}"
     assert "[cluster] io-impl: auto" in out, out[-6000:]
+    assert "[cluster] pump: auto" in out, out[-6000:]
+    from pushcdn_tpu.native import pump as npump
+    from pushcdn_tpu.native import routeplan
+    from pushcdn_tpu.native import uring as nuring
+    if nuring.available() and routeplan.available() and npump.available():
+        assert "pump OK" in out, out[-6000:]
+    else:
+        assert "pump skipped" in out, out[-6000:]
     assert "OK: end-to-end echo through real processes" in out, out[-6000:]
     assert "trace chain complete" in out, out[-6000:]
     assert "trace report OK" in out, out[-6000:]

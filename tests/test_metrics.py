@@ -285,3 +285,75 @@ async def test_loop_lag_sampler_reports_stall():
         assert metrics_mod.EVENT_LOOP_LAG.value < 0.05
     finally:
         task.cancel()
+
+
+async def test_pump_metrics_exposed_after_pumped_traffic():
+    """ISSUE 17 observability: after a fused-pump run the exposition
+    carries ``cdn_route_batch_frames{path="pump"}`` with the natively
+    pumped frame count and ``cdn_pump_escalations{reason="fenced"}``
+    for the frames diverted by a Python-queue fence."""
+    import os
+
+    import pytest
+
+    from pushcdn_tpu.broker.tasks import cutthrough
+    from pushcdn_tpu.broker.test_harness import TestDefinition
+    from pushcdn_tpu.native import pump as npump
+    from pushcdn_tpu.native import uring as nuring
+    from pushcdn_tpu.proto.message import Broadcast, serialize
+    from pushcdn_tpu.proto.transport import pump as pump_mod
+    from pushcdn_tpu.proto.transport import uring as umod
+
+    if not (nuring.available() and npump.available()
+            and cutthrough.routeplan.available()):
+        pytest.skip("fused pump unavailable on this host")
+
+    saved_env = os.environ.get("PUSHCDN_PUMP")
+    saved = (umod._resolved, umod._warned_demote, cutthrough.ROUTE_IMPL,
+             pump_mod.PUMP_IMPL, pump_mod._warned_demote)
+    umod.set_io_impl("uring")
+    cutthrough.ROUTE_IMPL = "native"
+    pump_mod.set_pump_impl("auto")
+    try:
+        run = await TestDefinition(
+            connected_users=[[], [0], [0]], tcp_users=True,
+            metrics_bind_endpoint="127.0.0.1:0").run()
+        try:
+            port = run.broker._metrics_server.sockets[0].getsockname()[1]
+            sender = run.user(0).remote
+            frame = serialize(Broadcast([0], b"pump-metrics"))
+            for _ in range(3):  # waves with idle gaps: pump engages
+                await sender.send_raw_many([frame] * 16)
+                await asyncio.sleep(0.15)
+            ps = run.broker._route_state._pump_state
+            assert ps is not None and ps.summary()["pump_frames"] > 0
+            # force a deterministic "fenced" escalation: a Python-queued
+            # frame fences the peer while a pumped wave is planned
+            key = run.connected_users[1].public_key
+            conn = run.broker.connections.get_user_connection(key)
+            async with conn._write_mutex:
+                await conn.send_raw(serialize(Broadcast([0], b"mark")))
+                await sender.send_raw_many([frame] * 16)
+                await asyncio.sleep(0.2)
+            status, body = await _get(port, "/metrics")
+            assert status == 200
+        finally:
+            await run.shutdown()
+            umod.UringEngine.shutdown()
+    finally:
+        if saved_env is None:
+            os.environ.pop("PUSHCDN_PUMP", None)
+        else:
+            os.environ["PUSHCDN_PUMP"] = saved_env
+        (umod._resolved, umod._warned_demote, cutthrough.ROUTE_IMPL,
+         pump_mod.PUMP_IMPL, pump_mod._warned_demote) = saved
+
+    pump_line = [ln for ln in body.splitlines()
+                 if ln.startswith('cdn_route_batch_frames{path="pump"}')]
+    assert pump_line, "pump path missing from cdn_route_batch_frames"
+    assert float(pump_line[0].split()[-1]) > 0
+    fenced = [ln for ln in body.splitlines()
+              if ln.startswith('cdn_pump_escalations{reason="fenced"}')]
+    assert fenced, "fenced escalation series missing"
+    assert float(fenced[0].split()[-1]) > 0
+    assert "# TYPE cdn_pump_escalations counter" in body

@@ -563,3 +563,218 @@ def test_sharded_route_direct_directmap_precedence():
     egress = EgressBatch(broker)
     route_direct(broker, b"u", _Raw(), to_user_only=False, egress=egress)
     assert list(egress.shards) == [0] and not egress.brokers
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17: fused-pump equivalence — the same seeded mixes over REAL
+# loopback TCP, python-scalar vs native cut-through vs the engaged pump.
+# The pump's escalation taxonomy (control / traced / garbage / durable)
+# must be semantically invisible: per-peer delivery sequences, disconnect
+# decisions, and pool balance all byte-identical to the reference legs.
+# ---------------------------------------------------------------------------
+
+from pushcdn_tpu.native import pump as _npump  # noqa: E402
+from pushcdn_tpu.native import uring as _nuring  # noqa: E402
+from pushcdn_tpu.proto.transport import pump as _pump_mod  # noqa: E402
+from pushcdn_tpu.proto.transport import uring as _umod  # noqa: E402
+
+_PUMP_OK = _nuring.available() and _npump.available()
+requires_pump = pytest.mark.skipif(
+    not _PUMP_OK,
+    reason="fused pump needs io_uring + the native route-plan kernel")
+
+# legs: scalar reference, cut-through reference, fused pump
+_PUMP_LEGS = (("asyncio", "python", "off"),
+              ("uring", "native", "off"),
+              ("uring", "native", "auto"))
+
+
+def _gen_pump_frames(rng: np.random.Generator, n: int, popularity: str):
+    """A seeded mix covering every pump escalation class: broadcasts
+    (uniform or zipf topic popularity), directs, control (sub/unsub),
+    traced frames, and trailing garbage. The warmup prefix guarantees
+    the pump leg engages before the interesting frames arrive."""
+    from pushcdn_tpu.proto import trace as trace_lib
+
+    if popularity == "zipf":
+        # heavy head on topic 0, thin tail on topic 1
+        topic_p = np.array([0.85, 0.15])
+    else:
+        topic_p = np.array([0.5, 0.5])
+
+    def pick_topics(k):
+        return [int(t) for t in rng.choice([0, 1], size=k, p=topic_p)]
+
+    frames = [serialize(Broadcast([0], b"warm-%02d" % i)) for i in range(8)]
+    for _ in range(n):
+        roll = rng.integers(0, 100)
+        payload = bytes(rng.integers(0, 256, int(rng.integers(1, 256)),
+                                     dtype=np.uint8))
+        if roll < 60:
+            frames.append(serialize(Broadcast(
+                pick_topics(int(rng.integers(1, 3))), payload)))
+        elif roll < 78:
+            rcpt = KNOWN_DIRECTS[int(rng.integers(0, len(KNOWN_DIRECTS)))]
+            frames.append(serialize(Direct(rcpt, payload)))
+        elif roll < 86:
+            frames.append(serialize(Subscribe(pick_topics(1))))
+        elif roll < 92:
+            frames.append(serialize(Unsubscribe([0])))
+        elif roll < 97:
+            tr = trace_lib.new_trace()
+            frames.append(trace_lib.stamp_frame(
+                serialize(Broadcast(pick_topics(1), payload)), tr))
+        else:
+            frames.append(b"\xfe" + payload)  # garbage: unknown kind
+    return frames
+
+
+async def _drain_tcp(user, quiet=0.3):
+    """Every frame a TCP user receives until silence, as full bytes."""
+    got = []
+    while True:
+        try:
+            raw = await asyncio.wait_for(user.remote.recv_raw(), quiet)
+        except (asyncio.TimeoutError, Exception):
+            return got
+        if type(raw) is FrameChunk:
+            got.extend(bytes(mv) for mv in raw.views())
+        elif hasattr(raw, "data"):
+            got.append(bytes(raw.data))
+        else:
+            got.append(bytes(raw))
+        if hasattr(raw, "release"):
+            raw.release()
+
+
+async def _run_mix_pump(io_impl, route_impl, pump, frames, retain=None):
+    """One mix through one (io, route, pump) leg over loopback TCP.
+    Returns (deliveries, sender-alive, balanced, pump-summary)."""
+    import os as _os
+
+    prev_impl = cutthrough.ROUTE_IMPL
+    saved = (_umod._resolved, _umod._warned_demote,
+             _pump_mod.PUMP_IMPL, _pump_mod._warned_demote)
+    prev_retain = _os.environ.get("PUSHCDN_RETAIN_TOPICS")
+    _umod.set_io_impl(io_impl)
+    cutthrough.ROUTE_IMPL = route_impl
+    _pump_mod.set_pump_impl(pump)
+    if retain is not None:
+        _os.environ["PUSHCDN_RETAIN_TOPICS"] = retain
+    else:
+        _os.environ.pop("PUSHCDN_RETAIN_TOPICS", None)
+    try:
+        run = await TestDefinition(connected_users=USER_TOPICS,
+                                   connected_brokers=BROKER_DEFS,
+                                   tcp_users=True).run()
+        try:
+            sender = run.user(0).remote
+            try:
+                # warmup wave first, then an idle gap: the pump leg
+                # engages before the seeded mix arrives (a no-op for the
+                # reference legs — deliveries stay identical)
+                await sender.send_raw_many(list(frames[:8]), flush=True)
+                await asyncio.sleep(0.2)
+                await sender.send_raw_many(list(frames[8:]), flush=True)
+            except Exception:
+                pass  # disconnected mid-send: a legal outcome
+            await asyncio.sleep(0.3)
+
+            deliveries = {}
+            for i in range(1, len(USER_TOPICS)):
+                deliveries[f"user-{i}"] = await _drain_tcp(run.user(i))
+            for j in range(len(BROKER_DEFS)):
+                deliveries[f"peer-{j}"] = await _drain_all(
+                    run.peer(j).remote)
+            deliveries["user-0"] = await _drain_tcp(run.user(0))
+            alive = run.broker.connections.has_user(b"user-0")
+            summary = None
+            state = getattr(run.broker, "_route_state", None)
+            ps = getattr(state, "_pump_state", None)
+            if ps is not None and not ps.closed:
+                summary = ps.summary()
+            pool = run.broker.limiter.pool
+            balanced = True
+            if pool is not None and retain is None:
+                # with retention on, the rings legitimately park leases
+                # until broker close — balance is checked post-shutdown
+                for _ in range(20):
+                    gc.collect()
+                    if pool.available == pool.capacity:
+                        break
+                    await asyncio.sleep(0.02)
+                balanced = pool.available == pool.capacity
+            return deliveries, alive, balanced, summary
+        finally:
+            await run.shutdown()
+            pool = run.broker.limiter.pool
+            if retain is not None and pool is not None:
+                for _ in range(20):
+                    gc.collect()
+                    if pool.available == pool.capacity:
+                        break
+                    await asyncio.sleep(0.02)
+                assert pool.available == pool.capacity, (
+                    "retained leases leaked past broker close")
+    finally:
+        _umod.UringEngine.shutdown()
+        cutthrough.ROUTE_IMPL = prev_impl
+        (_umod._resolved, _umod._warned_demote,
+         _pump_mod.PUMP_IMPL, _pump_mod._warned_demote) = saved
+        if prev_retain is None:
+            _os.environ.pop("PUSHCDN_RETAIN_TOPICS", None)
+        else:
+            _os.environ["PUSHCDN_RETAIN_TOPICS"] = prev_retain
+
+
+@requires_pump
+@pytest.mark.parametrize("popularity", ("uniform", "zipf"))
+@pytest.mark.parametrize("seed", range(3))
+async def test_pump_mix_equivalence(seed, popularity):
+    rng = np.random.default_rng(17_000 + seed
+                                + (500 if popularity == "zipf" else 0))
+    frames = _gen_pump_frames(rng, 48, popularity)
+    baseline = base_alive = None
+    for io_impl, route_impl, pump in _PUMP_LEGS:
+        d, alive, balanced, summary = await _run_mix_pump(
+            io_impl, route_impl, pump, frames)
+        assert balanced, (
+            f"seed {seed}/{popularity}: permits leaked under "
+            f"{io_impl}/{route_impl}/pump={pump}")
+        if baseline is None:
+            baseline, base_alive = d, alive
+            assert any(len(v) > 0 for v in d.values()), d
+        assert alive == base_alive, (
+            f"seed {seed}/{popularity}: disconnect decisions differ "
+            f"under {io_impl}/{route_impl}/pump={pump}")
+        assert d == baseline, (
+            f"seed {seed}/{popularity}: delivery diverged under "
+            f"{io_impl}/{route_impl}/pump={pump}")
+        if pump == "auto":
+            assert summary is not None and summary["pump_frames"] > 0, (
+                f"pump leg never pumped: {summary}")
+
+
+@requires_pump
+async def test_pump_mix_equivalence_durable():
+    """The durable escalation class: with topic 0 retained, the pump
+    must hand retained broadcasts to the retention ring exactly like the
+    scalar path — identical live deliveries, identical retained rings,
+    and the pump still engaged for the rest of the mix."""
+    rng = np.random.default_rng(17_900)
+    frames = _gen_pump_frames(rng, 48, "uniform")
+    baseline = results = None
+    retained = {}
+    for io_impl, route_impl, pump in _PUMP_LEGS:
+        d, alive, balanced, summary = await _run_mix_pump(
+            io_impl, route_impl, pump, frames, retain="0")
+        assert balanced, f"{io_impl}/{route_impl}/pump={pump}"
+        if baseline is None:
+            baseline = d
+            assert any(len(v) > 0 for v in d.values()), d
+        assert d == baseline, (
+            f"durable delivery diverged under "
+            f"{io_impl}/{route_impl}/pump={pump}")
+        if pump == "auto":
+            assert summary is not None and summary["pump_frames"] > 0, (
+                f"durable pump leg never pumped: {summary}")

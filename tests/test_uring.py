@@ -31,12 +31,16 @@ import pytest
 
 from pushcdn_tpu.broker.tasks import cutthrough
 from pushcdn_tpu.broker.test_harness import TestDefinition
+from pushcdn_tpu.native import pump as npump
+from pushcdn_tpu.native import routeplan
 from pushcdn_tpu.native import uring as nuring
 from pushcdn_tpu.proto.limiter import NO_LIMIT, Limiter
 from pushcdn_tpu.proto.message import Broadcast, Direct
+from pushcdn_tpu.proto.transport import pump as pump_mod
 from pushcdn_tpu.proto.transport import uring as umod
 
 _URING_OK = nuring.available()
+_PUMP_OK = _URING_OK and routeplan.available() and npump.available()
 
 requires_uring = pytest.mark.skipif(
     not _URING_OK,
@@ -44,6 +48,9 @@ requires_uring = pytest.mark.skipif(
 requires_zc = pytest.mark.skipif(
     not (_URING_OK and nuring.zerocopy_supported()),
     reason="MSG_ZEROCOPY sends unsupported by this kernel's io_uring")
+requires_pump = pytest.mark.skipif(
+    not _PUMP_OK,
+    reason="fused pump needs io_uring + the native route-plan kernel")
 
 
 @pytest.fixture(autouse=True)
@@ -53,9 +60,10 @@ def _io_impl_state():
     engine down after each test — fd/lease hygiene across the suite."""
     saved_env = {k: os.environ.get(k)
                  for k in ("PUSHCDN_IO_IMPL", "PUSHCDN_IO_URING",
-                           "PUSHCDN_URING_ZC_MIN")}
+                           "PUSHCDN_URING_ZC_MIN", "PUSHCDN_PUMP")}
     saved = (umod._resolved, umod._warned_demote, umod._warned_tls,
              cutthrough.ROUTE_IMPL)
+    saved_pump = (pump_mod.PUMP_IMPL, pump_mod._warned_demote)
     yield
     umod.UringEngine.shutdown()
     for k, v in saved_env.items():
@@ -65,6 +73,7 @@ def _io_impl_state():
             os.environ[k] = v
     (umod._resolved, umod._warned_demote, umod._warned_tls,
      cutthrough.ROUTE_IMPL) = saved
+    (pump_mod.PUMP_IMPL, pump_mod._warned_demote) = saved_pump
 
 
 # ---------------------------------------------------------------------------
@@ -195,84 +204,129 @@ def _assert_pool_balanced(limiter, what):
             f"leaked (permit imbalance)")
 
 
-async def _run_one_shard(io_impl, route_impl, msgs):
+def _pump_summary(broker):
+    state = getattr(broker, "_route_state", None)
+    ps = getattr(state, "_pump_state", None)
+    if ps is None or ps.closed:
+        return None
+    return ps.summary()
+
+
+async def _run_one_shard(io_impl, route_impl, msgs, pump="off"):
     umod.set_io_impl(io_impl)
     cutthrough.ROUTE_IMPL = route_impl
+    pump_mod.set_pump_impl(pump)
     run = await TestDefinition(connected_users=_USER_TOPICS,
                                tcp_users=True).run()
     try:
         if io_impl == "uring":
             assert umod.resolve_io_impl() == "uring"
             assert isinstance(run.tcp_listener, umod.UringListener)
-        for m in msgs:
+        for i, m in enumerate(msgs):
             await run.send_message_as(run.user(0), m)
+            if i == 0:
+                # one idle gap: pump engagement completes at the first
+                # TX-idle transition, so the remaining mix exercises the
+                # engaged path (a no-op for the non-pump legs)
+                await asyncio.sleep(0.15)
         seqs = await asyncio.gather(
             *[_drain_sequence(u) for u in run.connected_users])
+        summary = _pump_summary(run.broker)
     finally:
         await run.shutdown()
     _assert_pool_balanced(run.broker.limiter,
-                          f"1-shard {io_impl}/{route_impl}")
-    return {u.public_key: s for u, s in zip(run.connected_users, seqs)}
+                          f"1-shard {io_impl}/{route_impl}/pump={pump}")
+    return ({u.public_key: s
+             for u, s in zip(run.connected_users, seqs)}, summary)
 
 
-async def _run_two_shards(io_impl, route_impl, msgs):
+async def _run_two_shards(io_impl, route_impl, msgs, pump="off"):
     from pushcdn_tpu.testing.shardharness import run_sharded
     umod.set_io_impl(io_impl)
     cutthrough.ROUTE_IMPL = route_impl
+    pump_mod.set_pump_impl(pump)
     # sender on worker 0, receivers split across workers: topic-2 fanout
     # and the directs both cross the shard ring
     run = await run_sharded(
         [(0, _USER_TOPICS[0]), (1, _USER_TOPICS[1]), (1, _USER_TOPICS[2])],
         num_shards=2, tcp_users=True)
     try:
-        for m in msgs:
+        for i, m in enumerate(msgs):
             await run.user(0).remote.send_message(m, flush=True)
+            if i == 0:
+                await asyncio.sleep(0.15)
         seqs = await asyncio.gather(
             *[_drain_sequence(u) for u, _ in run.connected_users])
+        summaries = [s for s in map(_pump_summary, run.brokers)
+                     if s is not None]
     finally:
         await run.shutdown()
     for broker in run.brokers:
         _assert_pool_balanced(broker.limiter,
-                              f"2-shard {io_impl}/{route_impl}")
-    return {u.public_key: s for (u, _), s in zip(run.connected_users, seqs)}
+                              f"2-shard {io_impl}/{route_impl}/pump={pump}")
+    return ({u.public_key: s
+             for (u, _), s in zip(run.connected_users, seqs)}, summaries)
 
 
 def _io_impls():
     return ("asyncio", "uring") if _URING_OK else ("asyncio",)
 
 
+def _equivalence_configs():
+    """(io impl, route impl, pump) legs: the io x route grid with the
+    pump off, plus — when the composition can engage here — the fused
+    pump leg on top of uring+native."""
+    configs = [(io_impl, route_impl, "off")
+               for io_impl in _io_impls()
+               for route_impl in ("python", "native")]
+    if _PUMP_OK:
+        configs.append(("uring", "native", "auto"))
+    return configs
+
+
 async def test_delivery_equivalence_one_shard():
-    """Byte-identical per-peer delivery SEQUENCES across io x route impls
-    through one real broker over loopback TCP."""
+    """Byte-identical per-peer delivery SEQUENCES across io x route x
+    pump impls through one real broker over loopback TCP."""
     msgs = _scenario_messages()
     baseline = None
-    for io_impl in _io_impls():
-        for route_impl in ("python", "native"):
-            got = await _run_one_shard(io_impl, route_impl, msgs)
-            if baseline is None:
-                baseline = got
-                # the scenario must actually deliver: every receiver saw
-                # traffic (a silent broker would vacuously "match")
-                assert all(len(s) > 0 for s in got.values()), got
-            assert got == baseline, (
-                f"delivery diverged under {io_impl}/{route_impl}")
+    for io_impl, route_impl, pump in _equivalence_configs():
+        got, summary = await _run_one_shard(io_impl, route_impl, msgs,
+                                            pump=pump)
+        if baseline is None:
+            baseline = got
+            # the scenario must actually deliver: every receiver saw
+            # traffic (a silent broker would vacuously "match")
+            assert all(len(s) > 0 for s in got.values()), got
+        assert got == baseline, (
+            f"delivery diverged under {io_impl}/{route_impl}/pump={pump}")
+        if pump == "auto":
+            # non-vacuous: the pump leg must have actually pumped
+            assert summary is not None and summary["pump_frames"] > 0, (
+                f"pump leg never sent a frame natively: {summary}")
     if not _URING_OK:
         pytest.skip("asyncio-only equivalence (io_uring unavailable)")
 
 
 async def test_delivery_equivalence_two_shards():
     """The same contract across a 2-worker shard group: the cross-shard
-    handoff ring must be invisible to the io-impl A/B."""
+    handoff ring must be invisible to the io-impl and pump A/Bs. Both
+    shards share one loop engine, so exactly one RouteState owns the
+    pump — the other's frames take the residual path, which the
+    equivalence assertion covers for free."""
     msgs = _scenario_messages()
     baseline = None
-    for io_impl in _io_impls():
-        for route_impl in ("python", "native"):
-            got = await _run_two_shards(io_impl, route_impl, msgs)
-            if baseline is None:
-                baseline = got
-                assert all(len(s) > 0 for s in got.values()), got
-            assert got == baseline, (
-                f"sharded delivery diverged under {io_impl}/{route_impl}")
+    for io_impl, route_impl, pump in _equivalence_configs():
+        got, summaries = await _run_two_shards(io_impl, route_impl, msgs,
+                                               pump=pump)
+        if baseline is None:
+            baseline = got
+            assert all(len(s) > 0 for s in got.values()), got
+        assert got == baseline, (
+            f"sharded delivery diverged under "
+            f"{io_impl}/{route_impl}/pump={pump}")
+        if pump == "auto":
+            assert sum(s["pump_frames"] for s in summaries) > 0, (
+                f"no shard pumped natively: {summaries}")
     if not _URING_OK:
         pytest.skip("asyncio-only equivalence (io_uring unavailable)")
 
@@ -571,3 +625,320 @@ async def test_listener_survives_reset_client():
         for c in opened:
             c.close()
         await listener.close()
+
+
+# ---------------------------------------------------------------------------
+# tier 5: fused data-plane pump faults (ISSUE 17)
+# ---------------------------------------------------------------------------
+#
+# Binding-level tests drive NativePump directly over a socketpair with
+# injected CQEs (deterministic chain accounting, no kernel timing);
+# product-level tests run a real broker over loopback TCP with the pump
+# engaged and break things mid-fan-out.
+
+def _pump_rig(topics=((1,), (2,))):
+    """RoutePlanner + raw Ring + NativePump with one engaged peer per
+    entry in ``topics`` (user slots 0..n-1), plus the peer sockets."""
+    import struct
+
+    import numpy as np
+
+    planner = routeplan.RoutePlanner.create()
+    assert planner is not None
+    user_cap, broker_cap = max(4, len(topics)), 2
+    peer_masks = np.zeros((user_cap + broker_cap, routeplan.MASK_WORDS),
+                          np.uint64)
+    valid_topics = sorted({t for ts in topics for t in ts})
+    for slot, ts in enumerate(topics):
+        peer_masks[slot] = routeplan.topic_mask(list(ts))
+    assert planner.build(user_cap, broker_cap,
+                         routeplan.topic_mask(valid_topics), peer_masks,
+                         [], np.zeros(0, np.int32))
+    ring = nuring.Ring(256)
+    pump = npump.NativePump.create(ring, max_peers=8, chunk_slots=4)
+    assert pump is not None
+    socks = []
+    slot_map = np.full(user_cap + broker_cap, -1, np.int32)
+    for slot in range(len(topics)):
+        a, b = socket.socketpair()
+        a.setblocking(False)
+        b.setblocking(False)
+        pid = pump.add_peer(a.fileno())
+        assert pid >= 0
+        slot_map[slot] = pid
+        socks.append((a, b, pid))
+    pump.set_slots(slot_map)
+
+    def chunk(frame_topics):
+        from pushcdn_tpu.proto.message import Broadcast, serialize
+        frames = [serialize(Broadcast((t,), b"payload-%d" % i))
+                  for i, t in enumerate(frame_topics)]
+        buf = b"".join(struct.pack(">I", len(f)) + f for f in frames)
+        offs, lens, o = [], [], 0
+        for f in frames:
+            offs.append(o + 4)
+            lens.append(len(f))
+            o += 4 + len(f)
+        import numpy as _np
+        return buf, _np.asarray(offs, _np.int64), _np.asarray(lens, _np.int64)
+
+    return planner, ring, pump, socks, chunk
+
+
+def _rig_teardown(ring, pump, socks):
+    pump.destroy()
+    ring.close()
+    for pair in socks:
+        for s in pair[:2]:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+@requires_pump
+def test_pump_short_lone_tail_repumps_residue():
+    """A short-but-successful CQE on the LAST link of a chain re-pumps
+    the residue from the advanced offset (the MSG_WAITALL backstop) —
+    the run stays queued, a fresh SQE is prepped at the next drain, and
+    the chunk slot releases only when every byte is accounted."""
+    planner, ring, pump, socks, chunk = _pump_rig(topics=((1,),))
+    try:
+        buf, offs, lens = chunk([1, 1, 1])
+        consumed, stop, rp, rf, meta = pump.route_chunk(
+            planner._handle, buf, offs, lens, 0, 1)
+        assert consumed == 3 and len(rp) == 0
+        assert meta[npump.META_SQES] == 1  # one contiguous run
+        run_len = int(offs[2] + lens[2] - (offs[0] - 4))
+        # never submit: the injected CQEs are the only completions
+        _c, ev, _n = pump.inject_cqe(socks[0][2], run_len - 7), [], 0
+        st = pump.stats()
+        assert st["short_repump"] == 1
+        assert not pump.take_released(), "slot freed before bytes done"
+        cqes, events, n_prepped = pump.drain()
+        assert n_prepped == 1, "residue chain not re-prepped"
+        pump.inject_cqe(socks[0][2], 7)
+        released = pump.take_released()
+        assert released == [int(meta[npump.META_CHUNK_SLOT])]
+        assert pump.stats()["errors"] == 0
+        assert pump.peer_stats(socks[0][2])["err"] == 0
+    finally:
+        _rig_teardown(ring, pump, socks)
+
+
+@requires_pump
+def test_pump_short_mid_chain_poisons_peer():
+    """A short completion with more links of the chain still in flight
+    means the wire holds a torn frame: the peer must poison (EV_PEER_ERROR
+    with EIO), queued runs drop, the chunk slot still releases, and later
+    chunks escalate that peer's frames as peer_error residuals."""
+    import errno as _errno
+    planner, ring, pump, socks, chunk = _pump_rig(topics=((1,), (2,)))
+    try:
+        # frames: topic1, topic2, topic1 -> peer0 gets TWO runs (a
+        # 2-link chain), peer1 one run
+        buf, offs, lens = chunk([1, 2, 1])
+        consumed, stop, rp, rf, meta = pump.route_chunk(
+            planner._handle, buf, offs, lens, 0, 1)
+        assert consumed == 3 and len(rp) == 0
+        p0 = socks[0][2]
+        assert pump.peer_stats(p0)["inflight"] == 2
+        first_run = int(lens[0]) + 4
+        events = pump.inject_cqe(p0, first_run - 3)  # short, chain live
+        assert [e[0] for e in events] == [npump.EV_PEER_ERROR]
+        assert events[0][1] == p0
+        assert abs(events[0][2]) == _errno.EIO
+        assert pump.stats()["errors"] == 1
+        # the still-in-flight second link drains as a trailing CQE
+        events = pump.inject_cqe(p0, -_errno.ECANCELED)
+        assert npump.EV_PEER_QUIESCED in [e[0] for e in events]
+        # peer1's clean run completes; only then is the chunk slot free
+        p1 = socks[1][2]
+        pump.inject_cqe(p1, int(lens[1]) + 4)
+        assert pump.take_released() == [int(meta[npump.META_CHUNK_SLOT])]
+        # frames for the poisoned peer now escalate as residuals
+        consumed, stop, rp, rf, meta = pump.route_chunk(
+            planner._handle, buf, offs, lens, 0, 1)
+        assert meta[npump.META_RESID_ERROR] == 2
+        assert sorted(set(rp.tolist())) == [0]
+        assert pump.peer_stats(p0)["err"] != 0
+    finally:
+        _rig_teardown(ring, pump, socks)
+
+
+async def _pump_broker(receivers, topics=(0,)):
+    """A real broker over loopback TCP with the pump engaged: returns
+    (run, sender, pump_state) after a warmup wave has landed so every
+    receiver is natively engaged."""
+    from pushcdn_tpu.proto.message import serialize
+
+    umod.set_io_impl("uring")
+    cutthrough.ROUTE_IMPL = "native"
+    pump_mod.set_pump_impl("auto")
+    run = await TestDefinition(
+        connected_users=[[]] + [list(topics)] * receivers,
+        tcp_users=True).run()
+    sender = run.user(0).remote
+    warm = serialize(Broadcast(list(topics), b"warm"))
+    for _ in range(3):
+        await sender.send_raw_many([warm] * 8)
+        await asyncio.sleep(0.15)
+    state = run.broker._route_state
+    assert state is not None
+    ps = state._pump_state
+    assert ps is not None and not ps.closed, "pump never engaged"
+    assert len(ps.bindings) >= receivers, ps.summary()
+    return run, sender, ps
+
+
+async def _drain_payloads(user, quiet=0.4):
+    """Every frame the user receives until the link goes quiet, decoded
+    payload-first so tests can assert ordering by content."""
+    from pushcdn_tpu.proto.transport.base import FrameChunk
+    out = []
+    while True:
+        try:
+            raw = await asyncio.wait_for(user.remote.recv_raw(), quiet)
+        except (asyncio.TimeoutError, Exception):
+            return out
+        if type(raw) is FrameChunk:
+            for i in range(raw.remaining):
+                o, ln = raw.offs[i], raw.lens[i]
+                out.append(bytes(memoryview(raw.buf)[o:o + ln]))
+        else:
+            out.append(bytes(raw.data) if hasattr(raw, "data")
+                       else bytes(raw))
+        if hasattr(raw, "release"):
+            raw.release()
+
+
+@requires_pump
+async def test_pump_peer_reset_during_pumped_fanout():
+    """One receiver RSTs mid-fan-out while its pumped chain is in
+    flight: the broker must survive, disengage (never force-disconnect —
+    the Python path owns that decision), keep delivering to the healthy
+    receivers, and leave the pools balanced."""
+    import struct
+    from pushcdn_tpu.proto.message import serialize
+
+    run, sender, ps = await _pump_broker(receivers=3)
+    try:
+        victim = run.connected_users[1]
+        vsock = victim.remote._stream._sock
+        # stop reading + RST on close: in-flight pumped sends error
+        vsock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         struct.pack("ii", 1, 0))
+        frame = serialize(Broadcast([0], os.urandom(9_000)))
+        await sender.send_raw_many([frame] * 32)
+        vsock.close()
+        await sender.send_raw_many([frame] * 32)
+        await asyncio.sleep(0.3)
+        # the healthy receivers got every post-warmup frame
+        for u in (run.connected_users[2], run.connected_users[3]):
+            got = [p for p in await _drain_payloads(u) if len(p) > 5_000]
+            assert len(got) == 64, f"healthy receiver lost frames: {len(got)}"
+        assert not ps.closed, "whole pump died with one peer"
+        summary = ps.summary()
+        assert summary["pump_frames"] > 0
+    finally:
+        await run.shutdown()
+    _assert_pool_balanced(run.broker.limiter, "pump peer-reset")
+
+
+@requires_pump
+async def test_pump_fence_race_with_concurrent_python_enqueue():
+    """A frame entering a pumped peer's Python writer queue fences the
+    peer synchronously: frames planned while the queue is non-empty
+    divert to the residual path (counted, ordered behind the queue), and
+    the fence lifts once both sides drain — after which the pump engages
+    again."""
+    from pushcdn_tpu.proto.message import serialize
+
+    run, sender, ps = await _pump_broker(receivers=2)
+    try:
+        key = run.connected_users[1].public_key
+        conn = run.broker.connections.get_user_connection(key)
+        assert conn is not None
+        marker = serialize(Broadcast([0], b"MARKER" * 10))
+        wave = [serialize(Broadcast([0], b"wave-%03d" % i))
+                for i in range(24)]
+        fenced_before = ps.escalations.get("fenced", 0)
+        # hold the writer mutex so the queued marker CANNOT drain: the
+        # fence provably overlaps the wave's plan call
+        async with conn._write_mutex:
+            await conn.send_raw(marker)     # enqueue -> synchronous fence
+            assert any(b.fenced for b in ps.bindings.values())
+            await sender.send_raw_many(wave)
+            await asyncio.sleep(0.25)        # wave planned while fenced
+        assert ps.escalations.get("fenced", 0) > fenced_before, (
+            "wave never hit the fence escalation path")
+        await asyncio.sleep(0.2)
+        got = await _drain_payloads(run.connected_users[1])
+        wave_tags = [p[p.find(b"wave-"):p.find(b"wave-") + 8]
+                     for p in got if b"wave-" in p]
+        assert wave_tags == sorted(wave_tags), "fenced frames reordered"
+        assert len(wave_tags) == 24
+        assert any(b"MARKER" in p for p in got)
+        # fence lifted and the pump re-engages for the next wave
+        assert not any(b.fenced for b in ps.bindings.values())
+        pumped_before = ps.pump_frames
+        await sender.send_raw_many(wave)
+        await asyncio.sleep(0.3)
+        assert ps.pump_frames > pumped_before, "peer never unfenced"
+    finally:
+        await run.shutdown()
+    _assert_pool_balanced(run.broker.limiter, "pump fence race")
+
+
+@requires_pump
+async def test_pump_lease_balance_after_teardown_in_flight():
+    """Shutdown with pumped runs still referencing chunk slots: the
+    parked leases must release on teardown — zero pooled bytes leaked."""
+    from pushcdn_tpu.proto.message import serialize
+
+    run, sender, ps = await _pump_broker(receivers=2)
+    try:
+        frame = serialize(Broadcast([0], os.urandom(4_000)))
+        await sender.send_raw_many([frame] * 48)
+        # no drain, no sleep: chunk slots are still referenced when the
+        # shutdown path starts tearing the engine down
+    finally:
+        await run.shutdown()
+        umod.UringEngine.shutdown(asyncio.get_running_loop())
+    assert ps.closed and not ps.leases, "parked leases survived teardown"
+    _assert_pool_balanced(run.broker.limiter, "pump teardown in flight")
+
+
+def test_pump_demotion_warning_names_failed_layer(monkeypatch, caplog):
+    """``resolve_pump`` must name the dead layer in ONE warning — an
+    operator reading the log learns WHICH leg of the composition failed,
+    and repeat probes stay silent (count, don't spam)."""
+    monkeypatch.setattr(pump_mod, "_warned_demote", False)
+    pump_mod.set_pump_impl("auto")
+
+    # io impl resolved to asyncio (kernel fine, selection says no)
+    umod.set_io_impl("asyncio")
+    with caplog.at_level(logging.WARNING, logger=pump_mod.logger.name):
+        ok, why = pump_mod.resolve_pump()
+        ok2, _ = pump_mod.resolve_pump()  # second probe: silent
+    assert not ok and not ok2
+    assert "asyncio" in why or "io_uring unavailable" in why
+    warnings = [r for r in caplog.records if "pump demoted" in r.message]
+    assert len(warnings) == 1, "demotion must warn exactly once"
+    assert why in warnings[0].message
+
+    # dead route-plan kernel: the warning names THAT layer
+    caplog.clear()
+    pump_mod.set_pump_impl("auto")  # resets the warn-once latch
+    monkeypatch.setattr(pump_mod.routeplan, "available", lambda: False)
+    with caplog.at_level(logging.WARNING, logger=pump_mod.logger.name):
+        ok, why = pump_mod.resolve_pump()
+    assert not ok and "route-plan kernel unavailable" in why
+
+    # explicit off is a decision, not a demotion: no warning at all
+    caplog.clear()
+    pump_mod.set_pump_impl("off")
+    with caplog.at_level(logging.WARNING, logger=pump_mod.logger.name):
+        ok, why = pump_mod.resolve_pump()
+    assert not ok and "disabled" in why
+    assert not [r for r in caplog.records if "demoted" in r.message]
