@@ -30,6 +30,7 @@ import asyncio
 import gc
 import json
 import os
+import re
 import statistics
 import sys
 import time
@@ -1356,6 +1357,90 @@ async def bench_pump_attribution(quick: bool) -> dict:
     return stats
 
 
+async def bench_telemetry_overhead(quick: bool) -> dict:
+    """ISSUE 19 row: native-telemetry overhead on the PUMPED path.
+
+    ``route/telemetry_overhead`` is the honest cost of the shm stage
+    stamps + class accounting the pump pays per run: the same
+    8-receiver pumped forwarding child as ``route/pump_forward``, with
+    exactly one variable flipped — ``PUSHCDN_NATIVE_TELEMETRY`` (0 =
+    no mmap, every C-side observe compiled out behind the null telem
+    pointer; 1 = the shipped default). Legs are INTERLEAVED off/on in
+    fresh measurement children because a shared core drifts thermally
+    over the minutes this takes; each leg's figure is the median of
+    its children's medians — 5 pairs in full mode, since single
+    same-process draws on this shared core range +-10% (the r17 shard
+    tier learned the same lesson) and the real C-side cost per observe
+    is nanoseconds. Budget: <= 2% (the observability-plane budget
+    every prior overhead row holds to).
+
+    Skips loudly when io_uring / the planner / the pump can't engage —
+    an unpumped run measures the Python writer path, where the native
+    stamps never execute, and would be a mislabeled 0%."""
+    import subprocess
+
+    from pushcdn_tpu.native import routeplan
+    from pushcdn_tpu.native import uring as nuring
+
+    stats: dict = {}
+    reason = None
+    if not nuring.available():
+        reason = f"io_uring unavailable ({nuring.probe_errname()})"
+    elif not routeplan.available():
+        reason = "route-plan kernel unavailable"
+    if reason is not None:
+        emit("route/telemetry_overhead", 0, "skipped", reason=reason)
+        return stats
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def child(telemetry: str) -> Optional[dict]:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PUSHCDN_NATIVE_TELEMETRY=telemetry)
+        argv = [sys.executable, "-m", "pushcdn_tpu.testing.routebench",
+                "--io-impl", "uring", "--route-impl", "native",
+                "--pump", "auto", "--receivers", "8",
+                "--msgs", str(1_000 if quick else 3_000),
+                "--trials", str(2 if quick else 3)]
+        try:
+            out = subprocess.run(
+                argv, capture_output=True, text=True, timeout=600,
+                env=env, cwd=repo).stdout.strip()
+            return json.loads(out.splitlines()[-1])
+        except (subprocess.SubprocessError, ValueError, IndexError):
+            return None
+
+    legs: dict = {"0": [], "1": []}
+    pairs = 2 if quick else 5
+    for _ in range(pairs):
+        for telemetry in ("0", "1"):  # interleaved: off, on, off, on, ...
+            res = child(telemetry)
+            if res is not None:
+                legs[telemetry].append(res["median"])
+    if not (legs["0"] and legs["1"]):
+        emit("route/telemetry_overhead", 0, "skipped",
+             reason="measurement children failed (or pump never engaged)")
+        return stats
+
+    off_med = statistics.median(legs["0"])
+    on_med = statistics.median(legs["1"])
+    emit("route/telemetry_overhead", off_med, "msgs/s", telemetry="off",
+         receivers=8, pump="auto",
+         trials=[round(r, 1) for r in legs["0"]])
+    emit("route/telemetry_overhead", on_med, "msgs/s", telemetry="on",
+         receivers=8, pump="auto",
+         trials=[round(r, 1) for r in legs["1"]])
+    if on_med:
+        ratio = off_med / on_med  # >1 = telemetry costs throughput
+        emit("route/telemetry_overhead", ratio, "x",
+             overhead_pct=round((ratio - 1) * 100, 2),
+             budget_pct=2.0, interleaved_pairs=pairs)
+        stats["telemetry_overhead_ratio"] = round(ratio, 4)
+        stats["telemetry_overhead_pct"] = round((ratio - 1) * 100, 2)
+        stats["telemetry_headline_msgs_s"] = round(on_med, 1)
+    return stats
+
+
 async def amain(quick: bool, impl_arg: str,
                 out_json: Optional[str] = None,
                 shard_rows: Optional[str] = None,
@@ -1419,6 +1504,12 @@ async def amain(quick: bool, impl_arg: str,
         stats.update(await bench_pump_attribution(quick))
         gc.collect()
 
+    # ISSUE 19: native-telemetry overhead A/B on the pumped path
+    # (PUSHCDN_NATIVE_TELEMETRY off vs on, interleaved children)
+    if io_rows:
+        stats.update(await bench_telemetry_overhead(quick))
+        gc.collect()
+
     # ISSUE 8: the device data plane — dense-vs-ragged delivery A/B on
     # the CPU twin + the one-collective fused mesh tick (dryrun)
     stats.update(bench_device_delivery(quick))
@@ -1477,7 +1568,10 @@ def write_bench_json(path: str, section: str, headline: dict,
                 doc = json.load(fh)
         except (OSError, ValueError):
             doc = {}
-    doc.setdefault("round", 17)
+    # the round number rides in the artifact name (BENCH_r18.json -> 18)
+    # so a re-run into a new round's file never inherits a stale constant
+    m = re.search(r"_r0*(\d+)\.json$", os.path.basename(path))
+    doc.setdefault("round", int(m.group(1)) if m else 18)
     from pushcdn_tpu.testing.provenance import provenance
     doc[section] = {"headline": headline, "rows": rows,
                     "provenance": provenance()}

@@ -18,6 +18,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <ctime>
 #include <new>
 #include <sys/mman.h>
 #include <sys/syscall.h>
@@ -202,6 +203,89 @@ static int sys_register(int fd, unsigned opcode, void *arg, unsigned nr) {
 
 }  // namespace
 
+// ---- native telemetry block (ISSUE 19) --------------------------------------
+//
+// A shared-memory stats block the data plane stamps with CLOCK_MONOTONIC
+// (vdso — no syscall) at each stage boundary: recv-CQE -> plan-done ->
+// SQE-submit -> send-CQE. Values accumulate into log2-ns bucket
+// histograms + per-class / per-peer counters; a single sequence word
+// makes whole-block snapshots torn-read-safe (the same commit-word
+// scheme as the shard handoff ring): the writer bumps it to odd around
+// every update, the reader retries until it observes the same even
+// value on both sides of its copy. Single writer (the ring's event-loop
+// thread), any number of snapshot readers.
+
+enum {
+    PCU_TM_BUCKETS = 64,  // bucket k counts durations in [2^(k-1), 2^k) ns
+    PCU_TM_STAGES = 4,    // 0=plan 1=submit 2=wire 3=total
+    PCU_TM_CHAIN = 2,     // 0=enter (io_uring_enter wall) 1=chain (submit->quiesce)
+    PCU_TM_CLASSES = 4,   // 0=control 1=consensus 2=live 3=bulk
+    PCU_TM_PEERS = 64,    // bounded per-peer counter table (fd-keyed)
+};
+
+struct pcu_hist {
+    u64 count;
+    u64 sum_ns;
+    u64 bucket[PCU_TM_BUCKETS];
+};
+
+struct pcu_telem {
+    u64 seq;  // seqlock commit word (odd = write in progress)
+    // everything below `seq` is the snapshot payload, flat u64s
+    pcu_hist stage[PCU_TM_STAGES];
+    pcu_hist chain[PCU_TM_CHAIN];
+    pcu_hist class_delay[PCU_TM_CLASSES];  // recv->send-CQE, per frame
+    u64 class_frames[PCU_TM_CLASSES];      // pumped deliveries (dir=out)
+    u64 class_bytes[PCU_TM_CLASSES];
+    u64 peer_fd[PCU_TM_PEERS];
+    u64 peer_frames[PCU_TM_PEERS];
+    u64 peer_bytes[PCU_TM_PEERS];
+    u64 peer_used;
+};
+
+static inline u64 pcu_now_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (u64)ts.tv_sec * 1000000000ull + (u64)ts.tv_nsec;
+}
+
+// write_seqcount_begin/end: the fences are store-store barriers so the
+// payload stores can never be observed outside the odd window
+static inline void pcu_tm_begin(pcu_telem *t) {
+    __atomic_store_n(&t->seq, t->seq + 1, __ATOMIC_RELAXED);
+    __atomic_thread_fence(__ATOMIC_RELEASE);
+}
+
+static inline void pcu_tm_end(pcu_telem *t) {
+    __atomic_store_n(&t->seq, t->seq + 1, __ATOMIC_RELEASE);
+}
+
+static inline int pcu_log2_bucket(u64 ns) {
+    if (!ns) return 0;
+    int b = 64 - __builtin_clzll(ns);
+    return b >= PCU_TM_BUCKETS ? PCU_TM_BUCKETS - 1 : b;
+}
+
+// one observation: 2 sequence bumps + 3 plain adds (no lock, no syscall)
+static inline void pcu_tm_observe(pcu_telem *t, pcu_hist *h, u64 ns) {
+    pcu_tm_begin(t);
+    h->count++;
+    h->sum_ns += ns;
+    h->bucket[pcu_log2_bucket(ns)]++;
+    pcu_tm_end(t);
+}
+
+// weighted observation (per-class delay: one duration covers n frames)
+static inline void pcu_tm_observe_n(pcu_telem *t, pcu_hist *h, u64 ns,
+                                    u64 n) {
+    if (!n) return;
+    pcu_tm_begin(t);
+    h->count += n;
+    h->sum_ns += ns * n;
+    h->bucket[pcu_log2_bucket(ns)] += n;
+    pcu_tm_end(t);
+}
+
 struct pcu_ring {
     int fd = -1;
     unsigned sq_entries = 0, cq_entries = 0;
@@ -227,6 +311,9 @@ struct pcu_ring {
     u8 *pbuf_slab = nullptr;
     unsigned pbuf_entries = 0, pbuf_len = 0;
     u16 *pbuf_tail = nullptr;
+
+    // native telemetry block (null = telemetry off: one branch per site)
+    pcu_telem *telem = nullptr;
 };
 
 extern "C" {
@@ -350,7 +437,68 @@ void pcu_destroy(pcu_ring *r) {
     if (r->fd >= 0) close(r->fd);
     free(r->pbuf_ring);
     free(r->pbuf_slab);
+    if (r->telem) munmap(r->telem, sizeof(pcu_telem));
     delete r;
+}
+
+// ---- telemetry ABI ----------------------------------------------------------
+
+// Allocate + attach the shm telemetry block (idempotent). MAP_SHARED |
+// MAP_ANONYMOUS: same address space here, but the mapping survives a
+// fork and is the natural substrate should a sibling process ever map
+// it — and it is page-aligned and zero-filled by the kernel.
+int pcu_telem_enable(pcu_ring *r) {
+    if (r->telem) return 0;
+    void *p = mmap(nullptr, sizeof(pcu_telem), PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_ANONYMOUS, -1, 0);
+    if (p == MAP_FAILED) return -errno;
+    r->telem = (pcu_telem *)p;
+    return 0;
+}
+
+int pcu_telem_enabled(pcu_ring *r) { return r->telem ? 1 : 0; }
+
+// Snapshot payload size in u64 words (everything after the seq word).
+long pcu_telem_words(void) {
+    return (long)((sizeof(pcu_telem) - sizeof(u64)) / sizeof(u64));
+}
+
+// Torn-read-safe whole-block copy: retry until the sequence word reads
+// the same even value on both sides. Returns words copied, 0 when
+// telemetry is off, -1 on a too-small buffer, -2 if the writer never
+// went quiet (callers keep their previous snapshot).
+long pcu_telem_snapshot(pcu_ring *r, unsigned long long *out, long cap) {
+    pcu_telem *t = r->telem;
+    if (!t) return 0;
+    const long words = pcu_telem_words();
+    if (cap < words) return -1;
+    for (int attempt = 0; attempt < 1000; attempt++) {
+        u64 s1 = __atomic_load_n(&t->seq, __ATOMIC_ACQUIRE);
+        if (s1 & 1) continue;
+        memcpy(out, (const u8 *)t + sizeof(u64),
+               (size_t)words * sizeof(u64));
+        __atomic_thread_fence(__ATOMIC_ACQUIRE);
+        u64 s2 = __atomic_load_n(&t->seq, __ATOMIC_RELAXED);
+        if (s1 == s2) return words;
+    }
+    return -2;
+}
+
+// Test hook: drive one observation into a chosen histogram from Python
+// (kind 0 = stage, 1 = chain, 2 = class_delay) so the seqlock and the
+// log2 bucketing are testable without a live pumped ring.
+int pcu_telem_test_observe(pcu_ring *r, int kind, int idx,
+                           unsigned long long ns, unsigned long long n) {
+    pcu_telem *t = r->telem;
+    if (!t) return -1;
+    pcu_hist *h;
+    if (kind == 0 && idx >= 0 && idx < PCU_TM_STAGES) h = &t->stage[idx];
+    else if (kind == 1 && idx >= 0 && idx < PCU_TM_CHAIN) h = &t->chain[idx];
+    else if (kind == 2 && idx >= 0 && idx < PCU_TM_CLASSES)
+        h = &t->class_delay[idx];
+    else return -2;
+    pcu_tm_observe_n(t, h, ns, n ? n : 1);
+    return 0;
 }
 
 int pcu_ring_fd(pcu_ring *r) { return r->fd; }
@@ -579,12 +727,19 @@ long pcu_submit(pcu_ring *r, unsigned wait_nr) {
             flags |= IORING_ENTER_SQ_WAKEUP;
         if (wait_nr) flags |= IORING_ENTER_GETEVENTS;
         if (!flags) return to_submit;  // poller awake: zero-syscall submit
+        u64 t0 = r->telem ? pcu_now_ns() : 0;
         long rc = sys_enter(r->fd, 0, wait_nr, flags);
+        if (r->telem)
+            pcu_tm_observe(r->telem, &r->telem->chain[0],
+                           pcu_now_ns() - t0);
         return rc < 0 ? rc : (long)to_submit;
     }
     if (!to_submit && !wait_nr) return 0;
     unsigned flags = wait_nr ? IORING_ENTER_GETEVENTS : 0;
+    u64 t0 = r->telem ? pcu_now_ns() : 0;
     long rc = sys_enter(r->fd, to_submit, wait_nr, flags);
+    if (r->telem)
+        pcu_tm_observe(r->telem, &r->telem->chain[0], pcu_now_ns() - t0);
     if (rc < 0) return rc;
     r->local_submitted += (u32)rc;
     return rc;

@@ -60,6 +60,14 @@ struct PumpRun {
   u32 len;
   u32 sent;
   s32 chunk_slot;
+  // telemetry (ISSUE 19): stage stamps ride the run so completion can
+  // observe recv->send latency with zero Python. All zero when the
+  // ring's telemetry block is off.
+  u64 t_recv = 0;    // recv-CQE stamp (drain wakeup that carried the chunk)
+  u64 t_ready = 0;   // plan-done stamp (run queued, eligible to submit)
+  u64 t_submit = 0;  // SQE-submit stamp (prep_chain staged the send)
+  u32 cls_frames[PCU_TM_CLASSES] = {0, 0, 0, 0};
+  u32 cls_bytes[PCU_TM_CLASSES] = {0, 0, 0, 0};
 };
 
 struct PumpPeer {
@@ -73,6 +81,10 @@ struct PumpPeer {
   u32 inflight = 0;    // CQEs outstanding for the current chain
   // per-route_chunk staging (frame-ordered pair list indices)
   s32 stage_head = -1, stage_tail = -1;
+  // telemetry: chain start stamp + claimed row in the bounded per-peer
+  // counter table (-1 = unclaimed / table full)
+  u64 chain_t0 = 0;
+  s32 tm_row = -1;
 };
 
 struct ChunkSlot {
@@ -100,6 +112,9 @@ struct Pump {
   u64 st_runs = 0, st_chains = 0, st_sqes = 0, st_cqes = 0;
   u64 st_bytes = 0, st_frames = 0, st_errors = 0, st_short_repump = 0;
   u64 st_ev_lost = 0;
+  // telemetry: recv-CQE stamp from the last drain wakeup (one vdso clock
+  // read per drain, shared by every route_chunk the wakeup fans into)
+  u64 last_recv_ns = 0;
 };
 
 struct EvBuf {
@@ -154,6 +169,55 @@ void free_peer_slot(Pump *p, u32 id) {
   pp = PumpPeer();
 }
 
+// Unlocked histogram add — callers batch several of these inside one
+// pcu_tm_begin/pcu_tm_end seqlock section.
+inline void tm_hist_add(pcu_hist *h, u64 ns, u64 n) {
+  h->count += n;
+  h->sum_ns += ns * n;
+  h->bucket[pcu_log2_bucket(ns)] += n;
+}
+
+// Telemetry on a fully-delivered run: wire + total stage latencies,
+// per-class delay/frames/bytes, bounded per-peer counters. One seqlock
+// section per delivered run. Runs queued before telemetry was enabled
+// carry zero stamps and are skipped.
+void run_delivered(Pump *p, PumpPeer &pp, const PumpRun &r, u64 t_done) {
+  pcu_telem *tm = p->ring->telem;
+  if (tm == nullptr || r.t_submit == 0) return;
+  const u64 wire = t_done > r.t_submit ? t_done - r.t_submit : 0;
+  const u64 total =
+      (r.t_recv != 0 && t_done > r.t_recv) ? t_done - r.t_recv : 0;
+  u64 frames = 0;
+  for (int c = 0; c < PCU_TM_CLASSES; ++c) frames += r.cls_frames[c];
+  if (pp.tm_row < 0) {
+    // claim (or rejoin, after re-engage) a row in the bounded per-peer
+    // table, keyed by fd; table full -> stays unattributed (-1)
+    for (u32 i = 0; i < tm->peer_used; ++i)
+      if (tm->peer_fd[i] == (u64)pp.fd) { pp.tm_row = (s32)i; break; }
+    if (pp.tm_row < 0 && tm->peer_used < (u64)PCU_TM_PEERS) {
+      pp.tm_row = (s32)tm->peer_used;
+      pcu_tm_begin(tm);
+      tm->peer_fd[pp.tm_row] = (u64)pp.fd;
+      tm->peer_used++;
+      pcu_tm_end(tm);
+    }
+  }
+  pcu_tm_begin(tm);
+  tm_hist_add(&tm->stage[2], wire, 1);
+  tm_hist_add(&tm->stage[3], total, 1);
+  for (int c = 0; c < PCU_TM_CLASSES; ++c) {
+    if (r.cls_frames[c] == 0) continue;
+    tm_hist_add(&tm->class_delay[c], total, r.cls_frames[c]);
+    tm->class_frames[c] += r.cls_frames[c];
+    tm->class_bytes[c] += r.cls_bytes[c];
+  }
+  if (pp.tm_row >= 0) {
+    tm->peer_frames[pp.tm_row] += frames;
+    tm->peer_bytes[pp.tm_row] += r.len;
+  }
+  pcu_tm_end(tm);
+}
+
 void peer_fail(Pump *p, u32 id, int neg_errno, EvBuf *eb) {
   PumpPeer &pp = p->peers[id];
   if (pp.err == 0) {
@@ -198,6 +262,20 @@ int prep_chain(Pump *p, u32 id) {
   pp.inflight = done;
   p->st_chains++;
   p->st_sqes += done;
+  if (p->ring->telem != nullptr) {
+    pcu_telem *tm = p->ring->telem;
+    const u64 t_sub = pcu_now_ns();
+    pp.chain_t0 = t_sub;
+    pcu_tm_begin(tm);
+    for (u32 i = 0; i < done; ++i) {
+      PumpRun &qr = pp.q[pp.q_head + i];
+      qr.t_submit = t_sub;  // re-preps (ECANCELED requeue) restamp
+      const u64 d =
+          (qr.t_ready != 0 && t_sub > qr.t_ready) ? t_sub - qr.t_ready : 0;
+      tm_hist_add(&tm->stage[1], d, 1);
+    }
+    pcu_tm_end(tm);
+  }
   return (int)done;
 }
 
@@ -208,6 +286,13 @@ void pump_on_cqe(Pump *p, u32 id, int res, EvBuf *eb) {
   if (!pp.in_use || pp.inflight == 0) return;  // stale/aborted
   pp.inflight--;
   p->st_cqes++;
+  if (pp.inflight == 0 && pp.chain_t0 != 0 && p->ring->telem != nullptr) {
+    // submit -> quiesce wall time for the chain that just finished
+    const u64 now = pcu_now_ns();
+    pcu_tm_observe(p->ring->telem, &p->ring->telem->chain[1],
+                   now > pp.chain_t0 ? now - pp.chain_t0 : 0);
+    pp.chain_t0 = 0;
+  }
   if (pp.err != 0) {
     // draining a failed peer: every trailing CQE frees one head run
     if (pp.q_len > 0) pop_run(p, pp);
@@ -228,6 +313,8 @@ void pump_on_cqe(Pump *p, u32 id, int res, EvBuf *eb) {
     } else {
       r.sent += (u32)res;
       if (r.sent >= r.len) {
+        if (p->ring->telem != nullptr)
+          run_delivered(p, pp, r, pcu_now_ns());
         pop_run(p, pp);
       } else if (pp.inflight > 0) {
         // short link mid-chain: later links already wrote past the gap
@@ -422,7 +509,8 @@ int64_t pushcdn_pump_route_chunk(
     void *handle, void *table_handle, const unsigned char *buf,
     int64_t buf_len, const int64_t *offs, const int64_t *lens,
     int64_t start, int64_t count, int mode, int *resid_peer,
-    int *resid_frame, int64_t resid_cap, int64_t *out_meta) {
+    int *resid_frame, int64_t resid_cap, int64_t *out_meta,
+    unsigned char *out_class) {
   Pump *p = (Pump *)handle;
   RouteTable *t = (RouteTable *)table_handle;
   std::memset(out_meta, 0, 16 * sizeof(int64_t));
@@ -431,14 +519,31 @@ int64_t pushcdn_pump_route_chunk(
     out_meta[1] = 1;  // STOP_RESIDUAL: caller falls back
     return 0;
   }
+  pcu_telem *tm = p->ring->telem;
+  u64 t_recv = 0;
+  if (tm != nullptr) {
+    // recv stamp comes from the drain wakeup that delivered the chunk;
+    // a stale stamp (cold start, >100ms old) falls back to "now" so an
+    // idle gap never masquerades as plan latency
+    const u64 now = pcu_now_ns();
+    t_recv = (p->last_recv_ns != 0 && now >= p->last_recv_ns &&
+              now - p->last_recv_ns < 100000000ull)
+                 ? p->last_recv_ns
+                 : now;
+  }
   int64_t n_pairs = 0;
   int32_t stop = 0;
   int64_t consumed = pushcdn_route_plan(
       table_handle, buf, buf_len, offs, lens, start, count, mode,
-      p->pr_peer, p->pr_frame, p->pair_cap, &n_pairs, &stop);
+      p->pr_peer, p->pr_frame, p->pair_cap, &n_pairs, &stop, out_class);
   if (consumed < 0) {
     out_meta[1] = 1;
     return 0;
+  }
+  u64 t_plan = 0;
+  if (tm != nullptr && consumed > 0) {
+    t_plan = pcu_now_ns();
+    pcu_tm_observe(tm, &tm->stage[0], t_plan > t_recv ? t_plan - t_recv : 0);
   }
   out_meta[0] = consumed;
   out_meta[1] = stop;
@@ -552,6 +657,23 @@ int64_t pushcdn_pump_route_chunk(
       r.len = (u32)(b - a);
       r.sent = 0;
       r.chunk_slot = chunk_slot;
+      // the queue comes from realloc: always reset the telemetry fields
+      // so a run queued while telemetry is off can't replay stale stamps
+      // after a later enable
+      r.t_recv = t_recv;
+      r.t_ready = t_plan;
+      r.t_submit = 0;
+      for (int c = 0; c < PCU_TM_CLASSES; ++c) {
+        r.cls_frames[c] = 0;
+        r.cls_bytes[c] = 0;
+      }
+      if (out_class != nullptr) {
+        for (s32 f = first; f <= last; ++f) {
+          const int c = out_class[f] & (PCU_TM_CLASSES - 1);
+          r.cls_frames[c]++;
+          r.cls_bytes[c] += (u32)(lens[f] + 4);
+        }
+      }
       pp.q_len++;
       p->chunks[chunk_slot].refs++;
       refs++;
@@ -585,6 +707,7 @@ int pushcdn_pump_drain(void *handle, unsigned long long *uds, int *ress,
   if (p == nullptr) return 0;
   EvBuf eb{events, ev_cap, 0};
   pcu_ring *r = p->ring;
+  if (r->telem != nullptr) p->last_recv_ns = pcu_now_ns();
   u32 head = *r->cq_khead;
   const u32 tail = LOAD_ACQ(r->cq_ktail);
   int n_out = 0;

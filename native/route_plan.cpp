@@ -107,6 +107,11 @@ struct RouteTable {
   // that never rebuilds, and a wrapped stamp silently skips a peer)
   uint64_t* stamp = nullptr;
   uint64_t stamp_cur = 0;
+
+  // topic byte -> flow class (0=control 1=consensus 2=live 3=bulk) for
+  // per-class accounting (ISSUE 19). Survives rebuild/apply: the taxonomy
+  // is deployment config, not routing state. Defaults to live.
+  uint8_t topic_class[N_TOPICS];
 };
 
 uint64_t fnv1a(const uint8_t* data, int32_t len) {
@@ -214,7 +219,19 @@ bool blob_append(RouteTable* t, const uint8_t* key, int32_t klen,
 extern "C" {
 
 void* pushcdn_route_table_create() {
-  return new (std::nothrow) RouteTable();
+  RouteTable* t = new (std::nothrow) RouteTable();
+  if (t != nullptr) std::memset(t->topic_class, 2, N_TOPICS);  // live
+  return t;
+}
+
+// Replace the topic -> flow-class map (256 bytes, values 0..3; higher
+// bits are masked off at plan time). Returns 0, or -1 on a bad handle.
+int32_t pushcdn_route_table_set_classes(void* handle,
+                                        const uint8_t* classes) {
+  RouteTable* t = (RouteTable*)handle;
+  if (t == nullptr || classes == nullptr) return -1;
+  std::memcpy(t->topic_class, classes, N_TOPICS);
+  return 0;
 }
 
 void pushcdn_route_table_destroy(void* handle) {
@@ -456,11 +473,17 @@ void pushcdn_route_table_stats(void* handle, int64_t* out) {
 // to hold the next frame's worst-case fan-out (*stop_reason = 2: call
 // again from the returned index). *stop_reason = 0 means the whole range
 // was planned. Returns the number of frames consumed, or -1 on bad args.
+//
+// out_class (nullable): per-frame flow class, indexed by ABSOLUTE frame
+// index — Broadcast takes the class of its FIRST topic byte, Direct is
+// live, and 255 marks a consumed frame that reached no one (pruned-empty
+// broadcast / unknown-recipient drop), excluded from ingress accounting.
+// Only indices [start, start+consumed) are meaningful.
 int64_t pushcdn_route_plan(
     void* handle, const uint8_t* buf, int64_t buf_len,
     const int64_t* offs, const int64_t* lens, int64_t start, int64_t count,
     int32_t mode, int32_t* out_peer, int32_t* out_frame, int64_t pair_cap,
-    int64_t* n_pairs, int32_t* stop_reason) {
+    int64_t* n_pairs, int32_t* stop_reason, uint8_t* out_class) {
   RouteTable* t = (RouteTable*)handle;
   *n_pairs = 0;
   *stop_reason = 0;
@@ -498,6 +521,9 @@ int64_t pushcdn_route_plan(
         mask[w] &= t->valid_mask[w];
         any |= mask[w] != 0;
       }
+      if (out_class != nullptr)
+        out_class[i] = any ? (uint8_t)(t->topic_class[buf[o + 3]] & 3)
+                           : (uint8_t)255;
       if (!any) continue;  // pruned empty: drop (scalar parity)
       const uint64_t st = ++t->stamp_cur;
       bool overflow = false;
@@ -536,6 +562,8 @@ int64_t pushcdn_route_plan(
       const uint8_t* key = buf + o + 5;
       const int64_t slot = dmap_find(t, key, (int32_t)rlen,
                                      fnv1a(key, (int32_t)rlen));
+      if (out_class != nullptr)
+        out_class[i] = slot < 0 ? (uint8_t)255 : (uint8_t)2;  // Direct: live
       if (slot < 0) continue;  // unknown recipient: drop
       const int32_t peer = t->dmap[slot].peer;
       if (mode == 1 && peer >= t->n_users) {

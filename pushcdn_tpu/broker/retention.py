@@ -84,9 +84,12 @@ import logging
 import os
 import struct
 import time
+import weakref
 from collections import deque
 from typing import TYPE_CHECKING, Iterable, List, Optional
 
+from pushcdn_tpu.proto import flowclass
+from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto import trace as trace_mod
 from pushcdn_tpu.proto.error import Error
 from pushcdn_tpu.proto.limiter import Bytes
@@ -108,6 +111,48 @@ logger = logging.getLogger("pushcdn.broker")
 
 _LEN = struct.Struct(">I")
 _U64 = struct.Struct("<Q")
+
+# -- retention observability (ISSUE 19 tentpole 3) ---------------------------
+# Live stores refresh their ring/replay gauges from a /metrics pre-render
+# hook (retain/evict hot paths only bump plain counters); eviction-reason
+# children are cached so the evict loop pays one inc, no label lookup.
+_LIVE_STORES: "weakref.WeakSet[DurableTopics]" = weakref.WeakSet()
+_EVICT_REASON = {r: metrics_mod.RETENTION_EVICTIONS.labels(reason=r)
+                 for r in ("bytes", "entries", "age")}
+_REPLAY_LAG_TOP_K = 8
+_replay_lag_live: set = set()
+
+
+def _refresh_retention_metrics() -> None:
+    rings: dict = {}
+    ring_bytes: dict = {}
+    lags: list = []
+    for store in list(_LIVE_STORES):
+        for t, ring in store._rings.items():
+            key = str(t)
+            rings[key] = rings.get(key, 0) + len(ring.entries)
+            ring_bytes[key] = ring_bytes.get(key, 0) + ring.nbytes
+        lags.extend(store._replay_lags())
+    for key, n in rings.items():
+        metrics_mod.RETENTION_RING_ENTRIES.labels(topic=key).set(n)
+        metrics_mod.RETENTION_RING_BYTES.labels(topic=key).set(
+            ring_bytes[key])
+    lags.sort(key=lambda kv: (-kv[1], kv[0]))
+    shown, other = set(), 0
+    for name, lag in lags:
+        if len(shown) < _REPLAY_LAG_TOP_K:
+            metrics_mod.REPLAY_LAG.labels(subscriber=name).set(lag)
+            shown.add(name)
+        else:
+            other += lag
+    metrics_mod.REPLAY_LAG.labels(subscriber="other").set(other)
+    for name in _replay_lag_live - shown:
+        metrics_mod.REPLAY_LAG.labels(subscriber=name).set(0)
+    _replay_lag_live.clear()
+    _replay_lag_live.update(shown)
+
+
+metrics_mod.PRE_RENDER_HOOKS.append(_refresh_retention_metrics)
 
 
 def _parse_topic_set(spec: str) -> frozenset:
@@ -217,9 +262,16 @@ class DurableTopics:
         self.retained_frames = 0
         self.replayed_frames = 0
         self.evicted_entries = 0
+        self.evictions_by_reason: dict = {}
         self.materialized_entries = 0
         self.pool_reclaims = 0
         self.relayed_pubs = 0
+        # replay-lag tracking: subscriber mnemonic -> [weakref(conn)|None,
+        # entries handed over at its most recent replay]. The pre-render
+        # hook publishes these top-K and retires entries whose writer
+        # queue drained (replay reached the kernel = caught up).
+        self._replay_track: dict = {}
+        _LIVE_STORES.add(self)
 
     # -- construction --------------------------------------------------------
 
@@ -243,6 +295,11 @@ class DurableTopics:
             except ValueError as exc:
                 logger.warning("PUSHCDN_TOPIC_NAMES entry %r ignored: %s",
                                pair, exc)
+        # the bound names imply the flow-class taxonomy ("consensus.*",
+        # "bulk.*", ...): publish the compiled topic -> class table for
+        # the scalar senders; the cut-through plane mirrors it into the
+        # native planner on its next (re)build
+        flowclass.install_table(flowclass.compile_table(d.namespace))
         return d
 
     @property
@@ -285,15 +342,22 @@ class DurableTopics:
             "pooled_bytes": self._pooled_bytes,
             "ring_entries": {t: len(r.entries)
                              for t, r in self._rings.items()},
+            "ring_bytes": {t: r.nbytes for t, r in self._rings.items()},
             "next_seq": {t: r.next_seq for t, r in self._rings.items()},
+            "evictions_by_reason": dict(self.evictions_by_reason),
+            "replay_lag": dict(self._replay_lags()),
         }
 
     # -- retention rings -----------------------------------------------------
 
-    def _evict_one(self, ring: _Ring) -> None:
+    def _evict_one(self, ring: _Ring, reason: Optional[str] = None) -> None:
         e = ring.entries.popleft()
         ring.nbytes -= e.nbytes
         self.evicted_entries += 1
+        if reason is not None:  # None = teardown drain, not an eviction
+            self.evictions_by_reason[reason] = \
+                self.evictions_by_reason.get(reason, 0) + 1
+            _EVICT_REASON[reason].inc()
         if ring.last is e:
             # the LVC slot outlives the ring — but must not pin a pool
             # permit indefinitely: one bounded copy per topic
@@ -306,7 +370,7 @@ class DurableTopics:
         if self.max_age_s > 0:
             horizon = now - self.max_age_s
             while ring.entries and ring.entries[0].t < horizon:
-                self._evict_one(ring)
+                self._evict_one(ring, "age")
 
     def _retain(self, dtopics: List[int], payload,
                 raw: Optional[Bytes]) -> None:
@@ -335,7 +399,10 @@ class DurableTopics:
             self._age_evict(ring, now)
             while (len(ring.entries) > self.max_count
                    or ring.nbytes > self.max_bytes):
-                self._evict_one(ring)
+                self._evict_one(ring,
+                                "entries"
+                                if len(ring.entries) > self.max_count
+                                else "bytes")
         # pooled clamp: retention's idle leases may not crowd the pool
         while self._pooled_bytes > self._pool_budget and self._pooled:
             self._materialize_oldest()
@@ -512,13 +579,40 @@ class DurableTopics:
         stream = b"".join(self._prefixed_retained(topic, e)
                           for e in entries)
         try:
-            conn.send_encoded_nowait(stream, None)
+            conn.send_encoded_nowait(stream, None, cls=flowclass.BULK,
+                                     nframes=len(entries))
         except Exception as exc:
             logger.info("replay to user %s failed (%r); disconnecting",
                         mnemonic(public_key), exc)
             return False
         self.replayed_frames += len(entries)
+        self._track_replay(public_key, conn, len(entries))
         return True
+
+    def _track_replay(self, public_key, conn, entries: int) -> None:
+        self._replay_track[mnemonic(public_key)] = \
+            [weakref.ref(conn) if conn is not None else None, entries]
+
+    def _replay_lags(self) -> list:
+        """(subscriber, lag) pairs for the pre-render hook: a tracked
+        replay counts as lagging while its connection's writer queue is
+        still draining; once empty (or the conn died) the subscriber has
+        caught up and the entry retires."""
+        out = []
+        for name, (ref, entries) in list(self._replay_track.items()):
+            conn = ref() if ref is not None else None
+            if conn is None:
+                del self._replay_track[name]
+                continue
+            try:
+                depth, _ = conn.queue_stats()
+            except Exception:
+                depth = 0
+            if depth <= 0:
+                del self._replay_track[name]
+                continue
+            out.append((name, entries))
+        return out
 
     def _watch_pattern(self, public_key, pattern: str) -> None:
         """Keep a wildcard subscription live: future ``bind``/``unbind``
@@ -681,7 +775,10 @@ class DurableTopics:
                 if conn is None:
                     return
                 try:
-                    await conn.send_encoded(b"".join(frames), None)
+                    await conn.send_encoded(b"".join(frames), None,
+                                            cls=flowclass.BULK,
+                                            nframes=len(frames))
+                    self._track_replay(key, conn, len(frames))
                 except asyncio.CancelledError:
                     raise
                 except Exception as exc:

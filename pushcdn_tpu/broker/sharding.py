@@ -447,7 +447,10 @@ class ShardRuntime:
         (metrics_mod.EGRESS_FRAMES_USER if kind == shardring.KIND_USER
          else metrics_mod.EGRESS_FRAMES_BROKER).inc(n_frames)
         try:
-            await conn.send_encoded(data, owner)
+            # class volume was counted at the ORIGIN shard's routing
+            # decision (pair-level, before the handoff); nbytes=0 keeps
+            # the sibling's writer from counting the stream twice
+            await conn.send_encoded(data, owner, nbytes=0)
         except asyncio.CancelledError:
             raise
         except Exception as exc:

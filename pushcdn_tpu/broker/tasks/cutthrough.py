@@ -52,6 +52,7 @@ from pushcdn_tpu.broker.tasks.handlers import (
     route_direct,
 )
 from pushcdn_tpu.native import routeplan
+from pushcdn_tpu.proto import flowclass
 from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto import trace as trace_mod
 from pushcdn_tpu.proto.def_ import no_hook
@@ -114,6 +115,17 @@ _COMPACT_CHECK_EVERY = 64
 _ZERO_MASK = np.zeros(routeplan.MASK_WORDS, np.uint64)  # reused, read-only
 
 _warned_unavailable = False
+
+
+def _inc_class_counts(classes, lens, frames_row, bytes_row) -> None:
+    """Fold one plan's per-frame class array into the cdn_class_*
+    counters (vectorized — one bincount per plan call, not per frame)."""
+    frames, nbytes = flowclass.bincount_classes(classes, lens)
+    for c in range(flowclass.N_CLASSES):
+        n = int(frames[c])
+        if n:
+            frames_row[c].inc(n)
+            bytes_row[c].inc(int(nbytes[c]))
 
 
 def acquire(broker: "Broker", hook) -> Optional["RouteState"]:
@@ -434,6 +446,11 @@ class RouteState:
             user_cap, broker_cap, valid, peer_masks, dkeys,
             np.asarray(owners, np.int32))
         if self.usable:
+            # mirror the flow-class taxonomy into the native table so the
+            # plan (and the fused pump) classes frames exactly like the
+            # scalar senders; deployment config, so every rebuild restores
+            # the same map
+            self.planner.set_classes(flowclass.active_table())
             self.version = conns.interest_version
             self.log_seq = conns.route_log_next
             self.user_cap = user_cap
@@ -603,13 +620,19 @@ class RouteState:
 
     async def _send_plan(self, chunk: FrameChunk, offs: np.ndarray,
                          lens: np.ndarray, peers: np.ndarray,
-                         frames: np.ndarray) -> None:
+                         frames: np.ndarray, fc=None) -> None:
         """Hand one plan's fan-out to the per-peer writers. Pairs arrive in
         frame order; a stable sort groups them per peer without disturbing
         per-(sender→receiver) frame order. Failure ⇒ removal, exactly like
-        ``EgressBatch.flush``."""
+        ``EgressBatch.flush``. ``fc`` is the plan's per-frame class array
+        (absolute indices) — dir=out accounting happens here at the pair
+        level, so the writer stamps below carry nframes=0/nbytes=0."""
         if len(peers) == 0:
             return
+        if fc is not None:
+            _inc_class_counts(fc[frames], lens[frames],
+                              metrics_mod.CLASS_FRAMES_OUT,
+                              metrics_mod.CLASS_BYTES_OUT)
         broker = self.broker
         # Phase 1 — SYNCHRONOUS build: resolve peer indices against the
         # snapshot lists and assemble every per-peer stream before any
@@ -632,7 +655,7 @@ class RouteState:
         ends = np.concatenate((bounds, [len(speers)]))
         buf = chunk.buf
         mv = None
-        sends: list = []  # (is_user, key_or_ident, data, owner, n_frames)
+        sends: list = []  # (is_user, key_or_ident, data, owner, n, cls)
         ring: Optional[dict] = None  # shard -> [(kind, ident, idx array)]
         for s, e in zip(starts.tolist(), ends.tolist()):
             peer = int(speers[s])
@@ -681,7 +704,10 @@ class RouteState:
                 owner = None
                 if data is None:  # can't happen on in-range indices
                     continue
-            sends.append((*target, data, owner, len(idx)))
+            # queue-delay attribution class: the batch's first frame's
+            # (volume was already counted pair-level above)
+            cls = int(fc[first]) & 3 if fc is not None else flowclass.LIVE
+            sends.append((*target, data, owner, len(idx), cls))
         if ring is not None:
             # still phase 1 (synchronous): the ring write copies the wire
             # bytes straight out of the pooled chunk into shared memory —
@@ -691,7 +717,7 @@ class RouteState:
         # Phase 2 — sends (may await). Connections are looked up by
         # stable identity here, like the scalar flush: a peer that left
         # mid-batch drops its frames; failure ⇒ removal.
-        for is_user_peer, key, data, owner, n_frames in sends:
+        for is_user_peer, key, data, owner, n_frames, cls in sends:
             if is_user_peer:
                 conn = broker.connections.get_user_connection(key)
             else:
@@ -701,7 +727,8 @@ class RouteState:
             (metrics_mod.EGRESS_FRAMES_USER if is_user_peer
              else metrics_mod.EGRESS_FRAMES_BROKER).inc(n_frames)
             try:
-                await conn.send_encoded(data, owner)
+                await conn.send_encoded(data, owner, cls=cls,
+                                        nframes=0, nbytes=0)
             except asyncio.CancelledError:
                 raise
             except Exception as exc:
@@ -762,7 +789,8 @@ class RouteState:
                     route_broadcast(broker, pruned, raw,
                                     to_users_only=not is_user,
                                     egress=egress,
-                                    interest_cache=interest_cache)
+                                    interest_cache=interest_cache,
+                                    raw_topics=message.topics)
             if tr is not None:
                 if egress.appended > a0:
                     trace_mod.emit("plan", tr, "residual")
@@ -947,6 +975,15 @@ class RouteState:
                     else:
                         metrics_mod.ROUTE_CUTTHROUGH_FRAMES.inc(consumed)
                     self._frames_since_rebuild += consumed
+                    # per-class ingress accounting off the plan's class
+                    # array (pumped runs count their own dir=out in C;
+                    # residual pairs count in _send_plan below)
+                    fc = (pump.np_.frame_classes if pump is not None
+                          else planner.frame_classes)
+                    _inc_class_counts(fc[pos:pos + consumed],
+                                      lens[pos:pos + consumed],
+                                      metrics_mod.CLASS_FRAMES_IN,
+                                      metrics_mod.CLASS_BYTES_IN)
                     # durable retention seam (ISSUE 14): stamp the consumed
                     # broadcasts in the same synchronous region as the plan
                     # (before the first egress await), so a SubscribeFrom
@@ -956,7 +993,8 @@ class RouteState:
                     if durable is not None and durable.topics:
                         durable.retain_from_chunk(buf, offs, lens, pos,
                                                   consumed)
-                    await self._send_plan(chunk, offs, lens, peers, frames)
+                    await self._send_plan(chunk, offs, lens, peers, frames,
+                                          fc)
                 pos += consumed
                 if stop == routeplan.STOP_END:
                     break

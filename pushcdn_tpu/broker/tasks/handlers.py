@@ -30,6 +30,7 @@ import time
 from typing import TYPE_CHECKING, List, Optional, Sequence
 
 from pushcdn_tpu.broker.staging import StageResult
+from pushcdn_tpu.proto import flowclass
 from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto import trace as trace_mod
 from pushcdn_tpu.proto.def_ import HookResult
@@ -174,17 +175,21 @@ class EgressBatch:
         coalescer). Ownership rule either way: the frames are consumed —
         released here on the encode path, by the connection on the raw
         path."""
+        # class volume was already counted at the routing decision
+        # (route_direct/route_broadcast, one count per fan-out pair), so
+        # the writer entries carry nframes=0/nbytes=0 and only observe
+        # queue delay — same suppression the cut-through plan path uses
         if len(frames) < 2:  # depth-1: nothing to coalesce, skip probing
-            await conn.send_raw_many(frames)
+            await conn.send_raw_many(frames, nframes=0, nbytes=0)
             return
         from pushcdn_tpu.broker.tasks.senders import pre_encode_frames
         encoded = pre_encode_frames(frames)
         if encoded is not None:
             for f in frames:
                 f.release()
-            await conn.send_encoded(encoded)
+            await conn.send_encoded(encoded, nbytes=0)
         else:
-            await conn.send_raw_many(frames)
+            await conn.send_raw_many(frames, nframes=0, nbytes=0)
 
     async def flush(self) -> None:
         broker = self.broker
@@ -282,7 +287,28 @@ def _emit_scalar_trace(message, egress: EgressBatch, before: int) -> None:
 
 def route_direct(broker: "Broker", recipient: bytes, raw: Bytes,
                  to_user_only: bool, egress: EgressBatch) -> None:
-    """One-hop direct routing decision (broker/handler.rs:197-237)."""
+    """One-hop direct routing decision (broker/handler.rs:197-237).
+
+    Flow accounting mirrors the cut-through plan's semantics exactly: a
+    delivered Direct counts ONE ``dir=in`` frame (class ``live``, like the
+    plan's ``out_class``) and one ``dir=out`` count per fan-out pair,
+    stamped at the routing decision before any connection lookup; a
+    dropped Direct (unknown recipient) counts nothing (plan writes 255).
+    """
+    before = egress.appended
+    _route_direct(broker, recipient, raw, to_user_only, egress)
+    delta = egress.appended - before
+    if delta:
+        data = getattr(raw, "data", None)
+        nb = (len(data) + 4) if data is not None else 4
+        metrics_mod.CLASS_FRAMES_IN[flowclass.LIVE].inc()
+        metrics_mod.CLASS_BYTES_IN[flowclass.LIVE].inc(nb)
+        metrics_mod.CLASS_FRAMES_OUT[flowclass.LIVE].inc(delta)
+        metrics_mod.CLASS_BYTES_OUT[flowclass.LIVE].inc(delta * nb)
+
+
+def _route_direct(broker: "Broker", recipient: bytes, raw: Bytes,
+                  to_user_only: bool, egress: EgressBatch) -> None:
     conns = broker.connections
     if conns.num_shards > 1:
         # sharded data plane: "our user" spans every worker shard of this
@@ -352,7 +378,8 @@ def route_broadcast(broker: "Broker", topics: Sequence[int], raw: Bytes,
                     to_users_only: bool, egress: EgressBatch,
                     users_via_device: bool = False,
                     exclude_brokers: frozenset = frozenset(),
-                    interest_cache: Optional[dict] = None) -> None:
+                    interest_cache: Optional[dict] = None,
+                    raw_topics: Optional[Sequence[int]] = None) -> None:
     """Interest-driven fan-out decision (broker/handler.rs:240-272).
 
     ``users_via_device=True`` means the local-user fan-out was staged onto
@@ -365,7 +392,37 @@ def route_broadcast(broker: "Broker", topics: Sequence[int], raw: Bytes,
     one landing while this batch awaits egress or device backpressure —
     invalidates them, keeping parity with the reference's per-message
     interest query.
+
+    Flow accounting mirrors the cut-through plan: one ``dir=in`` frame per
+    Broadcast with a non-empty (pruned) topic list — consumed even with
+    zero interested peers, like the plan's ``out_class`` — and one
+    ``dir=out`` count per fan-out pair, under the class of the FIRST
+    topic byte of the frame AS SENT (``raw_topics``; the plan kernel
+    reads that byte before pruning, and the scalar twin must agree).
     """
+    before = egress.appended
+    _route_broadcast(broker, topics, raw, to_users_only, egress,
+                     users_via_device=users_via_device,
+                     exclude_brokers=exclude_brokers,
+                     interest_cache=interest_cache)
+    if topics:
+        cls = flowclass.class_of_topics(
+            raw_topics if raw_topics is not None else topics)
+        data = getattr(raw, "data", None)
+        nb = (len(data) + 4) if data is not None else 4
+        metrics_mod.CLASS_FRAMES_IN[cls].inc()
+        metrics_mod.CLASS_BYTES_IN[cls].inc(nb)
+        delta = egress.appended - before
+        if delta:
+            metrics_mod.CLASS_FRAMES_OUT[cls].inc(delta)
+            metrics_mod.CLASS_BYTES_OUT[cls].inc(delta * nb)
+
+
+def _route_broadcast(broker: "Broker", topics: Sequence[int], raw: Bytes,
+                     to_users_only: bool, egress: EgressBatch,
+                     users_via_device: bool = False,
+                     exclude_brokers: frozenset = frozenset(),
+                     interest_cache: Optional[dict] = None) -> None:
     if interest_cache is None:
         users, brokers = broker.connections.get_interested_by_topic(
             list(topics), to_users_only)
@@ -541,7 +598,8 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                             route_broadcast(
                                 broker, pruned, raw, to_users_only=False,
                                 egress=egress,
-                                interest_cache=interest_cache)
+                                interest_cache=interest_cache,
+                                raw_topics=message.topics)
                             _emit_scalar_trace(message, egress, a0)
                     elif isinstance(message, Subscribe):
                         pruned, bad = topics.prune(message.topics)
@@ -624,7 +682,8 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                                     frozenset(
                                         device.covered_broker_idents())
                                     if staged else frozenset()),
-                                interest_cache=interest_cache)
+                                interest_cache=interest_cache,
+                                raw_topics=message.topics)
                             if not staged:
                                 _emit_scalar_trace(message, egress, a0)
             finally:
@@ -734,7 +793,8 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                             route_broadcast(broker, pruned, raw,
                                             to_users_only=True,
                                             egress=egress,
-                                            interest_cache=interest_cache)
+                                            interest_cache=interest_cache,
+                                            raw_topics=message.topics)
                             _emit_scalar_trace(message, egress, a0)
                     elif isinstance(message, UserSync):
                         broker.connections.apply_user_sync(message.payload)
@@ -768,7 +828,8 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                             route_broadcast(broker, pruned, raw,
                                             to_users_only=True,
                                             egress=egress,
-                                            interest_cache=interest_cache)
+                                            interest_cache=interest_cache,
+                                            raw_topics=message.topics)
                         _emit_scalar_trace(message, egress, a0)
             finally:
                 try:

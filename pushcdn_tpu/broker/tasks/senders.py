@@ -72,16 +72,17 @@ def pre_encode_frames(raws) -> Optional[bytearray]:
 
 
 async def try_send_to_user(broker: "Broker", public_key: bytes,
-                           raw: Bytes) -> bool:
+                           raw: Bytes, cls: int = 2) -> bool:
     """Queue ``raw`` (one clone) to a local user; remove the user on
     failure. The clone is released by the writer task after the frame hits
-    the stream, or by us on failure."""
+    the stream, or by us on failure. ``cls`` is the flow class counted at
+    the writer (default ``live`` — this is a data-frame path)."""
     connection = broker.connections.get_user_connection(public_key)
     if connection is None:
         return False
     clone = raw.clone()
     try:
-        await connection.send_raw(clone)
+        await connection.send_raw(clone, cls=cls)
         return True
     except Exception as exc:
         clone.release()
@@ -110,7 +111,9 @@ def try_send_frames_to_user_nowait(broker: "Broker", public_key: bytes,
     encoded = pre_encode_frames(raws)
     try:
         if encoded is not None:
-            connection.send_encoded_nowait(encoded)
+            # nframes carries the batch's frame count into the writer's
+            # class accounting (an encoded stream is otherwise opaque)
+            connection.send_encoded_nowait(encoded, nframes=len(raws))
         else:
             # the connection owns the clones from here (released on
             # failure too)
@@ -125,16 +128,18 @@ def try_send_frames_to_user_nowait(broker: "Broker", public_key: bytes,
 
 
 def try_send_encoded_to_user_nowait(broker: "Broker", public_key: bytes,
-                                    data, owner=None) -> bool:
+                                    data, owner=None,
+                                    nframes: int = 0) -> bool:
     """Queue a pre-framed egress stream (native.egress_encode output) to
     one user — zero per-frame work here or in the writer; a failure
     removes the user (failure-is-removal, as everywhere). ``owner`` keeps
-    a pooled egress buffer alive until the flush completes."""
+    a pooled egress buffer alive until the flush completes. ``nframes``
+    feeds the writer's class accounting (the stream itself is opaque)."""
     connection = broker.connections.get_user_connection(public_key)
     if connection is None:
         return False
     try:
-        connection.send_encoded_nowait(data, owner)
+        connection.send_encoded_nowait(data, owner, nframes=nframes)
         return True
     except Exception as exc:
         logger.info("encoded send to user %s failed (%r)%s; removing",
@@ -154,7 +159,8 @@ def egress_streams(broker: "Broker", slots, streams) -> int:
         if key is None:  # released mid-step: user is gone, drop
             continue
         if try_send_encoded_to_user_nowait(broker, key, streams.stream(slot),
-                                           owner=streams):
+                                           owner=streams,
+                                           nframes=int(streams.msgs[slot])):
             routed += int(streams.msgs[slot])
     return routed
 

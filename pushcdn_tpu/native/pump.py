@@ -108,7 +108,7 @@ def _compile() -> Optional[ctypes.CDLL]:
     lib.pushcdn_pump_route_chunk.restype = _i64
     lib.pushcdn_pump_route_chunk.argtypes = [
         P, P, u8p, _i64, _i64p, _i64p, _i64, _i64, ctypes.c_int,
-        _i32p, _i32p, _i64, _i64p]
+        _i32p, _i32p, _i64, _i64p, u8p]
     lib.pushcdn_pump_drain.restype = ctypes.c_int
     lib.pushcdn_pump_drain.argtypes = [P, _u64p, _i32p, _u32p,
                                        ctypes.c_int, _i64p, ctypes.c_long,
@@ -154,7 +154,7 @@ class NativePump:
     __slots__ = ("_lib", "_h", "_ring", "pair_cap", "chunk_slots",
                  "_resid_peer", "_resid_frame", "_meta", "_uds", "_ress",
                  "_flagss", "_events", "_released", "_stats", "_pstats",
-                 "_n_events", "_n_prepped")
+                 "_n_events", "_n_prepped", "_frame_cls")
 
     def __init__(self, lib, handle, ring, pair_cap: int, chunk_slots: int):
         self._lib = lib
@@ -174,6 +174,7 @@ class NativePump:
         self._pstats = (_i64 * 6)()
         self._n_events = ctypes.c_long(0)
         self._n_prepped = ctypes.c_long(0)
+        self._frame_cls = np.zeros(1024, np.uint8)
 
     @classmethod
     def create(cls, ring, max_peers: int = 4096, chunk_slots: int = 64,
@@ -245,20 +246,33 @@ class NativePump:
         """Plan + pump one chunk. Returns ``(consumed, stop,
         resid_peers, resid_frames, meta)`` where the resid arrays are
         int32 VIEWS over instance scratch (consume before the next
-        call) and ``meta`` is the int64[16] out_meta view."""
+        call) and ``meta`` is the int64[16] out_meta view.
+
+        Per-frame flow classes land in the ``frame_classes`` scratch
+        (absolute frame index; 255 = consumed, delivered to no one)."""
         arr = np.frombuffer(buf, np.uint8)
         count = len(offs) - start
+        if len(self._frame_cls) < len(offs):
+            self._frame_cls = np.zeros(
+                max(len(offs), 2 * len(self._frame_cls)), np.uint8)
         consumed = self._lib.pushcdn_pump_route_chunk(
             self._h, table_handle, _ptr(arr, ctypes.c_uint8), len(arr),
             _ptr(offs, _i64), _ptr(lens, _i64), start, count, mode,
             _ptr(self._resid_peer, ctypes.c_int),
             _ptr(self._resid_frame, ctypes.c_int),
-            self.pair_cap, _ptr(self._meta, _i64))
+            self.pair_cap, _ptr(self._meta, _i64),
+            _ptr(self._frame_cls, ctypes.c_uint8))
         meta = self._meta
         n_resid = int(meta[META_N_RESID])
         return (int(consumed), int(meta[META_STOP]),
                 self._resid_peer[:n_resid], self._resid_frame[:n_resid],
                 meta)
+
+    @property
+    def frame_classes(self) -> np.ndarray:
+        """Per-frame flow classes from the last ``route_chunk`` (absolute
+        frame index; only [start, start+consumed) meaningful)."""
+        return self._frame_cls
 
     def drain(self):
         """Drain the ring's CQ through the pump. Returns ``(cqes,

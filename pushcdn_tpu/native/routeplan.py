@@ -62,11 +62,13 @@ def _compile():
         u8p, i64p, i32p, i32p, ctypes.c_int32]
     lib.pushcdn_route_table_stats.restype = None
     lib.pushcdn_route_table_stats.argtypes = [ctypes.c_void_p, i64p]
+    lib.pushcdn_route_table_set_classes.restype = ctypes.c_int32
+    lib.pushcdn_route_table_set_classes.argtypes = [ctypes.c_void_p, u8p]
     lib.pushcdn_route_plan.restype = ctypes.c_int64
     lib.pushcdn_route_plan.argtypes = [
         ctypes.c_void_p, u8p, ctypes.c_int64, i64p, i64p,
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
-        i32p, i32p, ctypes.c_int64, i64p, i32p]
+        i32p, i32p, ctypes.c_int64, i64p, i32p, u8p]
     lib.pushcdn_route_gather.restype = ctypes.c_int64
     lib.pushcdn_route_gather.argtypes = [
         u8p, ctypes.c_int64, i64p, i64p, i32p, ctypes.c_int64,
@@ -111,13 +113,14 @@ class RoutePlanner:
     """
 
     __slots__ = ("_lib", "_handle", "_pair_peer", "_pair_frame",
-                 "n_users", "n_brokers")
+                 "_frame_cls", "n_users", "n_brokers")
 
     def __init__(self, lib, handle):
         self._lib = lib
         self._handle = handle
         self._pair_peer = np.zeros(4096, np.int32)
         self._pair_frame = np.zeros(4096, np.int32)
+        self._frame_cls = np.zeros(1024, np.uint8)
         self.n_users = 0
         self.n_brokers = 0
 
@@ -214,11 +217,26 @@ class RoutePlanner:
                 "keys_blob_bytes": int(out[6]),
                 "keys_blob_garbage": int(out[7])}
 
+    def set_classes(self, classes: np.ndarray) -> bool:
+        """Install the topic -> flow-class map (u8[256], values 0..3 per
+        ``proto.flowclass``). Survives ``build``/``apply``: the taxonomy
+        is deployment config, not routing state."""
+        table = np.ascontiguousarray(classes, np.uint8)
+        if table.shape != (256,):
+            return False
+        return self._lib.pushcdn_route_table_set_classes(
+            self._handle, _ptr(table, ctypes.c_uint8)) == 0
+
     def _ensure_pairs(self, need: int) -> None:
         if len(self._pair_peer) < need:
             cap = max(need, 2 * len(self._pair_peer))
             self._pair_peer = np.zeros(cap, np.int32)
             self._pair_frame = np.zeros(cap, np.int32)
+
+    def _ensure_classes(self, need: int) -> None:
+        if len(self._frame_cls) < need:
+            cap = max(need, 2 * len(self._frame_cls))
+            self._frame_cls = np.zeros(cap, np.uint8)
 
     def plan(self, buf: bytes, offs: np.ndarray, lens: np.ndarray,
              start: int, mode: int
@@ -227,13 +245,18 @@ class RoutePlanner:
 
         Returns (consumed, stop_reason, peer_idx, frame_idx) where the
         pair arrays are views into reusable scratch (valid until the next
-        call). ``mode`` 0 = user-origin, 1 = broker-origin."""
+        call). ``mode`` 0 = user-origin, 1 = broker-origin.
+
+        Per-frame flow classes land in the ``frame_classes`` scratch
+        (absolute frame index; 255 = consumed but delivered to no one),
+        valid for the same window as the pair views."""
         count = len(offs) - start
         n_peers = self.n_users + self.n_brokers
         # capacity for the worst case (every frame fans to every peer)
         # is overkill; size for one guaranteed frame of progress plus a
         # typical batch, and let STOP_CAPACITY loop handle the rest
         self._ensure_pairs(max(n_peers + 1, 4096))
+        self._ensure_classes(len(offs))
         arr = np.frombuffer(buf, np.uint8) if buf else np.zeros(1, np.uint8)
         n_pairs = ctypes.c_int64(0)
         stop = ctypes.c_int32(0)
@@ -243,12 +266,19 @@ class RoutePlanner:
             start, count, mode,
             _ptr(self._pair_peer, ctypes.c_int32),
             _ptr(self._pair_frame, ctypes.c_int32),
-            len(self._pair_peer), ctypes.byref(n_pairs), ctypes.byref(stop))
+            len(self._pair_peer), ctypes.byref(n_pairs), ctypes.byref(stop),
+            _ptr(self._frame_cls, ctypes.c_uint8))
         if consumed < 0:
             return 0, STOP_RESIDUAL, self._pair_peer[:0], self._pair_frame[:0]
         k = n_pairs.value
         return (int(consumed), int(stop.value),
                 self._pair_peer[:k], self._pair_frame[:k])
+
+    @property
+    def frame_classes(self) -> np.ndarray:
+        """Per-frame flow classes from the last ``plan`` call, indexed by
+        absolute frame index (only [start, start+consumed) meaningful)."""
+        return self._frame_cls
 
     def gather(self, buf: bytes, offs: np.ndarray, lens: np.ndarray,
                frame_idx: np.ndarray) -> Optional[bytearray]:

@@ -101,6 +101,17 @@ def _compile() -> Optional[ctypes.CDLL]:
     lib.pcu_flush_overflow.argtypes = [P]
     lib.pcu_peek_cqes.restype = ctypes.c_int
     lib.pcu_peek_cqes.argtypes = [P, _u64p, _i32p, _u32p, ctypes.c_int]
+    lib.pcu_telem_enable.restype = ctypes.c_int
+    lib.pcu_telem_enable.argtypes = [P]
+    lib.pcu_telem_enabled.restype = ctypes.c_int
+    lib.pcu_telem_enabled.argtypes = [P]
+    lib.pcu_telem_words.restype = ctypes.c_long
+    lib.pcu_telem_words.argtypes = []
+    lib.pcu_telem_snapshot.restype = ctypes.c_long
+    lib.pcu_telem_snapshot.argtypes = [P, _u64p, ctypes.c_long]
+    lib.pcu_telem_test_observe.restype = ctypes.c_int
+    lib.pcu_telem_test_observe.argtypes = [P, ctypes.c_int, ctypes.c_int,
+                                           _u64, _u64]
     return lib
 
 
@@ -165,6 +176,69 @@ MSG_WAITALL = 0x100
 MSG_NOSIGNAL = 0x4000
 
 _CQ_BATCH = 512
+
+# -- shm telemetry block layout (mirror of pcu_telem in io_uring.cpp) --
+# The snapshot is a flat u64 payload (the seqlock word is stripped); the
+# offsets below index into it. A pcu_hist is {count, sum_ns, bucket[64]}
+# where bucket[k] counts durations in [2^(k-1), 2^k) ns (0 -> bucket 0).
+TM_BUCKETS = 64
+TM_STAGES = 4     # 0=plan 1=submit 2=wire 3=total
+TM_CHAIN = 2      # 0=enter (io_uring_enter wall) 1=chain (submit->quiesce)
+TM_CLASSES = 4    # 0=control 1=consensus 2=live 3=bulk
+TM_PEERS = 64
+TM_HIST_WORDS = 2 + TM_BUCKETS
+TM_STAGE_OFF = 0
+TM_CHAIN_OFF = TM_STAGE_OFF + TM_STAGES * TM_HIST_WORDS
+TM_CLASS_DELAY_OFF = TM_CHAIN_OFF + TM_CHAIN * TM_HIST_WORDS
+TM_CLASS_FRAMES_OFF = TM_CLASS_DELAY_OFF + TM_CLASSES * TM_HIST_WORDS
+TM_CLASS_BYTES_OFF = TM_CLASS_FRAMES_OFF + TM_CLASSES
+TM_PEER_FD_OFF = TM_CLASS_BYTES_OFF + TM_CLASSES
+TM_PEER_FRAMES_OFF = TM_PEER_FD_OFF + TM_PEERS
+TM_PEER_BYTES_OFF = TM_PEER_FRAMES_OFF + TM_PEERS
+TM_PEER_USED_OFF = TM_PEER_BYTES_OFF + TM_PEERS
+TM_WORDS = TM_PEER_USED_OFF + 1
+
+STAGE_NAMES = ("plan", "submit", "wire", "total")
+CHAIN_NAMES = ("enter", "chain")
+CLASS_NAMES = ("control", "consensus", "live", "bulk")
+
+
+def _tm_hist(words, off):
+    return {"count": int(words[off]), "sum_ns": int(words[off + 1]),
+            "buckets": [int(words[off + 2 + k]) for k in range(TM_BUCKETS)]}
+
+
+def parse_telemetry(words):
+    """Decode a raw snapshot (sequence of TM_WORDS u64s) into dicts —
+    shared by the /metrics pre-render hook and the tests so the layout
+    is asserted in exactly one place."""
+    if words is None or len(words) < TM_WORDS:
+        return None
+    out = {
+        "stage": {STAGE_NAMES[i]:
+                  _tm_hist(words, TM_STAGE_OFF + i * TM_HIST_WORDS)
+                  for i in range(TM_STAGES)},
+        "chain": {CHAIN_NAMES[i]:
+                  _tm_hist(words, TM_CHAIN_OFF + i * TM_HIST_WORDS)
+                  for i in range(TM_CHAIN)},
+        "class_delay": {CLASS_NAMES[i]:
+                        _tm_hist(words, TM_CLASS_DELAY_OFF
+                                 + i * TM_HIST_WORDS)
+                        for i in range(TM_CLASSES)},
+        "class_frames": {CLASS_NAMES[i]:
+                         int(words[TM_CLASS_FRAMES_OFF + i])
+                         for i in range(TM_CLASSES)},
+        "class_bytes": {CLASS_NAMES[i]: int(words[TM_CLASS_BYTES_OFF + i])
+                        for i in range(TM_CLASSES)},
+    }
+    used = min(int(words[TM_PEER_USED_OFF]), TM_PEERS)
+    out["peers"] = [
+        {"fd": int(words[TM_PEER_FD_OFF + i]),
+         "frames": int(words[TM_PEER_FRAMES_OFF + i]),
+         "bytes": int(words[TM_PEER_BYTES_OFF + i])}
+        for i in range(used)
+    ]
+    return out
 
 
 class RingError(OSError):
@@ -324,6 +398,42 @@ class Ring:
                 return []
         uds, ress, flags = self._cq_uds, self._cq_ress, self._cq_flags
         return [(uds[i], ress[i], flags[i]) for i in range(n)]
+
+    # -- shm telemetry block (ISSUE 19) --
+
+    def enable_telemetry(self) -> bool:
+        """Attach the shm telemetry block (idempotent). Best-effort:
+        returns False when the mmap is denied — telemetry is an
+        observability plane, never a reason to fail the ring."""
+        if not self._h:
+            return False
+        return int(self._lib.pcu_telem_enable(self._h)) == 0
+
+    @property
+    def telemetry_enabled(self) -> bool:
+        return bool(self._h) and \
+            bool(self._lib.pcu_telem_enabled(self._h))
+
+    def telemetry_snapshot(self):
+        """Torn-read-safe snapshot of the telemetry payload as a list of
+        TM_WORDS ints, or None when telemetry is off / unreadable."""
+        if not self._h:
+            return None
+        words = int(self._lib.pcu_telem_words())
+        buf = (_u64 * words)()
+        n = int(self._lib.pcu_telem_snapshot(self._h, buf, words))
+        if n <= 0:
+            return None
+        return list(buf[:n])
+
+    def telemetry_test_observe(self, kind: int, idx: int, ns: int,
+                               n: int = 1) -> int:
+        """Test hook: drive one histogram observation from Python
+        (kind 0=stage 1=chain 2=class_delay)."""
+        if not self._h:
+            return -1
+        return int(self._lib.pcu_telem_test_observe(
+            self._h, kind, idx, ns, n))
 
     def pbuf_read(self, bid: int, nbytes: int) -> bytes:
         """Copy a provided buffer's payload out (the one copy the recv

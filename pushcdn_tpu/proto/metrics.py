@@ -399,6 +399,169 @@ POOL_BYTES = Gauge(
     "(state=in_use|capacity)",
     labels=("state",))
 
+# -- per-class flow accounting (ISSUE 19) -----------------------------------
+# Classes come from proto/flowclass.py (0=control 1=consensus 2=live
+# 3=bulk). dir=out counts fan-out deliveries (one per (frame, peer)
+# pair, stamped BEFORE the connection lookup so the scalar, cut-through
+# and pumped paths count identically); dir=in counts consumed ingress
+# frames. Both the Python writer and the native pump feed the same
+# families, so the split stays comparable across engagement changes.
+_CLASS_NAMES = ("control", "consensus", "live", "bulk")
+CLASS_FRAMES = Counter(
+    "cdn_class_frames",
+    "Frames moved per flow class (dir=in consumed ingress, dir=out "
+    "fan-out deliveries; taxonomy per proto/flowclass.py)",
+    labels=("class", "dir"))
+CLASS_BYTES = Counter(
+    "cdn_class_bytes",
+    "Wire bytes (payload + 4-byte length header) per flow class",
+    labels=("class", "dir"))
+CLASS_FRAMES_OUT = tuple(CLASS_FRAMES.labels(**{"class": c, "dir": "out"})
+                         for c in _CLASS_NAMES)
+CLASS_FRAMES_IN = tuple(CLASS_FRAMES.labels(**{"class": c, "dir": "in"})
+                        for c in _CLASS_NAMES)
+CLASS_BYTES_OUT = tuple(CLASS_BYTES.labels(**{"class": c, "dir": "out"})
+                        for c in _CLASS_NAMES)
+CLASS_BYTES_IN = tuple(CLASS_BYTES.labels(**{"class": c, "dir": "in"})
+                       for c in _CLASS_NAMES)
+
+WRITER_QUEUE_DELAY = Histogram(
+    "cdn_writer_queue_delay_seconds",
+    "Head-of-line delay per flow class: writer-queue enqueue -> the "
+    "writer loop dequeuing the entry (the ROADMAP item-4 scheduling "
+    "input; inline fast-path sends never queue and are not observed)",
+    buckets=(1e-6, 1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5,
+             1.0, 5.0),
+    labels=("class",))
+WRITER_QUEUE_DELAY_CLS = tuple(WRITER_QUEUE_DELAY.labels(**{"class": c})
+                               for c in _CLASS_NAMES)
+
+# Per-peer writer-queue depth: the top-K deepest connections by label,
+# refreshed at render. The rest fold into peer="other"; the family's
+# cardinality is capped like the task profiler's (a runaway connection
+# churn must not bloat every scrape forever).
+WRITER_QUEUE_DEPTH_PEER = Gauge(
+    "cdn_writer_queue_depth_peer",
+    "Send-queue depth of the deepest live connections (top-K by depth; "
+    "the rest aggregate under peer=\"other\")",
+    labels=("peer",))
+
+# Retention / replay observability (ISSUE 19 tentpole 3): refreshed at
+# render by broker/retention.py's pre-render hook over live stores.
+RETENTION_RING_BYTES = Gauge(
+    "cdn_retention_ring_bytes",
+    "Payload bytes resident in durable-topic retention rings",
+    labels=("topic",))
+RETENTION_RING_ENTRIES = Gauge(
+    "cdn_retention_ring_entries",
+    "Entries resident in durable-topic retention rings",
+    labels=("topic",))
+RETENTION_EVICTIONS = Counter(
+    "cdn_retention_evictions",
+    "Retention-ring evictions by reason (bytes = per-topic byte budget, "
+    "entries = per-topic entry budget, age = max-age expiry)",
+    labels=("reason",))
+REPLAY_LAG = Gauge(
+    "cdn_replay_lag_entries",
+    "Entries between a replaying subscriber's cursor and the retention "
+    "ring head (top-K laggards; the rest aggregate under "
+    "subscriber=\"other\")",
+    labels=("subscriber",))
+
+
+# -- native shm telemetry (ISSUE 19 tentpole 1) -----------------------------
+# The uring engine + fused pump accumulate log2-ns histograms into a
+# lock-free shared block written from C (zero hot-path Python). A
+# pre-render hook (registered by proto/transport/uring.py) snapshots it
+# and pushes the aggregate here; these classes only RENDER.
+
+# rendered bucket window: fold sub-256ns into the first bucket's
+# cumulative count, stop explicit buckets at ~1100s (the remainder only
+# shows in +Inf) — a fixed layout so scrapes compare across processes
+_TM_LO_BUCKET = 8
+_TM_HI_BUCKET = 40
+
+
+class _NativeLog2Histogram:
+    """Prometheus histogram family rendered from a native log2-ns
+    telemetry snapshot. ``update`` replaces a label's series wholesale
+    (the native block is the source of truth; values are monotonic
+    because closing engines fold their final snapshot into a carry)."""
+
+    def __init__(self, name: str, help_: str, label: str):
+        self.name = name
+        self.help = help_
+        self.label = label
+        self.series: Dict[str, dict] = {}
+        _REGISTRY[name] = self
+
+    def update(self, value: str, hist: dict) -> None:
+        self.series[value] = hist
+
+    def render(self) -> str:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        for val in sorted(self.series):
+            h = self.series[val]
+            lab = f'{self.label}="{_escape_label(val)}"'
+            cum = 0
+            for k, c in enumerate(h["buckets"]):
+                cum += c
+                if k < _TM_LO_BUCKET or k > _TM_HI_BUCKET:
+                    continue
+                le = float(1 << k) / 1e9
+                out.append(f'{self.name}_bucket{{{lab},le="{le:.9g}"}} '
+                           f'{cum}')
+            out.append(f'{self.name}_bucket{{{lab},le="+Inf"}} '
+                       f'{h["count"]}')
+            out.append(f'{self.name}_sum{{{lab}}} {h["sum_ns"] / 1e9}')
+            out.append(f'{self.name}_count{{{lab}}} {h["count"]}')
+        return "\n".join(out) + "\n"
+
+
+PUMP_STAGE_SECONDS = _NativeLog2Histogram(
+    "cdn_pump_stage_seconds",
+    "Native pump stage latency stamped from C with CLOCK_MONOTONIC "
+    "(stage=plan: recv-CQE -> route-plan done; submit: plan -> SQE "
+    "staged; wire: SQE submit -> send-CQE; total: recv-CQE -> "
+    "send-CQE)", "stage")
+URING_CHAIN_SECONDS = _NativeLog2Histogram(
+    "cdn_uring_chain_seconds",
+    "io_uring engine timing (stat=enter: one io_uring_enter syscall "
+    "wall time; chain: pumped linked-chain submit -> quiesce)", "stat")
+PUMP_CLASS_DELAY_SECONDS = _NativeLog2Histogram(
+    "cdn_pump_class_delay_seconds",
+    "Pumped per-frame recv -> send-CQE delay by flow class", "class")
+
+# last folded native class totals (the pumped counters are monotonic
+# aggregates: live engines + closed-engine carry; fold only the delta)
+_native_class_last: Dict[tuple, int] = {}
+
+
+def update_native_telemetry(totals: Optional[dict]) -> None:
+    """Publish one aggregated native telemetry snapshot (the output of
+    ``native.uring.parse_telemetry`` summed over live engines plus the
+    closed-engine carry). Called by the transport's pre-render hook;
+    histograms are replaced, pumped class counters fold by delta into
+    the shared cdn_class_* families (dir=out)."""
+    if not totals:
+        return
+    for stage, h in totals["stage"].items():
+        PUMP_STAGE_SECONDS.update(stage, h)
+    for stat, h in totals["chain"].items():
+        URING_CHAIN_SECONDS.update(stat, h)
+    for cls, h in totals["class_delay"].items():
+        PUMP_CLASS_DELAY_SECONDS.update(cls, h)
+    for i, cls in enumerate(_CLASS_NAMES):
+        for kind, child_row, series in (
+                ("frames", CLASS_FRAMES_OUT, totals["class_frames"]),
+                ("bytes", CLASS_BYTES_OUT, totals["class_bytes"])):
+            cur = int(series.get(cls, 0))
+            last = _native_class_last.get((kind, cls), 0)
+            if cur > last:
+                child_row[i].inc(cur - last)
+            _native_class_last[(kind, cls)] = max(cur, last)
+
 
 # Callables run before every render: components whose counters move on
 # hot paths (device-plane steps) register a refresh here instead of
@@ -557,12 +720,21 @@ def register_bls_pk_cache_metrics() -> None:
         PRE_RENDER_HOOKS.append(_refresh_bls_pk_cache)
 
 
+_TOP_K_QUEUE_PEERS = 8
+_MAX_PEER_SERIES = 64  # created-children cap, like the task profiler's
+_peer_depth_live: set = set()
+
+
 def _refresh_writer_queues() -> None:
     """Sum/max of send-queue depths across live connections (the transport
-    layer keeps a weak registry). Lazy module lookup: a process that never
-    created a connection reports zeros without importing the transport."""
+    layer keeps a weak registry), plus the top-K deepest peers by label —
+    the head-of-line victim is invisible in an aggregate. Lazy module
+    lookup: a process that never created a connection reports zeros
+    without importing the transport."""
+    global _peer_depth_live
     base = sys.modules.get("pushcdn_tpu.proto.transport.base")
     total = depth_max = 0
+    depths = []
     if base is not None:
         for conn in list(base.LIVE_CONNECTIONS):
             try:
@@ -572,8 +744,29 @@ def _refresh_writer_queues() -> None:
             total += d
             if d > depth_max:
                 depth_max = d
+            if d > 0:
+                depths.append((d, getattr(conn, "label", "?")))
     WRITER_QUEUE_DEPTH.labels(stat="sum").set(total)
     WRITER_QUEUE_DEPTH.labels(stat="max").set(depth_max)
+    depths.sort(key=lambda t: (-t[0], t[1]))
+    live = set()
+    other = 0
+    for rank, (d, label) in enumerate(depths):
+        # bounded cardinality: only top-K rank a series, and a label that
+        # would grow the family past the cap folds into "other" too
+        if rank >= _TOP_K_QUEUE_PEERS or (
+                (label,) not in WRITER_QUEUE_DEPTH_PEER._children
+                and len(WRITER_QUEUE_DEPTH_PEER._children)
+                >= _MAX_PEER_SERIES):
+            other += d
+            continue
+        WRITER_QUEUE_DEPTH_PEER.labels(peer=label).set(d)
+        live.add(label)
+    WRITER_QUEUE_DEPTH_PEER.labels(peer="other").set(other)
+    live.add("other")
+    for stale in _peer_depth_live - live:
+        WRITER_QUEUE_DEPTH_PEER.labels(peer=stale).set(0)
+    _peer_depth_live = live
 
 
 def _refresh_pools() -> None:

@@ -24,6 +24,7 @@ from __future__ import annotations
 import abc
 import asyncio
 import struct
+import time
 import weakref
 from collections import deque
 from typing import List, Optional
@@ -355,7 +356,7 @@ class Connection:
                     break
                 if item is _CLOSE or isinstance(item, Error):
                     continue
-                if isinstance(item, tuple):  # send entry: (payload, done)
+                if isinstance(item, tuple):  # entry: (payload, done, stamp)
                     item = item[0]
                     if type(item) is PreEncoded:
                         continue
@@ -495,7 +496,7 @@ class Connection:
                             # is in neither the queue nor `batch` — its
                             # permits and flush future are ours to settle
                             if item is not _CLOSE:
-                                payload, done = item
+                                payload, done = item[0], item[1]
                                 if type(payload) is list:
                                     for p in payload:
                                         if isinstance(p, Bytes):
@@ -537,6 +538,22 @@ class Connection:
                     entry[1].set_exception(err)
             self._poison(err)
 
+    def _account_entry(self, entry, now: float) -> None:
+        """Per-class flow accounting at dequeue: the entry's enqueue stamp
+        is ``(t_enq, class, frames, bytes)`` — observe the writer-queue
+        delay for its class and fold the frame/byte counts into the egress
+        class counters. Accounts entries dequeued FOR writing (a flush
+        that subsequently fails is still counted here; ``BYTES_SENT``
+        remains the flushed-bytes ground truth)."""
+        stamp = entry[2]
+        if stamp is None:
+            return
+        metrics_mod.WRITER_QUEUE_DELAY_CLS[stamp[1]].observe(now - stamp[0])
+        if stamp[2]:
+            metrics_mod.CLASS_FRAMES_OUT[stamp[1]].inc(stamp[2])
+        if stamp[3]:
+            metrics_mod.CLASS_BYTES_OUT[stamp[1]].inc(stamp[3])
+
     async def _writer_item(self, item, encoder_cell, enc_cap,
                            batch: list) -> bool:
         """Process one dequeued writer entry (and any batchable run behind
@@ -548,13 +565,16 @@ class Connection:
         if item is _CLOSE:
             await self._stream.close()
             return True
+        # one clock read per wakeup covers every entry this drain accounts
+        now = time.monotonic()
+        self._account_entry(item, now)
         # Depth-1 fast path (the latency regime): one small single frame
         # and nothing else queued — write it directly, skipping batch
         # assembly, the get_nowait exception, flattening and encoder
         # probing. This is what a handshake or an idle-link echo pays per
         # message.
         if self._send_q.empty():
-            payload, done = item
+            payload, done = item[0], item[1]
             if type(payload) is PreEncoded:
                 # a PreEncoded entry IS a fan-out batch (routing-loop /
                 # device-plane egress): it counts as the load regime, so
@@ -597,6 +617,7 @@ class Connection:
             batch.append(nxt)
             if nxt is _CLOSE:
                 break
+            self._account_entry(nxt, now)
 
         if encoder_cell[0] is False:
             encoder_cell[0] = native.FrameEncoder.create(
@@ -612,7 +633,7 @@ class Connection:
                 if entry is _CLOSE:
                     close_after = True
                     break
-                payload, done = entry
+                payload, done = entry[0], entry[1]
                 if type(payload) is list:
                     for p in payload:
                         frames.append(
@@ -988,7 +1009,7 @@ class Connection:
                 break
             if item is _CLOSE:
                 continue
-            payload, done = item
+            payload, done = item[0], item[1]
             if type(payload) is list:
                 for p in payload:
                     if isinstance(p, Bytes):
@@ -1032,12 +1053,17 @@ class Connection:
     async def send_message(self, message: Message, flush: bool = False) -> None:
         await self.send_raw(serialize(message), flush=flush)
 
-    async def send_raw(self, raw, flush: bool = False) -> None:
+    async def send_raw(self, raw, flush: bool = False, cls: int = 0) -> None:
         """Queue a pre-serialized frame (``bytes`` or :class:`Bytes`).
 
         With ``flush=True``, wait until the frame hits the stream — used by
         handshakes; the hot path queues and returns (reference
         send_message_raw semantics).
+
+        ``cls`` is the frame's flow class (flowclass taxonomy; 0/control
+        default fits the protocol traffic this entry point mostly carries)
+        — it rides the queue entry so the writer can account per-class
+        queue delay and egress volume at dequeue.
 
         Inline fast path: a flushed small frame on an idle link is written
         directly from the caller's task (no writer-task wakeup, no done
@@ -1073,8 +1099,14 @@ class Connection:
                     if isinstance(raw, Bytes):
                         raw.release()
                     self._write_mutex.release()
+                # inline path: zero queue delay by construction, so only
+                # the volume counters move
+                metrics_mod.CLASS_FRAMES_OUT[cls & 3].inc()
+                metrics_mod.CLASS_BYTES_OUT[cls & 3].inc(len(data) + 4)
                 return
         done = asyncio.get_running_loop().create_future() if flush else None
+        nb = (len(raw.data) if isinstance(raw, Bytes) else len(raw)) + 4
+        stamp = (time.monotonic(), cls & 3, 1, nb)
         q = self._send_q
         if q.maxsize <= 0:
             # unbounded (the default): skip the awaited put's coroutine
@@ -1083,23 +1115,26 @@ class Connection:
             # than losing every freed slot to a put_nowait fast path
             # (asyncio.Queue has no hard slot reservation, so a racing
             # sender can still occasionally win the wakeup window).
-            q.put_nowait((raw, done))
+            q.put_nowait((raw, done, stamp))
         else:
-            await q.put((raw, done))
+            await q.put((raw, done, stamp))
         self._ensure_writer()
         if self._error is not None:  # poisoned while enqueueing
             raise self._error
         if done is not None:
             await done
 
-    def send_raw_nowait(self, raw) -> None:
+    def send_raw_nowait(self, raw, cls: int = 2) -> None:
         """Queue a frame without awaiting; raises ``asyncio.QueueFull`` when
         the per-connection queue bound is hit (callers treat that as a
         failed send). Used by the device-plane egress so one backpressured
-        peer can't stall the pump."""
+        peer can't stall the pump (hence the ``live`` class default)."""
         self._check()
+        cls &= 3
+        nb = (len(raw.data) if isinstance(raw, Bytes) else len(raw)) + 4
         try:
-            self._send_q.put_nowait((raw, None))
+            self._send_q.put_nowait(
+                (raw, None, (time.monotonic(), cls, 1, nb)))
         except asyncio.QueueFull:
             self.flightrec.record("backpressure", "send queue full")
             raise
@@ -1107,10 +1142,17 @@ class Connection:
         if self._error is not None:
             raise self._error
 
-    async def send_raw_many(self, raws: list, flush: bool = False) -> None:
+    async def send_raw_many(self, raws: list, flush: bool = False,
+                            cls: int = 2, nframes=None, nbytes=None) -> None:
         """Queue a whole batch of pre-serialized frames as ONE queue entry
         (one writer wakeup for the lot) — the routing loops build per-peer
         batches and hand them over here.
+
+        ``cls``/``nframes``/``nbytes`` stamp the entry for per-class
+        accounting: ``None`` means count the batch here (len + byte walk);
+        a caller that already accounted its frames per-class (mixed-class
+        plan bincounts) passes ``nframes=0, nbytes=0`` so the writer only
+        observes the queue delay.
 
         Ownership semantics are stricter than :meth:`send_raw`: every
         :class:`Bytes` in ``raws`` is ALWAYS released by this connection —
@@ -1125,13 +1167,19 @@ class Connection:
                 if isinstance(p, Bytes):
                     p.release()
             raise
+        if nframes is None:
+            nframes = len(raws)
+        if nbytes is None:
+            nbytes = sum(len(p.data) if isinstance(p, Bytes) else len(p)
+                         for p in raws) + 4 * len(raws)
+        stamp = (time.monotonic(), cls & 3, nframes, nbytes)
         try:
             q = self._send_q
             if q.maxsize <= 0:
-                q.put_nowait((raws, done))  # unbounded: no coroutine hop
+                q.put_nowait((raws, done, stamp))  # unbounded: no coroutine hop
                 self._ensure_writer()
             else:
-                await q.put((raws, done))  # bounded: queue behind waiters
+                await q.put((raws, done, stamp))  # bounded: behind waiters
                 self._ensure_writer()
         except BaseException:
             # cancelled while blocked on a bounded queue: never inserted
@@ -1148,17 +1196,25 @@ class Connection:
         if done is not None:
             await done
 
-    def send_encoded_nowait(self, data, owner=None) -> None:
+    def send_encoded_nowait(self, data, owner=None, cls: int = 2,
+                            nframes: int = 0, nbytes=None) -> None:
         """Queue an ALREADY length-delimited byte stream (one or many
         frames, each u32-BE-prefixed) to be written verbatim — the
         device-plane egress path: the native engine frames a whole step's
         deliveries per user in C, so the writer's only job is the flush.
         ``data`` may be a memoryview over the step's shared egress buffer;
         pass the buffer's holder (e.g. the ``EgressStreams``) as ``owner``
-        so a pooled buffer cannot be recycled under the pending write."""
+        so a pooled buffer cannot be recycled under the pending write.
+
+        The stream is opaque here (already framed), so callers that know
+        the frame count pass ``nframes``; ``nbytes`` defaults to the
+        stream's length (header bytes included — it IS the wire image)."""
         self._check()
+        if nbytes is None:
+            nbytes = len(data)
+        stamp = (time.monotonic(), cls & 3, nframes, nbytes)
         try:
-            self._send_q.put_nowait((PreEncoded(data, owner), None))
+            self._send_q.put_nowait((PreEncoded(data, owner), None, stamp))
         except asyncio.QueueFull:
             self.flightrec.record("backpressure", "send queue full")
             raise
@@ -1166,15 +1222,20 @@ class Connection:
         if self._error is not None:
             raise self._error
 
-    async def send_encoded(self, data, owner=None, flush: bool = False) -> None:
+    async def send_encoded(self, data, owner=None, flush: bool = False,
+                           cls: int = 2, nframes: int = 0,
+                           nbytes=None) -> None:
         """Awaited twin of :meth:`send_encoded_nowait`: queues behind a
         bounded send queue instead of raising ``QueueFull`` — the routing
         loops' pre-encoded egress handoff (one writer entry, one verbatim
         flush for a whole per-peer fan-out batch)."""
         self._check()
         done = asyncio.get_running_loop().create_future() if flush else None
+        if nbytes is None:
+            nbytes = len(data)
         q = self._send_q
-        entry = (PreEncoded(data, owner), done)
+        entry = (PreEncoded(data, owner), done,
+                 (time.monotonic(), cls & 3, nframes, nbytes))
         if q.maxsize <= 0:
             q.put_nowait(entry)  # unbounded: no coroutine hop
         else:
@@ -1185,13 +1246,20 @@ class Connection:
         if done is not None:
             await done
 
-    def send_raw_many_nowait(self, raws: list) -> None:
+    def send_raw_many_nowait(self, raws: list, cls: int = 2,
+                             nframes=None, nbytes=None) -> None:
         """Batch variant of :meth:`send_raw_nowait` (one entry, no await),
         with :meth:`send_raw_many`'s ownership rule: the frames are always
         released by the connection, never by the caller."""
         try:
             self._check()
-            self._send_q.put_nowait((raws, None))
+            if nframes is None:
+                nframes = len(raws)
+            if nbytes is None:
+                nbytes = sum(len(p.data) if isinstance(p, Bytes) else len(p)
+                             for p in raws) + 4 * len(raws)
+            self._send_q.put_nowait(
+                (raws, None, (time.monotonic(), cls & 3, nframes, nbytes)))
             self._ensure_writer()
         except BaseException:
             for p in raws:

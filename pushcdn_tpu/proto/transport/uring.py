@@ -207,6 +207,74 @@ def _env_zc_min() -> int:
         return 0
 
 
+# -- native telemetry aggregation (ISSUE 19) ---------------------------------
+# Each engine's ring owns one shm telemetry block written from C
+# (native/io_uring.cpp). /metrics aggregates: live engines are
+# snapshotted at render, closed engines fold their final snapshot into
+# this module-level carry so the rendered histograms stay monotonic
+# across engine teardown (loop-per-test suites recreate engines freely).
+# Default-on; PUSHCDN_NATIVE_TELEMETRY=0 is the bench A/B "off" leg.
+
+def _native_telemetry_enabled() -> bool:
+    return os.environ.get("PUSHCDN_NATIVE_TELEMETRY", "1") != "0"
+
+
+_TELEM_CARRY: Optional[dict] = None
+
+
+def _tm_empty() -> dict:
+    return nuring.parse_telemetry([0] * nuring.TM_WORDS)
+
+
+def _tm_merge(dst: dict, src: Optional[dict]) -> dict:
+    """Accumulate one parsed telemetry snapshot into ``dst`` (all-counter
+    payload, so element-wise sums are exact; peer rows concatenate —
+    distinct engines never share an fd at the same instant)."""
+    if src is None:
+        return dst
+    for key in ("stage", "chain", "class_delay"):
+        for name, h in src[key].items():
+            d = dst[key][name]
+            d["count"] += h["count"]
+            d["sum_ns"] += h["sum_ns"]
+            db = d["buckets"]
+            for k, c in enumerate(h["buckets"]):
+                db[k] += c
+    for key in ("class_frames", "class_bytes"):
+        for name, v in src[key].items():
+            dst[key][name] = dst[key].get(name, 0) + v
+    dst["peers"].extend(src.get("peers", ()))
+    return dst
+
+
+def telemetry_totals() -> Optional[dict]:
+    """Aggregate native telemetry: live engines' snapshots plus the
+    closed-engine carry (``parse_telemetry`` shape). None when nothing
+    has ever been recorded — the pre-render hook then skips the push."""
+    totals: Optional[dict] = None
+    if _TELEM_CARRY is not None:
+        totals = _tm_merge(_tm_empty(), _TELEM_CARRY)
+    for _, eng in UringEngine._engines.values():
+        if eng.closed:
+            continue
+        try:
+            snap = eng.ring.telemetry_snapshot()
+        except Exception:
+            continue
+        parsed = nuring.parse_telemetry(snap) if snap is not None else None
+        if parsed is not None:
+            totals = _tm_merge(totals if totals is not None else _tm_empty(),
+                               parsed)
+    return totals
+
+
+def _telemetry_pre_render() -> None:
+    metrics_mod.update_native_telemetry(telemetry_totals())
+
+
+metrics_mod.PRE_RENDER_HOOKS.append(_telemetry_pre_render)
+
+
 class UringEngine:
     """Per-event-loop io_uring engine. Use :meth:`current`."""
 
@@ -233,6 +301,14 @@ class UringEngine:
             os.close(self._efd)
             self.ring.close()
             raise
+        # native telemetry block: stamped from C on the pump/engine hot
+        # paths, snapshotted by the /metrics pre-render hook. Best-effort
+        # (an mmap failure just leaves telemetry off).
+        if _native_telemetry_enabled():
+            try:
+                self.ring.enable_telemetry()
+            except Exception:
+                pass
         self._pending: dict = {}
         self._next_ud = 0
         self._kick_scheduled = False
@@ -350,6 +426,19 @@ class UringEngine:
         try:
             os.close(self._efd)
         except OSError:
+            pass
+        # fold the final telemetry snapshot into the module carry BEFORE
+        # the ring drops (pcu_destroy munmaps the block) so the rendered
+        # aggregates stay monotonic across engine teardown
+        global _TELEM_CARRY
+        try:
+            snap = self.ring.telemetry_snapshot()
+            if snap is not None:
+                _TELEM_CARRY = _tm_merge(
+                    _TELEM_CARRY if _TELEM_CARRY is not None
+                    else _tm_empty(),
+                    nuring.parse_telemetry(snap))
+        except Exception:
             pass
         self.ring.close()
 

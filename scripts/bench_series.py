@@ -220,6 +220,88 @@ def gate(rounds: dict, threshold: float, fingerprints: dict = None,
     return failures
 
 
+def reduce_timeline(path: str) -> "dict | None":
+    """Reduce a ``cdn_top.py --record`` JSONL timeline into one headline
+    dict: per-sample cluster scalars collapse to the mean (rates/ratios),
+    the max (worst-case delays, lags, cumulative sheds), or the min
+    (process-up/ready counts — a flapping process must show). Returns
+    None when the file holds no usable samples."""
+    samples = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                head = doc.get("headline")
+                if isinstance(head, dict):
+                    samples.append((doc.get("t"), head))
+    except OSError as exc:
+        print(f"[series] cannot read timeline {path}: {exc}",
+              file=sys.stderr)
+        return None
+    if not samples:
+        return None
+    keys = sorted({k for _, h in samples for k in h
+                   if isinstance(h.get(k), (int, float))
+                   and not isinstance(h.get(k), bool)})
+    out = {}
+    for key in keys:
+        vals = [h[key] for _, h in samples if isinstance(
+            h.get(key), (int, float)) and not isinstance(h.get(key), bool)]
+        if not vals:
+            continue
+        parts = set(re.split(r"[^a-z0-9]+", key.lower()))
+        if parts & {"p99", "p95", "lag", "sheds", "max"}:
+            out[key] = max(vals)
+        elif parts & {"procs", "up", "ready"}:
+            out[key] = min(vals)
+        else:
+            out[key] = sum(vals) / len(vals)
+    out["timeline_samples"] = len(samples)
+    times = [t for t, _ in samples if isinstance(t, (int, float))]
+    if len(times) >= 2:
+        out["timeline_span_s"] = max(times) - min(times)
+    return out
+
+
+def ingest_timeline(root: str, path: str, rnd: int, section: str) -> bool:
+    """Merge a reduced timeline into ``BENCH_r<rnd>.json`` as a section
+    (headline + provenance), creating the round file if absent."""
+    headline = reduce_timeline(path)
+    if headline is None:
+        print(f"[series] timeline {path} holds no samples", file=sys.stderr)
+        return False
+    bench_path = os.path.join(root, f"BENCH_r{rnd:02d}.json")
+    doc = {"round": rnd}
+    if os.path.exists(bench_path):
+        try:
+            with open(bench_path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print(f"[series] cannot merge into {bench_path}: {exc}",
+                  file=sys.stderr)
+            return False
+    try:
+        sys.path.insert(0, REPO)
+        from pushcdn_tpu.testing.provenance import provenance
+        prov = provenance()
+    except Exception:
+        prov = {}
+    doc[section] = {"headline": headline, "provenance": prov,
+                    "source": os.path.basename(path)}
+    with open(bench_path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(f"[series] ingested {len(headline)} timeline metrics into "
+          f"{bench_path} section {section!r}")
+    return True
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--root", default=REPO,
@@ -231,7 +313,25 @@ def main() -> int:
                          "vs the previous round carrying the metric")
     ap.add_argument("--threshold", type=float, default=0.10,
                     help="gate threshold as a fraction (default 0.10)")
+    ap.add_argument("--ingest-timeline", metavar="JSONL", default=None,
+                    help="reduce a scripts/cdn_top.py --record timeline "
+                         "into a BENCH_r<round>.json section before "
+                         "rendering the series")
+    ap.add_argument("--round", type=int, default=None,
+                    help="round number for --ingest-timeline")
+    ap.add_argument("--section", default="cluster_top",
+                    help="section name for --ingest-timeline "
+                         "(default cluster_top)")
     args = ap.parse_args()
+
+    if args.ingest_timeline:
+        if args.round is None:
+            print("[series] --ingest-timeline needs --round",
+                  file=sys.stderr)
+            return 1
+        if not ingest_timeline(args.root, args.ingest_timeline, args.round,
+                               args.section):
+            return 1
 
     rounds = load_rounds(args.root)
     if not rounds:

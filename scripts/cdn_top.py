@@ -1,0 +1,600 @@
+#!/usr/bin/env python
+"""One-pane cluster collector (ISSUE 19 tentpole 4).
+
+Polls every process's ``/metrics`` + ``/debug/topology`` (+ ``/readyz``)
+and renders a live cluster view: per-process byte rates and writer-queue
+depth, per-broker routed-frame rates with the pump hit ratio and its
+escalation split, per-class flow rates and head-of-line queue delays,
+retention/replay state, sheds, and the native pump stage latencies —
+the numbers the scheduling work (ROADMAP item 4) and the mega-soak
+(item 5) read from one place instead of N scrape targets.
+
+Endpoints come from the ``local_cluster`` port layout or an explicit
+list:
+
+    python scripts/cdn_top.py --base-port 21700            # local_cluster
+    python scripts/cdn_top.py --endpoints broker0=127.0.0.1:21800,marshal=127.0.0.1:21840
+
+Modes:
+
+    (default)        live pane, repainted every --interval seconds
+    --once           two quick polls (rates need a delta), one render, exit
+    --record F       append one JSONL sample per poll ({"t", "headline",
+                     "procs"}) — reduce into a BENCH_r<N>.json section
+                     with ``scripts/bench_series.py --ingest-timeline``
+    --bundle DIR     capture a postmortem archive (every process's raw
+                     metrics, health, topology, flightrec trails +
+                     manifest) into DIR/bundle-<stamp>/ — on demand with
+                     --once, and automatically when any /readyz flips
+                     unready in watch mode (once per failure episode)
+
+Exit code: 0 on a clean run, 1 when --once could not reach ANY endpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# local_cluster.py metrics layout (keep in sync): each broker parent owns
+# a 20-port block so per-shard worker endpoints (parent + 1 + shard)
+# never collide with the next component
+CLUSTER_LAYOUT = {"broker0": 100, "broker1": 120, "marshal": 140,
+                  "client": 141, "client2": 142}
+
+
+# ---------------------------------------------------------------------------
+# scraping
+
+
+def http_get(endpoint: str, path: str, timeout: float = 2.0):
+    """(status, body) or None when nothing answers."""
+    try:
+        with urllib.request.urlopen(
+                f"http://{endpoint}{path}", timeout=timeout) as resp:
+            return resp.status, resp.read().decode(errors="replace")
+    except urllib.error.HTTPError as exc:
+        try:
+            return exc.code, exc.read().decode(errors="replace")
+        except OSError:
+            return exc.code, ""
+    except (urllib.error.URLError, OSError, TimeoutError, ValueError):
+        return None
+
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+([^\s]+)')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_metrics(text: str) -> dict:
+    """Prometheus text -> {sample_name: {labels_tuple: float}} where
+    labels_tuple is a sorted tuple of (key, value) pairs. Histogram
+    component samples (_bucket/_sum/_count) keep their suffixed names."""
+    out: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, rawlab, rawval = m.groups()
+        try:
+            val = float(rawval)
+        except ValueError:
+            continue
+        labels = tuple(sorted(
+            (k, v.replace('\\"', '"').replace("\\\\", "\\")
+                 .replace("\\n", "\n"))
+            for k, v in _LABEL_RE.findall(rawlab or "")))
+        out.setdefault(name, {})[labels] = val
+    return out
+
+
+def scrape(name: str, endpoint: str) -> dict:
+    """One process sample: raw metrics text + parsed families + readiness
+    + (brokers) topology. Unreachable -> {"up": False}."""
+    res = http_get(endpoint, "/metrics")
+    if res is None or res[0] != 200:
+        return {"name": name, "endpoint": endpoint, "up": False}
+    sample = {"name": name, "endpoint": endpoint, "up": True,
+              "t": time.monotonic(), "raw": res[1],
+              "metrics": parse_metrics(res[1])}
+    ready = http_get(endpoint, "/readyz")
+    sample["ready"] = None if ready is None else ready[0] == 200
+    sample["ready_body"] = None if ready is None else ready[1]
+    topo = http_get(endpoint, "/debug/topology")
+    if topo is not None and topo[0] == 200:
+        try:
+            sample["topology"] = json.loads(topo[1])
+        except ValueError:
+            pass
+    return sample
+
+
+# ---------------------------------------------------------------------------
+# derivation
+
+
+def sum_family(metrics: dict, name: str, **match) -> float:
+    """Sum of a family's samples whose labels include every match pair."""
+    total = 0.0
+    for labels, val in (metrics.get(name) or {}).items():
+        d = dict(labels)
+        if all(d.get(k) == v for k, v in match.items()):
+            total += val
+    return total
+
+
+def label_values(metrics: dict, name: str, label: str, **match) -> dict:
+    """{label_value: summed value} over a family, filtered by match."""
+    out: dict = {}
+    for labels, val in (metrics.get(name) or {}).items():
+        d = dict(labels)
+        if all(d.get(k) == v for k, v in match.items()):
+            key = d.get(label)
+            if key is not None:
+                out[key] = out.get(key, 0.0) + val
+    return out
+
+
+def hist_quantile(metrics: dict, name: str, q: float, base=None,
+                  **match) -> float:
+    """Quantile (seconds) from a cumulative-bucket histogram family,
+    optionally over the DELTA vs a previous sample's parsed metrics
+    (``base``) so watch mode shows the recent window, not all time.
+    Returns NaN when the (delta) histogram is empty."""
+    def buckets(src):
+        rows = []
+        for labels, val in (src.get(name + "_bucket") or {}).items():
+            d = dict(labels)
+            if not all(d.get(k) == v for k, v in match.items()):
+                continue
+            le = d.get("le")
+            if le is None:
+                continue
+            rows.append((math.inf if le == "+Inf" else float(le), val))
+        merged: dict = {}
+        for le, val in rows:
+            merged[le] = merged.get(le, 0.0) + val
+        return dict(sorted(merged.items()))
+
+    cur = buckets(metrics)
+    if not cur:
+        return math.nan
+    prev = buckets(base) if base else {}
+    deltas = [(le, cur[le] - prev.get(le, 0.0)) for le in cur]
+    total = deltas[-1][1]
+    if total <= 0:
+        return math.nan
+    target = q * total
+    lo = 0.0
+    for le, cum in deltas:
+        if cum >= target:
+            if le is math.inf:
+                return lo  # open-ended bucket: report its lower bound
+            prev_cum = 0.0
+            for ple, pcum in deltas:
+                if ple >= le:
+                    break
+                lo, prev_cum = ple, pcum
+            span = cum - prev_cum
+            frac = (target - prev_cum) / span if span > 0 else 1.0
+            return lo + (le - lo) * frac
+    return deltas[-1][0]
+
+
+def _rate(cur: dict, prev: dict, name: str, dt: float, **match) -> float:
+    if not prev or dt <= 0:
+        return 0.0
+    d = sum_family(cur, name, **match) - sum_family(prev, name, **match)
+    return max(0.0, d) / dt
+
+
+CLASSES = ("control", "consensus", "live", "bulk")
+
+
+def derive(cur: dict, prev: dict) -> dict:
+    """One process's view row from its current (and previous) sample."""
+    if not cur.get("up"):
+        return {"up": False}
+    m = cur["metrics"]
+    pm = (prev or {}).get("metrics") or {}
+    dt = cur["t"] - prev["t"] if prev and prev.get("up") else 0.0
+    row = {
+        "up": True,
+        "ready": cur.get("ready"),
+        "in_mb_s": _rate(m, pm, "cdn_bytes_received", dt) / 1e6,
+        "out_mb_s": _rate(m, pm, "cdn_bytes_sent", dt) / 1e6,
+        "queue_depth_sum": sum_family(m, "cdn_writer_queue_depth",
+                                      stat="sum"),
+        "queue_depth_max": sum_family(m, "cdn_writer_queue_depth",
+                                      stat="max"),
+        "loop_lag_ms": sum_family(m, "cdn_event_loop_lag_seconds") * 1e3,
+    }
+    # routed-frame rates by path + pump ratio (brokers; zero elsewhere)
+    paths = label_values(m, "cdn_route_batch_frames", "path")
+    if paths:
+        prev_paths = label_values(pm, "cdn_route_batch_frames", "path")
+        deltas = {p: max(0.0, v - prev_paths.get(p, 0.0))
+                  for p, v in paths.items()}
+        routed = sum(deltas.values())
+        row["routed_f_s"] = routed / dt if dt > 0 else 0.0
+        row["pump_hit_pct"] = (100.0 * deltas.get("pump", 0.0) / routed
+                               if routed > 0 else None)
+        row["path_split"] = {p: v / dt if dt > 0 else 0.0
+                             for p, v in deltas.items() if v > 0}
+    esc = label_values(m, "cdn_pump_escalations", "reason")
+    if esc:
+        prev_esc = label_values(pm, "cdn_pump_escalations", "reason")
+        row["escalations"] = {
+            r: int(v - prev_esc.get(r, 0.0)) for r, v in esc.items()
+            if v - prev_esc.get(r, 0.0) > 0}
+    # per-class flow + head-of-line delay
+    classes = {}
+    for cls in CLASSES:
+        out_f = _rate(m, pm, "cdn_class_frames", dt,
+                      **{"class": cls, "dir": "out"})
+        out_b = _rate(m, pm, "cdn_class_bytes", dt,
+                      **{"class": cls, "dir": "out"})
+        in_f = _rate(m, pm, "cdn_class_frames", dt,
+                     **{"class": cls, "dir": "in"})
+        p50 = hist_quantile(m, "cdn_writer_queue_delay_seconds", 0.50,
+                            base=pm, **{"class": cls})
+        p99 = hist_quantile(m, "cdn_writer_queue_delay_seconds", 0.99,
+                            base=pm, **{"class": cls})
+        if out_f or in_f or not math.isnan(p50):
+            classes[cls] = {"out_f_s": out_f, "out_mb_s": out_b / 1e6,
+                            "in_f_s": in_f,
+                            "delay_p50_ms":
+                                None if math.isnan(p50) else p50 * 1e3,
+                            "delay_p99_ms":
+                                None if math.isnan(p99) else p99 * 1e3}
+    if classes:
+        row["classes"] = classes
+    # native pump stages (delta-window quantiles; counts all-time)
+    stages = {}
+    for stage in ("plan", "submit", "wire", "total"):
+        count = sum_family(m, "cdn_pump_stage_seconds_count", stage=stage)
+        if count > 0:
+            p50 = hist_quantile(m, "cdn_pump_stage_seconds", 0.50,
+                                base=pm, stage=stage)
+            p99 = hist_quantile(m, "cdn_pump_stage_seconds", 0.99,
+                                base=pm, stage=stage)
+            stages[stage] = {
+                "count": int(count),
+                "p50_us": None if math.isnan(p50) else p50 * 1e6,
+                "p99_us": None if math.isnan(p99) else p99 * 1e6}
+    if stages:
+        row["pump_stages"] = stages
+    # retention / replay
+    ring_bytes = sum_family(m, "cdn_retention_ring_bytes")
+    ring_entries = sum_family(m, "cdn_retention_ring_entries")
+    if ring_bytes or ring_entries:
+        row["retention"] = {
+            "topics": len(m.get("cdn_retention_ring_entries") or {}),
+            "bytes": ring_bytes, "entries": ring_entries,
+            "evictions": {k: int(v) for k, v in label_values(
+                m, "cdn_retention_evictions", "reason").items()},
+        }
+    lags = label_values(m, "cdn_replay_lag_entries", "subscriber")
+    lags = {k: v for k, v in lags.items() if v > 0}
+    if lags:
+        worst = max(lags, key=lags.get)
+        row["replay_lag"] = {"max": int(lags[worst]), "subscriber": worst,
+                             "subscribers": len(lags)}
+    sheds = sum_family(m, "cdn_route_shed_total")
+    if sheds:
+        row["sheds"] = int(sheds)
+    topo = cur.get("topology")
+    if topo:
+        shards = topo.get("shards")
+        if shards:
+            row["shards"] = len(shards)
+        peers = topo.get("peers") or topo.get("brokers")
+        if isinstance(peers, (list, dict)):
+            row["mesh_peers"] = len(peers)
+    return row
+
+
+def headline(rows: dict) -> dict:
+    """Cluster-level scalars from the per-process rows (the --record
+    timeline's reducible surface: every value numeric or absent)."""
+    up = [r for r in rows.values() if r.get("up")]
+    head = {
+        "procs": len(rows),
+        "procs_up": len(up),
+        "procs_ready": sum(1 for r in up if r.get("ready")),
+        "out_mb_s": sum(r.get("out_mb_s", 0.0) for r in up),
+        "routed_f_s": sum(r.get("routed_f_s", 0.0) for r in up),
+        "sheds": sum(r.get("sheds", 0) for r in up),
+    }
+    ratios = [r["pump_hit_pct"] for r in up
+              if r.get("pump_hit_pct") is not None]
+    if ratios:
+        head["pump_hit_pct"] = min(ratios)
+    for cls in ("consensus", "bulk"):
+        p99s = [r["classes"][cls]["delay_p99_ms"] for r in up
+                if cls in r.get("classes", {})
+                and r["classes"][cls]["delay_p99_ms"] is not None]
+        if p99s:
+            head[f"{cls}_delay_p99_ms"] = max(p99s)
+    lags = [r["replay_lag"]["max"] for r in up if "replay_lag" in r]
+    if lags:
+        head["replay_lag_max"] = max(lags)
+    return head
+
+
+# ---------------------------------------------------------------------------
+# rendering
+
+
+def _fmt(v, unit="", digits=1):
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "—"
+        return f"{v:,.{digits}f}{unit}"
+    return f"{v:,}{unit}"
+
+
+def render(rows: dict, head: dict, poll: int, dt: float) -> str:
+    out = [f"cdn_top — {head['procs_up']}/{head['procs']} up, "
+           f"{head['procs_ready']} ready | poll {poll} (window {dt:.1f}s) "
+           f"| out {_fmt(head['out_mb_s'])} MB/s, routed "
+           f"{_fmt(head['routed_f_s'], ' f/s', 0)}"
+           + (f", pump {_fmt(head['pump_hit_pct'], '%')}"
+              if "pump_hit_pct" in head else "")]
+    out.append("")
+    out.append(f"{'PROC':<10} {'UP':<4} {'RDY':<4} {'IN MB/s':>8} "
+               f"{'OUT MB/s':>9} {'QDEPTH s/m':>11} {'LAG ms':>7}")
+    for name in sorted(rows):
+        r = rows[name]
+        if not r.get("up"):
+            out.append(f"{name:<10} down")
+            continue
+        rdy = {True: "ok", False: "FAIL", None: "—"}[r.get("ready")]
+        out.append(
+            f"{name:<10} {'ok':<4} {rdy:<4} {_fmt(r['in_mb_s'], '', 2):>8} "
+            f"{_fmt(r['out_mb_s'], '', 2):>9} "
+            f"{int(r['queue_depth_sum']):>6}/{int(r['queue_depth_max']):<4} "
+            f"{_fmt(r['loop_lag_ms'], '', 1):>7}")
+    for name in sorted(rows):
+        r = rows[name]
+        if not r.get("up") or "routed_f_s" not in r:
+            continue
+        split = " | ".join(f"{p} {_fmt(v, ' f/s', 0)}"
+                           for p, v in sorted(
+                               (r.get("path_split") or {}).items()))
+        shard = f", {r['shards']} shards" if "shards" in r else ""
+        out.append("")
+        out.append(f"{name}: routed {_fmt(r['routed_f_s'], ' f/s', 0)}"
+                   + (f" (pump {_fmt(r['pump_hit_pct'], '%')})"
+                      if r.get("pump_hit_pct") is not None else "")
+                   + shard + (f" [{split}]" if split else ""))
+        if r.get("escalations"):
+            esc = " ".join(f"{k}={v}" for k, v in
+                           sorted(r["escalations"].items()))
+            out.append(f"  escalations (window): {esc}")
+        if r.get("classes"):
+            out.append(f"  {'class':<10} {'out f/s':>9} {'out MB/s':>9} "
+                       f"{'in f/s':>8} {'delay p50/p99 ms':>18}")
+            for cls in CLASSES:
+                c = r["classes"].get(cls)
+                if c is None:
+                    continue
+                out.append(
+                    f"  {cls:<10} {_fmt(c['out_f_s'], '', 0):>9} "
+                    f"{_fmt(c['out_mb_s'], '', 2):>9} "
+                    f"{_fmt(c['in_f_s'], '', 0):>8} "
+                    f"{_fmt(c['delay_p50_ms'], '', 3):>9}/"
+                    f"{_fmt(c['delay_p99_ms'], '', 3)}")
+        if r.get("pump_stages"):
+            st = "  ".join(
+                f"{s} {_fmt(v['p50_us'], '', 0)}/{_fmt(v['p99_us'], '', 0)}us"
+                f" (n={v['count']})"
+                for s, v in r["pump_stages"].items())
+            out.append(f"  pump stages p50/p99: {st}")
+        if r.get("retention"):
+            ret = r["retention"]
+            ev = " ".join(f"{k}={v}" for k, v in
+                          sorted(ret["evictions"].items()))
+            out.append(f"  retention: {ret['topics']} topics, "
+                       f"{_fmt(ret['bytes'] / 1e6, ' MB', 2)}, "
+                       f"{int(ret['entries'])} entries"
+                       + (f" | evictions {ev}" if ev else ""))
+        if r.get("replay_lag"):
+            lag = r["replay_lag"]
+            out.append(f"  replay lag: max {lag['max']} entries "
+                       f"({lag['subscriber']}; "
+                       f"{lag['subscribers']} replaying)")
+        if r.get("sheds"):
+            out.append(f"  sheds (all-time): {r['sheds']}")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# bundle
+
+
+def capture_bundle(out_dir: str, endpoints: dict, reason: str) -> str:
+    """Postmortem archive: every process's raw observability surface in
+    one directory — what you attach to the incident, captured while the
+    cluster is still in the failed state."""
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    bdir = os.path.join(out_dir, f"bundle-{stamp}")
+    os.makedirs(bdir, exist_ok=True)
+    manifest = {"captured_at": time.time(), "reason": reason, "procs": {}}
+    for name, endpoint in endpoints.items():
+        entry = {"endpoint": endpoint, "files": []}
+        for path, fname, binary_ok in (
+                ("/metrics", f"{name}.metrics.txt", True),
+                ("/healthz", f"{name}.healthz.json", False),
+                ("/readyz", f"{name}.readyz.json", False),
+                ("/debug/topology", f"{name}.topology.json", False),
+                ("/debug/flightrec?limit=2000",
+                 f"{name}.flightrec.json", False)):
+            res = http_get(endpoint, path, timeout=3.0)
+            if res is None:
+                continue
+            status, body = res
+            if status != 200 and path.startswith("/debug"):
+                continue  # marshal/client have no topology: skip quietly
+            with open(os.path.join(bdir, fname), "w") as fh:
+                fh.write(body)
+            entry["files"].append({"file": fname, "path": path,
+                                   "status": status})
+        manifest["procs"][name] = entry
+    with open(os.path.join(bdir, "manifest.json"), "w") as fh:
+        json.dump(manifest, fh, indent=2)
+    return bdir
+
+
+# ---------------------------------------------------------------------------
+# main
+
+
+def discover_endpoints(args) -> dict:
+    """{name: host:port} from --endpoints, or probed from the
+    local_cluster layout at --base-port (only answering ports join —
+    per-shard worker endpoints at broker parent + 1 + shard included)."""
+    if args.endpoints:
+        out = {}
+        for item in args.endpoints.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            name, _, ep = item.partition("=")
+            if not ep:
+                raise SystemExit(f"--endpoints entry {item!r} is not "
+                                 f"name=host:port")
+            out[name] = ep
+        return out
+    if args.base_port is None:
+        raise SystemExit("need --base-port or --endpoints")
+    bp = args.base_port
+    out = {}
+    for name, off in CLUSTER_LAYOUT.items():
+        ep = f"{args.host}:{bp + off}"
+        if http_get(ep, "/healthz", timeout=0.5) is not None:
+            out[name] = ep
+        if name.startswith("broker"):
+            # sharded parents re-serve workers' metrics aggregated, but
+            # the per-worker endpoints answer too — surface them when up
+            for shard in range(args.shards):
+                wep = f"{args.host}:{bp + off + 1 + shard}"
+                if http_get(wep, "/healthz", timeout=0.3) is not None:
+                    out[f"{name}/s{shard}"] = wep
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n", 1)[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--base-port", type=int, default=None,
+                    help="local_cluster --base-port to derive the "
+                         "metrics layout from (probed; silent ports "
+                         "are skipped)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--shards", type=int, default=0,
+                    help="also probe per-shard worker metrics endpoints "
+                         "(broker parent port + 1 + shard)")
+    ap.add_argument("--endpoints", default=None,
+                    help="explicit name=host:port[,name=host:port...] "
+                         "(bypasses layout discovery)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll / repaint interval (watch mode) and the "
+                         "rate window for --once (default 2s)")
+    ap.add_argument("--once", action="store_true",
+                    help="two polls, one render, exit")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="watch-mode time budget in seconds "
+                         "(default: until interrupted)")
+    ap.add_argument("--record", metavar="FILE", default=None,
+                    help="append one JSONL timeline sample per poll")
+    ap.add_argument("--bundle", metavar="DIR", default=None,
+                    help="postmortem archive dir: captured on --once, "
+                         "and on any /readyz failure in watch mode")
+    ap.add_argument("--no-clear", action="store_true",
+                    help="don't ANSI-clear between repaints (log-friendly)")
+    args = ap.parse_args()
+
+    endpoints = discover_endpoints(args)
+    if not endpoints:
+        print("[cdn_top] no endpoints answered", file=sys.stderr)
+        return 1
+    print(f"[cdn_top] watching {len(endpoints)} endpoints: "
+          f"{', '.join(sorted(endpoints))}", file=sys.stderr)
+
+    prev: dict = {}
+    poll = 0
+    bundle_armed = True  # one capture per failure episode
+    deadline = (time.monotonic() + args.duration
+                if args.duration is not None else None)
+    try:
+        while True:
+            cur = {n: scrape(n, ep) for n, ep in endpoints.items()}
+            poll += 1
+            rows = {n: derive(cur[n], prev.get(n)) for n in cur}
+            head = headline(rows)
+            dt = args.interval
+            ups = [n for n in cur if cur[n].get("up")
+                   and prev.get(n, {}).get("up")]
+            if ups:
+                dt = cur[ups[0]]["t"] - prev[ups[0]]["t"]
+            if poll > 1 or args.once:
+                text = render(rows, head, poll, dt)
+                if not (args.once or args.no_clear):
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print(text)
+                if args.record:
+                    with open(args.record, "a") as fh:
+                        fh.write(json.dumps(
+                            {"t": time.time(), "headline": head,
+                             "procs": rows}) + "\n")
+            unready = [n for n in cur
+                       if cur[n].get("up") and cur[n].get("ready") is False]
+            down = [n for n in cur if not cur[n].get("up")]
+            if args.bundle and poll > 1:
+                if (unready or down) and bundle_armed:
+                    bdir = capture_bundle(
+                        args.bundle, endpoints,
+                        f"readyz failed: {unready or down}")
+                    print(f"[cdn_top] bundle captured -> {bdir} "
+                          f"(unready={unready}, down={down})",
+                          file=sys.stderr)
+                    bundle_armed = False
+                elif not (unready or down):
+                    bundle_armed = True
+            if args.once:
+                if poll == 1:
+                    prev = cur
+                    time.sleep(min(args.interval, 2.0))
+                    continue
+                if args.bundle:
+                    bdir = capture_bundle(args.bundle, endpoints,
+                                          "on-demand (--once --bundle)")
+                    print(f"[cdn_top] bundle captured -> {bdir}",
+                          file=sys.stderr)
+                return 0 if any(c.get("up") for c in cur.values()) else 1
+            prev = cur
+            if deadline is not None and time.monotonic() >= deadline:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

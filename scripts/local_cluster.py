@@ -34,6 +34,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -238,6 +239,106 @@ def check_pump(broker_ports: dict) -> bool:
         return False
     print("[cluster] pump skipped (composition not engaged on this host: "
           "io_uring or the native route planner unavailable)")
+    return True
+
+
+def check_collector(metrics_ports: dict, broker_ports: dict,
+                    logdir: str) -> bool:
+    """``--collector``: drive ``scripts/cdn_top.py --once --record
+    --bundle`` against the live cluster and verify the one-pane plane
+    end to end — the collector reaches every process, the recorded
+    timeline carries a reducible headline, and the postmortem bundle
+    holds every process's raw metrics plus each broker's topology. When
+    the fused pump is live (pumped frames visible in topology), the
+    bundled broker metrics must also show nonzero
+    ``cdn_pump_stage_seconds`` samples for all four stages; otherwise
+    that sub-check skips loudly (never a silent pass on an
+    asyncio-demoted host)."""
+    record = os.path.join(logdir, "cdn_top_timeline.jsonl")
+    bundle_root = os.path.join(logdir, "bundles")
+    eps = ",".join(f"{n}=127.0.0.1:{p}" for n, p in metrics_ports.items())
+    cmd = [sys.executable, os.path.join(REPO, "scripts", "cdn_top.py"),
+           "--endpoints", eps, "--once", "--interval", "1.0",
+           "--record", record, "--bundle", bundle_root]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=90)
+    except subprocess.TimeoutExpired:
+        print("[cluster] FAIL: cdn_top --once --bundle timed out")
+        return False
+    if proc.returncode != 0:
+        print(f"[cluster] FAIL: cdn_top rc={proc.returncode}\n"
+              f"{proc.stdout[-1500:]}\n{proc.stderr[-1500:]}")
+        return False
+    # the rendered pane reached stdout (one line per process at minimum)
+    for name in metrics_ports:
+        if name not in proc.stdout:
+            print(f"[cluster] FAIL: cdn_top pane missing process {name}:\n"
+                  f"{proc.stdout[-1500:]}")
+            return False
+    # recorded timeline: >=1 sample whose headline saw every process up
+    try:
+        with open(record) as fh:
+            samples = [json.loads(ln) for ln in fh if ln.strip()]
+    except (OSError, ValueError) as exc:
+        print(f"[cluster] FAIL: cdn_top --record unreadable: {exc}")
+        return False
+    if not samples or samples[-1]["headline"].get("procs_up", 0) \
+            != len(metrics_ports):
+        print(f"[cluster] FAIL: timeline headline incomplete: "
+              f"{samples[-1]['headline'] if samples else 'no samples'}")
+        return False
+    # bundle: every process's metrics + every broker's topology + manifest
+    bundles = sorted(os.path.join(bundle_root, d)
+                     for d in os.listdir(bundle_root)
+                     if d.startswith("bundle-")) if \
+        os.path.isdir(bundle_root) else []
+    if not bundles:
+        print("[cluster] FAIL: cdn_top --bundle wrote no bundle dir")
+        return False
+    bdir = bundles[-1]
+    missing = [f"{n}.metrics.txt" for n in metrics_ports
+               if not os.path.exists(os.path.join(bdir,
+                                                  f"{n}.metrics.txt"))]
+    missing += [f"{n}.topology.json" for n in broker_ports
+                if not os.path.exists(os.path.join(
+                    bdir, f"{n}.topology.json"))]
+    if not os.path.exists(os.path.join(bdir, "manifest.json")):
+        missing.append("manifest.json")
+    if missing:
+        print(f"[cluster] FAIL: bundle {bdir} missing {missing}")
+        return False
+    # pump stage telemetry: required exactly when the pump really pumped
+    pumped = False
+    for name, port in broker_ports.items():
+        topo = fetch_topology(port)
+        ps = ((topo or {}).get("cutthrough") or {}).get("pump")
+        if ps and ps.get("pump_frames", 0) > 0:
+            pumped = True
+    if pumped:
+        stages_seen = set()
+        for name in broker_ports:
+            with open(os.path.join(bdir, f"{name}.metrics.txt")) as fh:
+                text = fh.read()
+            for m in re.finditer(
+                    r'cdn_pump_stage_seconds_count\{stage="(\w+)"\} '
+                    r'(\d+)', text):
+                if int(m.group(2)) > 0:
+                    stages_seen.add(m.group(1))
+        want = {"plan", "submit", "wire", "total"}
+        if stages_seen != want:
+            print(f"[cluster] FAIL: pump live but bundle shows stage "
+                  f"samples only for {sorted(stages_seen)} "
+                  f"(want {sorted(want)})")
+            return False
+        print(f"[cluster] collector OK (bundle {os.path.basename(bdir)}: "
+              f"{len(metrics_ports)} metrics + {len(broker_ports)} "
+              f"topologies; pump stages all nonzero)")
+    else:
+        print(f"[cluster] collector OK (bundle {os.path.basename(bdir)}: "
+              f"{len(metrics_ports)} metrics + {len(broker_ports)} "
+              f"topologies; pump-stage check skipped — pump not engaged "
+              f"on this host)")
     return True
 
 
@@ -1052,6 +1153,11 @@ def main() -> int:
                          "processes); spawns a second client so directs "
                          "cross the shard boundary, and asserts the "
                          "handoff rings carried them")
+    ap.add_argument("--collector", action="store_true",
+                    help="drive scripts/cdn_top.py --once --record "
+                         "--bundle against the live cluster and verify "
+                         "the pane, timeline, and postmortem bundle "
+                         "(ISSUE 19)")
     ap.add_argument("--chaos", action="store_true",
                     help="scripted chaos events after the baseline checks: "
                          "broker SIGKILL (a shard-worker kill under "
@@ -1291,6 +1397,12 @@ def main() -> int:
             # live handover through real processes; BEFORE the trace
             # checks so --strict also covers chains delivered alongside
             ok = check_replay(bp + 50, broker_ports) and ok
+        if args.collector:
+            # ---- one-pane collector (ISSUE 19): cdn_top --once --bundle
+            # over every live endpoint, with the timeline + bundle +
+            # pump-stage-telemetry assertions
+            ok = check_collector(metrics_ports, broker_ports, logdir) \
+                and ok
         if args.shards > 1:
             # ---- sharded data plane (ISSUE 6): users on 2+ workers and
             # cross-shard directs carried by the handoff rings

@@ -110,6 +110,31 @@ def test_issue17_files_inside_lint_scope():
             f"{rel} is outside the ruff gate's scope {RUFF_SCOPE}"
 
 
+ISSUE19_FILES = [
+    # native-path telemetry + flow accounting + collector (ISSUE 19):
+    # shm telemetry block (C), class taxonomy, metrics families, the
+    # one-pane collector, and the telemetry/class test surfaces
+    "native/io_uring.cpp",
+    "native/pump.cpp",
+    "pushcdn_tpu/proto/flowclass.py",
+    "pushcdn_tpu/proto/metrics.py",
+    "pushcdn_tpu/native/uring.py",
+    "scripts/cdn_top.py",
+    "tests/test_uring.py",
+    "tests/test_route_cutthrough.py",
+]
+
+
+def test_issue19_files_inside_lint_scope():
+    for rel in ISSUE19_FILES:
+        assert os.path.exists(os.path.join(REPO, rel)), rel
+        if rel.endswith(".cpp"):
+            continue  # native sources sit outside the ruff gate
+        assert any(rel == scope or rel.startswith(scope + "/")
+                   for scope in RUFF_SCOPE), \
+            f"{rel} is outside the ruff gate's scope {RUFF_SCOPE}"
+
+
 def test_ruff_check_clean():
     cmd = _ruff_cmd()
     if cmd is None:

@@ -357,3 +357,99 @@ async def test_pump_metrics_exposed_after_pumped_traffic():
     assert fenced, "fenced escalation series missing"
     assert float(fenced[0].split()[-1]) > 0
     assert "# TYPE cdn_pump_escalations counter" in body
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19 acceptance: per-class writer-queue delay separation
+# ---------------------------------------------------------------------------
+
+def _delay_hist_state(child):
+    return list(child.counts), child.total
+
+
+def _delay_p99_delta(child, before):
+    """p99 upper bound over the (before -> now) window of a fixed-bucket
+    histogram child: the le edge of the bucket the 99th-percentile
+    sample landed in (+Inf window -> inf)."""
+    import math
+
+    b_counts, b_total = before
+    deltas = [a - b for a, b in zip(child.counts, b_counts)]
+    total = child.total - b_total
+    if total == 0:
+        return 0.0
+    rank = math.ceil(0.99 * total)
+    cum = 0
+    for i, c in enumerate(deltas):
+        cum += c
+        if cum >= rank:
+            return child.buckets[i] if i < len(child.buckets) \
+                else float("inf")
+    return float("inf")
+
+
+async def test_writer_queue_delay_separates_bulk_flood_from_consensus():
+    """``cdn_writer_queue_delay_seconds{class}`` must separate a
+    bulk-replay flood from concurrent consensus traffic on the SAME
+    link: a replay burst queues thousands of frames at once, so its
+    tail waits behind its own serialization (the writer drains <=512
+    entries per wakeup), while the sparse consensus frame enqueued in
+    the same loop tick rides the first batch out — seeded sizes, a
+    bandwidth-throttled stream, and bulk p99 >> consensus p99."""
+    import random
+
+    from pushcdn_tpu.proto.transport.memory import (
+        Memory,
+        gen_testing_connection_pair,
+    )
+
+    rng = random.Random(1911)
+    # window large enough that the duplex buffer never backpressures:
+    # the only bandwidth limit is the throttle below, so the measured
+    # delays are the burst's own serialization time, deterministically
+    prev_win = Memory.set_duplex_window(64 * 1024 * 1024)
+    a, b = await gen_testing_connection_pair()
+    sec_per_byte = 4e-8  # ~25 MB/s link
+
+    orig_write = a._stream.write
+
+    async def throttled_write(data, *owner):
+        await orig_write(data, *owner)
+        await asyncio.sleep(len(data) * sec_per_byte)
+
+    a._stream.write = throttled_write
+
+    cons_child = metrics_mod.WRITER_QUEUE_DELAY_CLS[1]
+    bulk_child = metrics_mod.WRITER_QUEUE_DELAY_CLS[3]
+    cons_before = _delay_hist_state(cons_child)
+    bulk_before = _delay_hist_state(bulk_child)
+    try:
+        for _ in range(3):
+            # consensus request in flight when the replay burst lands:
+            # enqueued in the SAME tick, ahead of the flood
+            await a.send_raw(b"consensus-vote", cls=1)
+            flood = rng.randrange(1200, 1400)
+            payload = bytes(4096)
+            for _ in range(flood):
+                a.send_raw_nowait(payload, cls=3)
+            # settle the round: a flushed control frame resolves only
+            # after the flood fully serialized, so the next round's
+            # consensus frame meets an IDLE writer, not the tail flush
+            # of this one (control is not a measured class here)
+            async with asyncio.timeout(30):
+                await a.send_raw(b"round-sync", cls=0, flush=True)
+        cons_p99 = _delay_p99_delta(cons_child, cons_before)
+        bulk_p99 = _delay_p99_delta(bulk_child, bulk_before)
+        cons_n = cons_child.total - cons_before[1]
+        bulk_n = bulk_child.total - bulk_before[1]
+        assert cons_n == 3 and bulk_n >= 3600, (cons_n, bulk_n)
+        assert bulk_p99 != float("inf"), "bulk delay blew the 5s bucket"
+        assert cons_p99 <= 0.01, f"consensus p99 {cons_p99} not sparse"
+        assert bulk_p99 >= 10 * max(cons_p99, 1e-3), (
+            f"classes not separated: bulk p99 {bulk_p99} vs "
+            f"consensus p99 {cons_p99}")
+    finally:
+        a._stream.write = orig_write
+        a.close()
+        b.close()
+        Memory.set_duplex_window(prev_win)

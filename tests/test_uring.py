@@ -942,3 +942,194 @@ def test_pump_demotion_warning_names_failed_layer(monkeypatch, caplog):
         ok, why = pump_mod.resolve_pump()
     assert not ok and "disabled" in why
     assert not [r for r in caplog.records if "demoted" in r.message]
+
+
+# ---------------------------------------------------------------------------
+# tier 6: native-path telemetry (ISSUE 19)
+# ---------------------------------------------------------------------------
+#
+# The shm telemetry block is written from C on the pump's hot path and
+# read by /metrics through a seqlock snapshot. These tests pin the three
+# contracts the exposition rests on: log2 bucketing (exact boundaries),
+# torn-read safety under a concurrent writer, and counter monotonicity
+# across pump disengage and engine teardown (the carry fold).
+
+
+@requires_uring
+def test_telemetry_log2_bucket_boundaries():
+    """Bucket k holds durations in [2^(k-1), 2^k) ns — i.e. the bucket
+    index of ``ns`` is ``ns.bit_length()`` capped at 63, with 0 in
+    bucket 0. Exact count/sum bookkeeping, weighted observes, and
+    out-of-range histogram indices rejected."""
+    from collections import Counter as _Counter
+
+    ring = nuring.Ring(64)
+    try:
+        assert ring.enable_telemetry()
+        assert ring.telemetry_enabled
+        assert ring.enable_telemetry()  # idempotent
+        cases = [0, 1, 2, 3, 4, 7, 8, 1023, 1024, 1025,
+                 2**32 - 1, 2**32, 2**62, 2**63 + 11]
+        for ns in cases:
+            assert ring.telemetry_test_observe(0, 0, ns) == 0
+        # weighted observe: one duration covering n frames adds n to
+        # count, n*ns to sum, n to the single bucket
+        assert ring.telemetry_test_observe(0, 0, 1024, n=5) == 0
+        # invalid indices/kinds must be rejected, not clamped
+        assert ring.telemetry_test_observe(0, nuring.TM_STAGES, 1) < 0
+        assert ring.telemetry_test_observe(1, nuring.TM_CHAIN, 1) < 0
+        assert ring.telemetry_test_observe(2, nuring.TM_CLASSES, 1) < 0
+        assert ring.telemetry_test_observe(3, 0, 1) < 0
+
+        snap = nuring.parse_telemetry(ring.telemetry_snapshot())
+        h = snap["stage"]["plan"]
+        assert h["count"] == len(cases) + 5
+        assert h["sum_ns"] == sum(cases) + 5 * 1024
+        expect = _Counter(min(ns.bit_length(), 63) for ns in cases)
+        expect[(1024).bit_length()] += 5
+        for k in range(nuring.TM_BUCKETS):
+            assert h["buckets"][k] == expect.get(k, 0), f"bucket {k}"
+        # nothing leaked into the neighbouring histograms
+        assert snap["stage"]["submit"]["count"] == 0
+        assert snap["chain"]["enter"]["count"] == 0
+    finally:
+        ring.close()
+
+
+@requires_uring
+def test_telemetry_snapshot_consistent_under_concurrent_writer():
+    """Seqlock torn-read safety: a writer thread hammers weighted
+    observations (seeded n) into one histogram while the reader
+    snapshots. Every snapshot must be internally consistent — with a
+    fixed duration, sum_ns == ns * count and all samples in one bucket;
+    a torn copy would break one of those identities — and counts must
+    be monotone across snapshots."""
+    import random
+    import threading
+    import time as _time
+
+    ring = nuring.Ring(64)
+    try:
+        assert ring.enable_telemetry()
+        rng = random.Random(1119)
+        ns = 1 << 20  # bucket 21
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                ring.telemetry_test_observe(2, 3, ns, n=rng.randrange(1, 8))
+
+        writer = threading.Thread(target=hammer)
+        writer.start()
+        try:
+            prev, good = 0, 0
+            deadline = _time.monotonic() + 10.0
+            while good < 150 and _time.monotonic() < deadline:
+                words = ring.telemetry_snapshot()
+                if words is None:
+                    continue  # writer never went quiet in the spin window
+                h = nuring.parse_telemetry(words)["class_delay"]["bulk"]
+                assert h["sum_ns"] == ns * h["count"], "torn sum"
+                assert sum(h["buckets"]) == h["count"], "torn buckets"
+                assert h["buckets"][21] == h["count"], "sample strayed"
+                assert h["count"] >= prev, "count went backwards"
+                prev = h["count"]
+                good += 1
+        finally:
+            stop.set()
+            writer.join()
+        assert good >= 150, f"reader starved: {good} consistent snapshots"
+        assert prev > 0, "writer never landed an observation"
+    finally:
+        ring.close()
+
+
+@requires_pump
+def test_pump_stage_telemetry_and_class_accounting_injected():
+    """Binding-level stage/class accounting over injected CQEs (no
+    kernel timing): one pumped chunk must stamp all four stages, fold
+    the frames into the planner's class (BULK via set_classes), account
+    the peer row by fd, and SURVIVE pump disengage — the telemetry
+    block belongs to the ring, not the pump."""
+    from pushcdn_tpu.proto import flowclass
+
+    planner, ring, pump, socks, chunk = _pump_rig(topics=((1,),))
+    try:
+        assert ring.enable_telemetry()
+        # topic 1 -> bulk; everything else keeps the live default
+        assert planner.set_classes(
+            flowclass.compile_table(overrides={1: flowclass.BULK}))
+        buf, offs, lens = chunk([1, 1, 1])
+        consumed, stop_r, rp, rf, meta = pump.route_chunk(
+            planner._handle, buf, offs, lens, 0, 1)
+        assert consumed == 3 and len(rp) == 0
+        assert list(pump.frame_classes[:3]) == [flowclass.BULK] * 3
+        run_len = int(offs[2] + lens[2] - (offs[0] - 4))
+        pump.inject_cqe(socks[0][2], run_len)
+        assert pump.take_released(), "run did not complete"
+
+        snap = nuring.parse_telemetry(ring.telemetry_snapshot())
+        for stage in nuring.STAGE_NAMES:
+            assert snap["stage"][stage]["count"] >= 1, stage
+        assert snap["class_delay"]["bulk"]["count"] == 3
+        assert snap["class_frames"]["bulk"] == 3
+        assert snap["class_bytes"]["bulk"] == run_len
+        assert snap["class_frames"]["live"] == 0
+        rows = {p["fd"]: p for p in snap["peers"]}
+        fd = socks[0][0].fileno()
+        assert rows[fd]["frames"] == 3 and rows[fd]["bytes"] == run_len
+
+        # disengage: destroying the pump must not reset the counters
+        pump.destroy()
+        after = nuring.parse_telemetry(ring.telemetry_snapshot())
+        assert after["class_frames"]["bulk"] == 3
+        for stage in nuring.STAGE_NAMES:
+            assert after["stage"][stage]["count"] \
+                == snap["stage"][stage]["count"], stage
+    finally:
+        if not pump.closed:
+            pump.destroy()
+        ring.close()
+        for pair in socks:
+            for s in pair[:2]:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+@requires_uring
+async def test_telemetry_totals_monotone_across_engine_teardown():
+    """Engine teardown folds the ring's final snapshot into the
+    module-level carry BEFORE the ring closes, so ``telemetry_totals``
+    (and with it every rendered series) stays monotone across engine
+    recreate — the lease-balance discipline, applied to counters."""
+    from pushcdn_tpu.proto import metrics as metrics_mod
+
+    saved_carry = umod._TELEM_CARRY
+    umod._TELEM_CARRY = None
+    try:
+        eng = umod.UringEngine.current()
+        assert eng.ring.enable_telemetry()
+        assert eng.ring.telemetry_test_observe(0, 3, 1 << 21, n=5) == 0
+        t1 = umod.telemetry_totals()
+        assert t1 is not None and t1["stage"]["total"]["count"] == 5
+
+        umod.UringEngine.shutdown()  # close() folds into the carry
+        t2 = umod.telemetry_totals()
+        assert t2["stage"]["total"]["count"] == 5, "teardown lost samples"
+
+        # a fresh engine keeps the series monotone on top of the carry
+        eng2 = umod.UringEngine.current()
+        assert eng2.ring.enable_telemetry()
+        assert eng2.ring.telemetry_test_observe(0, 3, 1 << 21, n=2) == 0
+        t3 = umod.telemetry_totals()
+        assert t3["stage"]["total"]["count"] == 7
+        assert t3["stage"]["total"]["sum_ns"] == 7 * (1 << 21)
+
+        # and the /metrics exposition publishes the aggregated family
+        metrics_mod.update_native_telemetry(t3)
+        body = metrics_mod.PUMP_STAGE_SECONDS.render()
+        assert 'cdn_pump_stage_seconds_count{stage="total"} 7' in body
+    finally:
+        umod._TELEM_CARRY = saved_carry
