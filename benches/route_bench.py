@@ -1441,6 +1441,83 @@ async def bench_telemetry_overhead(quick: bool) -> dict:
     return stats
 
 
+async def bench_audit_overhead(quick: bool) -> dict:
+    """ISSUE 20 row: frame-fate ledger overhead on the forwarding path.
+
+    ``route/audit_overhead`` is the cost of the conservation ledger's
+    per-decision accounting (queued/fate counters, per-link sent/recv
+    tables, the dequeue stamps in the writer) on the same 8-receiver
+    forwarding child as ``route/pump_forward``, with exactly one
+    variable flipped — ``PUSHCDN_LEDGER`` (0 = every fast-path returns
+    before touching a counter; 1 = the shipped default). Legs are
+    INTERLEAVED off/on in fresh measurement children (same thermal-
+    drift rationale as the telemetry row); each leg's figure is the
+    median of its children's medians. Budget: <= 2%, the
+    observability-plane budget every prior overhead row holds to."""
+    import subprocess
+
+    from pushcdn_tpu.native import uring as nuring
+
+    stats: dict = {}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    io_impl = "uring" if nuring.available() else "asyncio"
+
+    def child(ledger: str) -> Optional[dict]:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PUSHCDN_LEDGER=ledger)
+        argv = [sys.executable, "-m", "pushcdn_tpu.testing.routebench",
+                "--io-impl", io_impl, "--route-impl", "auto",
+                "--pump", "auto", "--receivers", "8",
+                "--msgs", str(1_000 if quick else 3_000),
+                "--trials", str(2 if quick else 3)]
+        try:
+            out = subprocess.run(
+                argv, capture_output=True, text=True, timeout=600,
+                env=env, cwd=repo).stdout.strip()
+            return json.loads(out.splitlines()[-1])
+        except (subprocess.SubprocessError, ValueError, IndexError):
+            return None
+
+    legs: dict = {"0": [], "1": []}
+    pair_ratios: list = []
+    pairs = 2 if quick else 7
+    for _ in range(pairs):
+        pair: dict = {}
+        for ledger in ("0", "1"):  # interleaved: off, on, off, on, ...
+            res = child(ledger)
+            if res is not None:
+                legs[ledger].append(res["median"])
+                pair[ledger] = res["median"]
+        if "0" in pair and "1" in pair and pair["1"]:
+            # back-to-back children see the same thermal/scheduler state,
+            # so the per-pair ratio cancels the slow drift that dominates
+            # this shared core's minute-scale variance (single-leg medians
+            # here range +-20%, an order of magnitude above the real cost)
+            pair_ratios.append(pair["0"] / pair["1"])
+    if not pair_ratios:
+        emit("route/audit_overhead", 0, "skipped",
+             reason="measurement children failed")
+        return stats
+
+    off_med = statistics.median(legs["0"])
+    on_med = statistics.median(legs["1"])
+    emit("route/audit_overhead", off_med, "msgs/s", ledger="off",
+         receivers=8, io_impl=io_impl,
+         trials=[round(r, 1) for r in legs["0"]])
+    emit("route/audit_overhead", on_med, "msgs/s", ledger="on",
+         receivers=8, io_impl=io_impl,
+         trials=[round(r, 1) for r in legs["1"]])
+    ratio = statistics.median(pair_ratios)  # >1 = ledger costs throughput
+    emit("route/audit_overhead", ratio, "x",
+         overhead_pct=round((ratio - 1) * 100, 2),
+         budget_pct=2.0, interleaved_pairs=len(pair_ratios),
+         pair_ratios=[round(r, 3) for r in pair_ratios])
+    stats["audit_overhead_ratio"] = round(ratio, 4)
+    stats["audit_overhead_pct"] = round((ratio - 1) * 100, 2)
+    stats["audit_headline_msgs_s"] = round(on_med, 1)
+    return stats
+
+
 async def amain(quick: bool, impl_arg: str,
                 out_json: Optional[str] = None,
                 shard_rows: Optional[str] = None,
@@ -1510,6 +1587,11 @@ async def amain(quick: bool, impl_arg: str,
         stats.update(await bench_telemetry_overhead(quick))
         gc.collect()
 
+    # ISSUE 20: frame-fate ledger overhead A/B on the forwarding path
+    # (PUSHCDN_LEDGER off vs on, interleaved children)
+    stats.update(await bench_audit_overhead(quick))
+    gc.collect()
+
     # ISSUE 8: the device data plane — dense-vs-ragged delivery A/B on
     # the CPU twin + the one-collective fused mesh tick (dryrun)
     stats.update(bench_device_delivery(quick))
@@ -1571,7 +1653,7 @@ def write_bench_json(path: str, section: str, headline: dict,
     # the round number rides in the artifact name (BENCH_r18.json -> 18)
     # so a re-run into a new round's file never inherits a stale constant
     m = re.search(r"_r0*(\d+)\.json$", os.path.basename(path))
-    doc.setdefault("round", int(m.group(1)) if m else 18)
+    doc.setdefault("round", int(m.group(1)) if m else 19)
     from pushcdn_tpu.testing.provenance import provenance
     doc[section] = {"headline": headline, "rows": rows,
                     "provenance": provenance()}

@@ -166,7 +166,7 @@ def mark_all(packs: list, timeout: float = 30.0):
         p.send("mark")
     merged = {"clients": 0, "live": 0, "rehomed": 0, "delivered": 0,
               "unique": 0, "gaps": 0, "reorders": 0, "hard_reconnects": 0,
-              "rehome_ms": []}
+              "rehome_ms": [], "gap_events": 0, "gap_healed": 0}
     for p, start in zip(packs, starts):
         ev = p.wait_event("mark", timeout, after=start)
         if ev is None:
@@ -463,15 +463,23 @@ async def amain(args) -> int:
                 log(f"FAIL: soak worker {p.name} never reported")
                 return 1
             results.append(res)
+        # the loss figures come from each worker's LIVE client-side gap
+        # detector (cdn_client_gap_events / _healed counters), not from
+        # post-hoc delivery-log diffing: gaps = holes still open at
+        # wrap-up, reorders = holes a late arrival healed
         gaps = sum(r["gaps"] for r in results)
         reorders = sum(r["reorders"] for r in results)
+        gap_events = sum(r.get("gap_events", 0) for r in results)
+        gap_healed = sum(r.get("gap_healed", 0) for r in results)
         hard = sum(r["hard_reconnects"] for r in results)
         delivered_total = sum(r["delivered"] for r in results)
         unique_total = sum(r["unique"] for r in results)
         dups = delivered_total - unique_total
-        log(f"loss check: gaps {gaps}, reorders {reorders}, "
-            f"duplicates {dups} (legal), hard reconnects {hard}, "
-            f"{delivered_total} delivered / {sum(seqs)} published")
+        log(f"loss check (live gap detector): open gaps {gaps} "
+            f"({gap_events} opened, {gap_healed} healed), reorders "
+            f"{reorders}, duplicates {dups} (legal), hard reconnects "
+            f"{hard}, {delivered_total} delivered / "
+            f"{sum(seqs)} published")
 
         ok = (gaps == 0 and reorders == 0 and orphans == 0
               and rehomed_pct >= 99.0)
@@ -484,6 +492,8 @@ async def amain(args) -> int:
             "rehomed_pct": round(rehomed_pct, 2),
             "orphans": orphans,
             "loss_gaps": gaps,
+            "gap_events": gap_events,
+            "gap_healed": gap_healed,
             "reorder_violations": reorders,
             "storm_reconnects": storm["established"],
             "storm_conns_per_s": round(storm["established"] / storm_s, 1),

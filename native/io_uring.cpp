@@ -241,6 +241,10 @@ struct pcu_telem {
     u64 peer_frames[PCU_TM_PEERS];
     u64 peer_bytes[PCU_TM_PEERS];
     u64 peer_used;
+    // frame-fate ledger (ISSUE 20): pumped frames DROPPED in C (peer
+    // poisoned / send error / chain teardown), per class — appended at
+    // the end so every prior snapshot offset stays stable
+    u64 fate_drop_frames[PCU_TM_CLASSES];
 };
 
 static inline u64 pcu_now_ns(void) {
@@ -498,6 +502,22 @@ int pcu_telem_test_observe(pcu_ring *r, int kind, int idx,
         h = &t->class_delay[idx];
     else return -2;
     pcu_tm_observe_n(t, h, ns, n ? n : 1);
+    return 0;
+}
+
+// Test hook: bump the flat per-class counters (which 0 = class_frames,
+// 1 = fate_drop_frames) so the conservation-ledger fold in metrics.py
+// is testable without a live pumped ring.
+int pcu_telem_test_count(pcu_ring *r, int which, int idx,
+                         unsigned long long n) {
+    pcu_telem *t = r->telem;
+    if (!t) return -1;
+    if (idx < 0 || idx >= PCU_TM_CLASSES || which < 0 || which > 1)
+        return -2;
+    pcu_tm_begin(t);
+    if (which == 0) t->class_frames[idx] += n;
+    else t->fate_drop_frames[idx] += n;
+    pcu_tm_end(t);
     return 0;
 }
 

@@ -153,11 +153,27 @@ void pop_run(Pump *p, PumpPeer &pp) {
   if (pp.q_len == 0) pp.q_head = 0;
 }
 
+// Terminal fate for an abandoned run: fold its per-class frame counts
+// into the shared fate_drop_frames block so the conservation ledger
+// accounts pump drops with zero Python on the frame path. cls_frames is
+// valid from enqueue time (unlike the t_* stamps), so runs dropped
+// before submit — or queued while telemetry was off — still count.
+void run_dropped(Pump *p, const PumpRun &r) {
+  pcu_telem *tm = p->ring->telem;
+  if (tm == nullptr) return;
+  pcu_tm_begin(tm);
+  for (int c = 0; c < PCU_TM_CLASSES; ++c)
+    tm->fate_drop_frames[c] += r.cls_frames[c];
+  pcu_tm_end(tm);
+}
+
 // Drop every queued-but-not-inflight run (peer failed or dropped). The
 // inflight ones keep their refs until their CQEs drain.
 void drop_tail_runs(Pump *p, PumpPeer &pp) {
   while (pp.q_len > pp.inflight) {
-    chunk_decref(p, pp.q[pp.q_head + pp.q_len - 1].chunk_slot);
+    const PumpRun &r = pp.q[pp.q_head + pp.q_len - 1];
+    run_dropped(p, r);
+    chunk_decref(p, r.chunk_slot);
     pp.q_len--;
   }
   if (pp.q_len == 0) pp.q_head = 0;
@@ -295,20 +311,29 @@ void pump_on_cqe(Pump *p, u32 id, int res, EvBuf *eb) {
   }
   if (pp.err != 0) {
     // draining a failed peer: every trailing CQE frees one head run
-    if (pp.q_len > 0) pop_run(p, pp);
+    if (pp.q_len > 0) {
+      run_dropped(p, pp.q[pp.q_head]);
+      pop_run(p, pp);
+    }
   } else if (res < 0) {
     if (res == -ECANCELED) {
       // entry stays queued; a later chain re-sends it
     } else {
       peer_fail(p, id, res, eb);
-      if (pp.q_len > 0) pop_run(p, pp);  // the failed head
+      if (pp.q_len > 0) {
+        run_dropped(p, pp.q[pp.q_head]);  // the failed head
+        pop_run(p, pp);
+      }
       drop_tail_runs(p, pp);
     }
   } else {
     PumpRun &r = pp.q[pp.q_head];
     if (res == 0 && r.sent < r.len) {
       peer_fail(p, id, -EPIPE, eb);
-      if (pp.q_len > 0) pop_run(p, pp);
+      if (pp.q_len > 0) {
+        run_dropped(p, r);
+        pop_run(p, pp);
+      }
       drop_tail_runs(p, pp);
     } else {
       r.sent += (u32)res;
@@ -320,7 +345,10 @@ void pump_on_cqe(Pump *p, u32 id, int res, EvBuf *eb) {
         // short link mid-chain: later links already wrote past the gap
         // — the wire holds a torn frame; poison, never re-frame
         peer_fail(p, id, -EIO, eb);
-        if (pp.q_len > 0) pop_run(p, pp);
+        if (pp.q_len > 0) {
+          run_dropped(p, pp.q[pp.q_head]);
+          pop_run(p, pp);
+        }
         drop_tail_runs(p, pp);
       } else {
         p->st_short_repump++;  // lone short tail: re-pump the residue

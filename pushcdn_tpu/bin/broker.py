@@ -55,6 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--membership-ttl", type=float, default=60.0,
                    help="discovery membership TTL in seconds (parity "
                         "heartbeat.rs 60 s)")
+    p.add_argument("--sync-interval", type=float, default=10.0,
+                   help="mesh anti-entropy cadence in seconds (partial "
+                        "user/topic syncs + LedgerSync balance sheets); "
+                        "audit drills shrink it so conservation sheets "
+                        "propagate quickly")
     # ---- sharded data plane (ISSUE 6) ---------------------------------
     p.add_argument("--shards", type=int, default=None,
                    help="shard the data plane across N worker OS "
@@ -262,6 +267,7 @@ async def amain(args: argparse.Namespace) -> None:
         global_memory_pool_size=args.global_memory_pool_size,
         heartbeat_interval_s=args.heartbeat_interval,
         membership_ttl_s=args.membership_ttl,
+        sync_interval_s=args.sync_interval,
         device_plane=device_plane,
         # a mesh-group deployment's inter-broker plane is the device mesh
         form_mesh=args.mesh_shards is None,

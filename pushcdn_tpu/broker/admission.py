@@ -35,6 +35,8 @@ import time
 from typing import TYPE_CHECKING, Optional, Tuple
 
 from pushcdn_tpu.proto import flightrec
+from pushcdn_tpu.proto import flowclass
+from pushcdn_tpu.proto import ledger as ledger_mod
 from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto.limiter import Bytes
 from pushcdn_tpu.proto.message import AuthenticateResponse, serialize
@@ -154,6 +156,9 @@ class AdmissionControl:
         shed is never a silent drop)."""
         self._note_shed("subscribe", _SUBSCRIBE_SHED_CONTEXT, conn,
                         metrics_mod.ROUTE_SHED_SUBSCRIBE)
+        # the shed mutation frame's terminal fate (control class)
+        ledger_mod.record_fate("dropped", "admission_shed",
+                               flowclass.CONTROL)
         if egress is not None and sender_key is not None:
             raw = Bytes(_SUBSCRIBE_SHED_FRAME)
             try:

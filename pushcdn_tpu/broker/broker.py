@@ -24,6 +24,7 @@ from pushcdn_tpu.broker.tasks import listeners as listener_tasks
 from pushcdn_tpu.broker.tasks import sync as sync_task
 from pushcdn_tpu.broker.tasks import whitelist as whitelist_task
 from pushcdn_tpu.proto import health as health_mod
+from pushcdn_tpu.proto import ledger as ledger_mod
 from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto.crypto.signature import KeyPair
 from pushcdn_tpu.proto.crypto.tls import Certificate, generate_cert_from_ca, load_ca
@@ -242,14 +243,20 @@ class Broker:
         health_mod.register_readiness("discovery", self._check_discovery)
         health_mod.register_readiness("mesh", self._check_mesh)
         health_mod.register_readiness("admission", self._check_admission)
+        health_mod.register_readiness("conservation",
+                                      ledger_mod.LEDGER.conservation_check)
         metrics_mod.register_debug_route("/debug/topology",
                                          self._topology_route)
+        metrics_mod.register_debug_route("/debug/ledger",
+                                         ledger_mod.ledger_route)
         metrics_mod.register_debug_route("/drain", self._drain_route)
 
     def unregister_observability(self) -> None:
-        for name in ("listeners", "discovery", "mesh", "admission"):
+        for name in ("listeners", "discovery", "mesh", "admission",
+                     "conservation"):
             health_mod.unregister(name)
         metrics_mod.unregister_debug_route("/debug/topology")
+        metrics_mod.unregister_debug_route("/debug/ledger")
         metrics_mod.unregister_debug_route("/drain")
 
     def _check_listeners(self):
@@ -400,6 +407,12 @@ class Broker:
             spawn(listener_tasks.run_user_listener_task(self),
                   name="user-listener"),
             spawn(whitelist_task.run_whitelist_task(self), name="whitelist"),
+            # continuous conservation auditor + SLO burn engine (ISSUE 20)
+            spawn(metrics_mod.supervised(
+                lambda: ledger_mod.run_auditor(
+                    my_ident=self.connections.identity),
+                "ledger-auditor"),
+                name="ledger-auditor"),
         ]
         if self.config.bind_private:
             # heartbeat rides supervised(): a transient discovery outage

@@ -34,6 +34,7 @@ from typing import Deque, Dict, List, Optional, Set, Tuple
 
 from pushcdn_tpu.broker.relational_map import RelationalMap
 from pushcdn_tpu.broker.versioned_map import VersionedMap
+from pushcdn_tpu.proto import ledger as ledger_mod
 from pushcdn_tpu.proto.transport.base import Connection
 from pushcdn_tpu.proto.util import AbortOnDropHandle, mnemonic
 
@@ -266,6 +267,10 @@ class Connections:
         async def _close_later():
             try:
                 await asyncio.sleep(PARTING_GRACE_S)
+                # the grace is over: whatever the flush below cannot get
+                # onto the wire is a counted parting-expiry loss, not a
+                # generic teardown (ISSUE 20)
+                conn.ledger_drop_reason = "parting_expiry"
                 await conn.soft_close()
             finally:
                 if self.parting.get(public_key) is conn:
@@ -303,6 +308,11 @@ class Connections:
             self.broker_topics.remove_key(identifier)
         self.interest_version += 1
         self.remote_broker_shard.pop(identifier, None)  # now a live link
+        # mesh links tag their connection for the conservation ledger:
+        # writer dequeues on this link count relayed/mesh, not delivered —
+        # and a (re)formed link opens a fresh per-link conservation epoch
+        connection.ledger_peer = identifier
+        ledger_mod.reset_link(identifier)
         self.brokers[identifier] = BrokerHandle(
             connection, abort_handle,
             topic_sync_map=VersionedMap(local_identity=identifier))
@@ -619,6 +629,10 @@ class Connections:
             rec.record("removed", reason,
                        abnormal=reason in cls._ABNORMAL_REASONS)
             rec.maybe_dump(reason)
+        if reason == "send failed":
+            # failure-is-removal: frames the writer drains now take the
+            # send_failed fate, not the generic teardown one (ISSUE 20)
+            handle.connection.ledger_drop_reason = "send_failed"
         if handle.abort_handle is not None:
             handle.abort_handle.abort()
         try:

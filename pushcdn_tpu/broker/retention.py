@@ -89,6 +89,7 @@ from collections import deque
 from typing import TYPE_CHECKING, Iterable, List, Optional
 
 from pushcdn_tpu.proto import flowclass
+from pushcdn_tpu.proto import ledger as ledger_mod
 from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto import trace as trace_mod
 from pushcdn_tpu.proto.error import Error
@@ -358,6 +359,10 @@ class DurableTopics:
             self.evictions_by_reason[reason] = \
                 self.evictions_by_reason.get(reason, 0) + 1
             _EVICT_REASON[reason].inc()
+            # the retained COPY's terminal fate (ISSUE 20; the original
+            # frame's delivery fate was counted on its own path)
+            ledger_mod.record_fate("dropped", "retention_evict",
+                                   flowclass.BULK)
         if ring.last is e:
             # the LVC slot outlives the ring — but must not pin a pool
             # permit indefinitely: one bounded copy per topic
@@ -751,6 +756,7 @@ class DurableTopics:
         if item[0] == "pub":
             _, frame, users, brokers = item
             raw = Bytes(frame)
+            cls = flowclass.frame_class(frame)
             egress = EgressBatch(broker)
             for u in users:
                 if u in conns.users or u in conns.parting:
@@ -761,12 +767,12 @@ class DurableTopics:
                         egress.to_shard(shard, shardring.KIND_USER, u, raw)
             for b in brokers:
                 if b in conns.brokers:
-                    egress.to_broker(b, raw)
+                    egress.to_broker(b, raw, cls=cls)
                 else:
                     shard = conns.remote_broker_shard.get(b)
                     if shard is not None:
                         egress.to_shard(shard, shardring.KIND_BROKER, b,
-                                        raw)
+                                        raw, cls=cls)
             await egress.flush()
         else:  # ("replay", key, user_shard, prefixed_frames)
             _, key, user_shard, frames = item

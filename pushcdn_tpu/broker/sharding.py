@@ -51,7 +51,9 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from pushcdn_tpu.broker import shardring
+from pushcdn_tpu.proto import flowclass
 from pushcdn_tpu.proto import health as health_mod
+from pushcdn_tpu.proto import ledger as ledger_mod
 from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto.util import mnemonic
 
@@ -354,6 +356,10 @@ class ShardRuntime:
                                             prefixed=prefixed):
                 metrics_mod.SHARD_HANDOFF_RING.inc()
                 metrics_mod.SHARD_HANDOFF_FRAMES_RING.inc(len(frames))
+                # the frames are the sibling shard's responsibility now
+                # (informational fate — class unresolved at this layer)
+                ledger_mod.record_fate("relayed", "shard_ring",
+                                       flowclass.CLASS_NONE, len(frames))
                 return
             self._enter_fallback(dst)
         entries = []
@@ -405,6 +411,8 @@ class ShardRuntime:
             self.relay_shed += 1
             metrics_mod.SHARD_HANDOFF_SHED.inc()
             metrics_mod.SHARD_HANDOFF_FRAMES_SHED.inc(n_frames)
+            ledger_mod.record_fate("dropped", "relay_shed",
+                                   flowclass.CLASS_NONE, n_frames)
             return
         self.relay_fallbacks += 1
         metrics_mod.SHARD_HANDOFF_FALLBACK.inc()
@@ -443,14 +451,17 @@ class ShardRuntime:
         else:
             conn = conns.get_broker_connection(ident.decode())
         if conn is None:
-            return  # peer left since the origin planned: drop (parity)
+            # peer left since the origin planned: drop (parity)
+            ledger_mod.record_fate("dropped", "no_route",
+                                   flowclass.CLASS_NONE, n_frames)
+            return
         (metrics_mod.EGRESS_FRAMES_USER if kind == shardring.KIND_USER
          else metrics_mod.EGRESS_FRAMES_BROKER).inc(n_frames)
         try:
             # class volume was counted at the ORIGIN shard's routing
             # decision (pair-level, before the handoff); nbytes=0 keeps
             # the sibling's writer from counting the stream twice
-            await conn.send_encoded(data, owner, nbytes=0)
+            await conn.send_encoded(data, owner, nbytes=0, count=n_frames)
         except asyncio.CancelledError:
             raise
         except Exception as exc:

@@ -48,11 +48,13 @@ import numpy as np
 
 from pushcdn_tpu.broker.tasks.handlers import (
     EgressBatch,
+    _ingress_class,
     route_broadcast,
     route_direct,
 )
 from pushcdn_tpu.native import routeplan
 from pushcdn_tpu.proto import flowclass
+from pushcdn_tpu.proto import ledger as ledger_mod
 from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto import trace as trace_mod
 from pushcdn_tpu.proto.def_ import no_hook
@@ -61,6 +63,7 @@ from pushcdn_tpu.proto.limiter import Bytes
 from pushcdn_tpu.proto.message import (
     Broadcast,
     Direct,
+    LedgerSync,
     Subscribe,
     SubscribeFrom,
     TopicSync,
@@ -126,6 +129,43 @@ def _inc_class_counts(classes, lens, frames_row, bytes_row) -> None:
         if n:
             frames_row[c].inc(n)
             bytes_row[c].inc(int(nbytes[c]))
+
+
+def _note_link_classes(ident: str, fc, idx) -> None:
+    """Bump the per-link conservation `sent` table for one broker-bound
+    pair group — per class, off the plan's per-frame class array
+    (ISSUE 20; one bincount per pair group, not per frame)."""
+    if not ledger_mod.LEDGER.enabled:
+        return
+    if fc is None:
+        ledger_mod.note_link_sent(ident, flowclass.LIVE, len(idx))
+        return
+    fci = np.asarray(fc)[idx]
+    counts = np.bincount(fci[fci < flowclass.N_CLASSES],
+                         minlength=flowclass.N_CLASSES)
+    for c in range(flowclass.N_CLASSES):
+        n = int(counts[c])
+        if n:
+            ledger_mod.note_link_sent(ident, c, n)
+
+
+def _note_fate_classes(fate: str, reason: str, fc, idx) -> None:
+    """Per-class terminal fates for one dropped pair group (plan path)."""
+    if not ledger_mod.LEDGER.enabled:
+        return
+    if fc is None:
+        ledger_mod.record_fate(fate, reason, flowclass.LIVE, len(idx))
+        return
+    fci = np.asarray(fc)[idx]
+    counts = np.bincount(np.minimum(fci, flowclass.N_CLASSES),
+                         minlength=flowclass.N_CLASSES + 1)
+    for c in range(flowclass.N_CLASSES):
+        n = int(counts[c])
+        if n:
+            ledger_mod.record_fate(fate, reason, c, n)
+    n = int(counts[flowclass.N_CLASSES])
+    if n:  # CLASS_NONE / out-of-range frames still get their fate
+        ledger_mod.record_fate(fate, reason, flowclass.CLASS_NONE, n)
 
 
 def acquire(broker: "Broker", hook) -> Optional["RouteState"]:
@@ -618,6 +658,37 @@ class RouteState:
 
     # -- egress --------------------------------------------------------------
 
+    @staticmethod
+    def _ledger_ingress_fold(fc, pos: int, consumed: int, buf,
+                             offs, lens, peer) -> None:
+        """Fold one plan call's consumed frames into the ledger's ingress
+        (and, for a mesh link, per-link recv) tables — one bincount for
+        the classed frames; routed-nowhere frames (out_class 255) resolve
+        their wire class per frame so both link ends count identically,
+        and take their terminal fate here (no_interest for a pruned-empty
+        Broadcast, no_route for an unknown-recipient Direct)."""
+        if not ledger_mod.LEDGER.enabled or not consumed:
+            return
+        fci = np.asarray(fc[pos:pos + consumed])
+        counts = np.bincount(
+            np.minimum(fci, flowclass.N_CLASSES),
+            minlength=flowclass.N_CLASSES + 1)
+        for c in range(flowclass.N_CLASSES):
+            n = int(counts[c])
+            if n:
+                ledger_mod.note_ingress(c, n, peer)
+        if int(counts[flowclass.N_CLASSES]):
+            mv = memoryview(buf)
+            for i in np.nonzero(fci >= flowclass.N_CLASSES)[0].tolist():
+                j = pos + i
+                data = mv[int(offs[j]):int(offs[j]) + int(lens[j])]
+                cls = flowclass.frame_class(data)
+                ledger_mod.note_ingress(cls, 1, peer)
+                kind = data[0] if len(data) else 0
+                reason = ("no_interest" if (kind & 0x7F) == 5
+                          else "no_route")
+                ledger_mod.record_fate("dropped", reason, cls)
+
     async def _send_plan(self, chunk: FrameChunk, offs: np.ndarray,
                          lens: np.ndarray, peers: np.ndarray,
                          frames: np.ndarray, fc=None) -> None:
@@ -663,7 +734,9 @@ class RouteState:
             if peer < user_cap:
                 key = slot_user[peer]
                 if key is None:
-                    continue  # freed slot raced the plan: drop (defensive)
+                    # freed slot raced the plan: drop (defensive)
+                    _note_fate_classes("dropped", "no_route", fc, idx)
+                    continue
                 shard = user_shard[peer]
                 if shard != local_shard:
                     # sibling-shard user: cross-shard handoff (collected
@@ -680,7 +753,13 @@ class RouteState:
                 b = peer - user_cap
                 ident = slot_broker[b]
                 if ident is None:
-                    continue  # freed slot: drop (defensive)
+                    # freed slot: drop (defensive)
+                    _note_fate_classes("dropped", "no_route", fc, idx)
+                    continue
+                # mesh-bound pair group: the per-link conservation table
+                # counts here (the routing decision) whether the frames
+                # ride this shard's link or a sibling's ring
+                _note_link_classes(ident, fc, idx)
                 shard = broker_shard[b]
                 if shard is not None:
                     if ring is None:
@@ -723,12 +802,15 @@ class RouteState:
             else:
                 conn = broker.connections.get_broker_connection(key)
             if conn is None:
-                continue  # peer left since the plan: drop (scalar parity)
+                # peer left since the plan: drop (scalar parity)
+                ledger_mod.record_fate("dropped", "no_route", cls, n_frames)
+                continue
             (metrics_mod.EGRESS_FRAMES_USER if is_user_peer
              else metrics_mod.EGRESS_FRAMES_BROKER).inc(n_frames)
             try:
                 await conn.send_encoded(data, owner, cls=cls,
-                                        nframes=0, nbytes=0)
+                                        nframes=0, nbytes=0,
+                                        count=n_frames)
             except asyncio.CancelledError:
                 raise
             except Exception as exc:
@@ -754,6 +836,8 @@ class RouteState:
         the sender's own connection (the admission token bucket's seat)."""
         broker = self.broker
         topics_space = broker.run_def.topics
+        ledger_mod.note_ingress(_ingress_class(message),
+                                peer=None if is_user else sender_id)
         if isinstance(message, Direct):
             tr = message.trace
             if tr is not None:
@@ -828,6 +912,16 @@ class RouteState:
             broker.update_metrics()
         elif not is_user and isinstance(message, TopicSync):
             broker.connections.apply_topic_sync(sender_id, message.payload)
+        elif not is_user and isinstance(message, LedgerSync):
+            # peer's conservation balance sheet (ISSUE 20; scalar-twin
+            # parity with broker_receive_loop — never link-fatal)
+            import json
+            try:
+                sheet = json.loads(bytes(message.payload))
+            except (ValueError, UnicodeDecodeError):
+                sheet = None
+            if sheet is not None:
+                ledger_mod.LEDGER.note_peer_sheet(sender_id, sheet)
         else:
             # users may not send auth/sync post-handshake; brokers may not
             # send auth/subscribe — disconnect (scalar parity, including
@@ -852,6 +946,8 @@ class RouteState:
                            sender_id)
         if conn is not None:
             conn.flightrec.record("malformed-frame", abnormal=True)
+        ledger_mod.record_fate("dropped", "malformed",
+                               flowclass.CLASS_NONE)
 
     # -- drains --------------------------------------------------------------
 
@@ -984,6 +1080,9 @@ class RouteState:
                                       lens[pos:pos + consumed],
                                       metrics_mod.CLASS_FRAMES_IN,
                                       metrics_mod.CLASS_BYTES_IN)
+                    self._ledger_ingress_fold(
+                        fc, pos, consumed, buf, offs, lens,
+                        None if is_user else sender_id)
                     # durable retention seam (ISSUE 14): stamp the consumed
                     # broadcasts in the same synchronous region as the plan
                     # (before the first egress await), so a SubscribeFrom

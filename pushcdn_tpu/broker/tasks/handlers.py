@@ -31,6 +31,7 @@ from typing import TYPE_CHECKING, List, Optional, Sequence
 
 from pushcdn_tpu.broker.staging import StageResult
 from pushcdn_tpu.proto import flowclass
+from pushcdn_tpu.proto import ledger as ledger_mod
 from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto import trace as trace_mod
 from pushcdn_tpu.proto.def_ import HookResult
@@ -39,6 +40,7 @@ from pushcdn_tpu.proto.limiter import Bytes
 from pushcdn_tpu.proto.message import (
     Broadcast,
     Direct,
+    LedgerSync,
     Subscribe,
     SubscribeFrom,
     TopicSync,
@@ -46,6 +48,17 @@ from pushcdn_tpu.proto.message import (
     UserSync,
     deserialize,
 )
+
+
+def _ingress_class(message) -> int:
+    """Frame-derived ledger class for ingress/link-recv accounting — the
+    SAME rule senders use for the per-link tables (ISSUE 20): Broadcast →
+    first-topic class, Direct → live, any other kind → control."""
+    if isinstance(message, Broadcast):
+        return flowclass.class_of_topics(message.topics)
+    if isinstance(message, Direct):
+        return flowclass.LIVE
+    return flowclass.CONTROL
 from pushcdn_tpu.proto.util import mnemonic
 
 if TYPE_CHECKING:
@@ -104,14 +117,19 @@ class EgressBatch:
         lst.append(raw.clone())
         self.appended += 1
 
-    def to_broker(self, identifier: str, raw: Bytes) -> None:
+    def to_broker(self, identifier: str, raw: Bytes,
+                  cls: int = flowclass.LIVE) -> None:
         lst = self.brokers.get(identifier)
         if lst is None:
             lst = self.brokers[identifier] = []
         lst.append(raw.clone())
         self.appended += 1
+        # per-link conservation table (ISSUE 20): counted at the routing
+        # decision, where the per-frame class is exact on both ends
+        ledger_mod.note_link_sent(identifier, cls)
 
-    def to_shard(self, shard: int, kind: int, ident, raw: Bytes) -> None:
+    def to_shard(self, shard: int, kind: int, ident, raw: Bytes,
+                 cls: int = flowclass.LIVE) -> None:
         """Queue a fan-out clone for a peer living on a sibling shard
         (``kind`` is shardring.KIND_USER/KIND_BROKER)."""
         targets = self.shards.get(shard)
@@ -122,6 +140,8 @@ class EgressBatch:
             lst = targets[(kind, ident)] = []
         lst.append(raw.clone())
         self.appended += 1
+        if kind == 1:  # shardring.KIND_BROKER: a mesh link via shard 0
+            ledger_mod.note_link_sent(ident, cls)
 
     def release_all(self) -> None:
         for frames in self.users.values():
@@ -187,7 +207,7 @@ class EgressBatch:
         if encoded is not None:
             for f in frames:
                 f.release()
-            await conn.send_encoded(encoded, nbytes=0)
+            await conn.send_encoded(encoded, nbytes=0, count=len(frames))
         else:
             await conn.send_raw_many(frames, nframes=0, nbytes=0)
 
@@ -305,6 +325,9 @@ def route_direct(broker: "Broker", recipient: bytes, raw: Bytes,
         metrics_mod.CLASS_BYTES_IN[flowclass.LIVE].inc(nb)
         metrics_mod.CLASS_FRAMES_OUT[flowclass.LIVE].inc(delta)
         metrics_mod.CLASS_BYTES_OUT[flowclass.LIVE].inc(delta * nb)
+    else:
+        # unknown/stale recipient: the frame's terminal fate (ISSUE 20)
+        ledger_mod.record_fate("dropped", "no_route", flowclass.LIVE)
 
 
 def _route_direct(broker: "Broker", recipient: bytes, raw: Bytes,
@@ -401,13 +424,13 @@ def route_broadcast(broker: "Broker", topics: Sequence[int], raw: Bytes,
     reads that byte before pruning, and the scalar twin must agree).
     """
     before = egress.appended
+    cls = flowclass.class_of_topics(
+        raw_topics if raw_topics is not None else topics)
     _route_broadcast(broker, topics, raw, to_users_only, egress,
                      users_via_device=users_via_device,
                      exclude_brokers=exclude_brokers,
-                     interest_cache=interest_cache)
+                     interest_cache=interest_cache, cls=cls)
     if topics:
-        cls = flowclass.class_of_topics(
-            raw_topics if raw_topics is not None else topics)
         data = getattr(raw, "data", None)
         nb = (len(data) + 4) if data is not None else 4
         metrics_mod.CLASS_FRAMES_IN[cls].inc()
@@ -416,13 +439,17 @@ def route_broadcast(broker: "Broker", topics: Sequence[int], raw: Bytes,
         if delta:
             metrics_mod.CLASS_FRAMES_OUT[cls].inc(delta)
             metrics_mod.CLASS_BYTES_OUT[cls].inc(delta * nb)
+        elif not users_via_device:
+            # zero interested recipients: a counted (benign) fate
+            ledger_mod.record_fate("dropped", "no_interest", cls)
 
 
 def _route_broadcast(broker: "Broker", topics: Sequence[int], raw: Bytes,
                      to_users_only: bool, egress: EgressBatch,
                      users_via_device: bool = False,
                      exclude_brokers: frozenset = frozenset(),
-                     interest_cache: Optional[dict] = None) -> None:
+                     interest_cache: Optional[dict] = None,
+                     cls: int = flowclass.LIVE) -> None:
     if interest_cache is None:
         users, brokers = broker.connections.get_interested_by_topic(
             list(topics), to_users_only)
@@ -447,12 +474,12 @@ def _route_broadcast(broker: "Broker", topics: Sequence[int], raw: Bytes,
             if ident in exclude_brokers:
                 continue
             if ident in local_brokers:
-                egress.to_broker(ident, raw)
+                egress.to_broker(ident, raw, cls=cls)
             else:
                 link_shard = conns.remote_broker_shard.get(ident)
                 if link_shard is not None:
                     egress.to_shard(link_shard, shardring.KIND_BROKER,
-                                    ident, raw)
+                                    ident, raw, cls=cls)
         if not users_via_device:
             for user in users:
                 if user in local_users:
@@ -470,7 +497,7 @@ def _route_broadcast(broker: "Broker", topics: Sequence[int], raw: Bytes,
         return
     for ident in brokers:
         if ident not in exclude_brokers:
-            egress.to_broker(ident, raw)
+            egress.to_broker(ident, raw, cls=cls)
     if not users_via_device:
         for user in users:
             egress.to_user(user, raw)
@@ -559,8 +586,11 @@ async def user_receive_loop(broker: "Broker", public_key: bytes,
                             mnemonic(public_key))
                         connection.flightrec.record("malformed-frame",
                                                     abnormal=True)
+                        ledger_mod.record_fate("dropped", "malformed",
+                                               flowclass.CLASS_NONE)
                         alive = False
                         break
+                    ledger_mod.note_ingress(_ingress_class(message))
                     result = hook(public_key, message)
                     if result == HookResult.SKIP:
                         continue
@@ -752,8 +782,12 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                             identifier)
                         connection.flightrec.record("malformed-frame",
                                                     abnormal=True)
+                        ledger_mod.record_fate("dropped", "malformed",
+                                               flowclass.CLASS_NONE)
                         alive = False
                         break
+                    ledger_mod.note_ingress(_ingress_class(message),
+                                            peer=identifier)
                     result = hook(identifier, message)
                     if result == HookResult.SKIP:
                         continue
@@ -802,6 +836,18 @@ async def broker_receive_loop(broker: "Broker", identifier: str,
                     elif isinstance(message, TopicSync):
                         broker.connections.apply_topic_sync(identifier,
                                                             message.payload)
+                    elif isinstance(message, LedgerSync):
+                        # peer's conservation balance sheet (ISSUE 20) —
+                        # unparseable sheets are ignored, not link-fatal
+                        # (monotone snapshots, last writer wins)
+                        import json
+                        try:
+                            sheet = json.loads(bytes(message.payload))
+                        except (ValueError, UnicodeDecodeError):
+                            sheet = None
+                        if sheet is not None:
+                            ledger_mod.LEDGER.note_peer_sheet(identifier,
+                                                              sheet)
                     else:
                         logger.warning(
                             "broker %s sent unexpected %s; dropping link",

@@ -14,6 +14,8 @@ import time
 from typing import TYPE_CHECKING, Iterable, List, Optional
 
 from pushcdn_tpu import native as native_mod
+from pushcdn_tpu.proto import flowclass
+from pushcdn_tpu.proto import ledger as ledger_mod
 from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto.limiter import Bytes
 from pushcdn_tpu.proto.util import mnemonic
@@ -196,6 +198,11 @@ async def try_send_to_broker(broker: "Broker", identifier: str,
     clone = raw.clone()
     try:
         await connection.send_raw(clone)
+        # control-plane mesh frames (topic/ledger sync) ride this path
+        # rather than the routed egress batches — count them into the
+        # per-link conservation table with the same wire-byte rule the
+        # receiving end uses, or every mesh link reads recv > sent
+        ledger_mod.note_link_sent(identifier, flowclass.frame_class(raw.data))
         return True
     except Exception as exc:
         clone.release()

@@ -15,8 +15,9 @@ import logging
 from typing import TYPE_CHECKING, Optional
 
 from pushcdn_tpu.broker.tasks.senders import try_send_to_broker, try_send_to_brokers
+from pushcdn_tpu.proto import ledger as ledger_mod
 from pushcdn_tpu.proto.limiter import Bytes
-from pushcdn_tpu.proto.message import TopicSync, UserSync, serialize
+from pushcdn_tpu.proto.message import LedgerSync, TopicSync, UserSync, serialize
 
 if TYPE_CHECKING:
     from pushcdn_tpu.broker.broker import Broker
@@ -61,9 +62,24 @@ async def full_topic_sync(broker: "Broker", peer: str) -> None:
     raw.release()
 
 
+async def ledger_sync(broker: "Broker") -> None:
+    """Broadcast this process's conservation balance sheet (ISSUE 20):
+    monotone per-link sent/received counters + fate totals, as an opaque
+    JSON ``LedgerSync``. Snapshot-sized and interval-paced — no
+    per-frame wire overhead."""
+    if not ledger_mod.LEDGER.enabled:
+        return
+    import json
+    sheet = ledger_mod.LEDGER.sheet(broker.connections.identity)
+    raw = _frame(LedgerSync(payload=json.dumps(sheet).encode()))
+    await try_send_to_brokers(broker, broker.connections.all_broker_identifiers(), raw)
+    raw.release()
+
+
 async def run_sync_task(broker: "Broker") -> None:
     """Periodic partial syncs to every peer (sync.rs:129-145)."""
     while True:
         await asyncio.sleep(broker.config.sync_interval_s)
         await partial_user_sync(broker)
         await partial_topic_sync(broker)
+        await ledger_sync(broker)

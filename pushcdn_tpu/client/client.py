@@ -26,8 +26,9 @@ import random
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import List, Optional, Set, Type
+from typing import Callable, Dict, List, Optional, Set, Tuple, Type
 
+from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto import trace as trace_mod
 from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME, KeyPair, SignatureScheme
 from pushcdn_tpu.proto.error import Error, ErrorKind, bail
@@ -85,6 +86,76 @@ def backoff_delay(attempt: int, retry_after_s: Optional[float] = None,
     return delay
 
 
+class GapDetector:
+    """Live delivery-gap detector (ISSUE 20): the subscriber's half of
+    the frame-fate ledger. The application tells the client how to read
+    a (stream, sequence) pair out of a delivery (``ClientConfig.
+    seq_extractor``) and the client accounts every arrival AS IT LANDS:
+
+    - a sequence jumping past the stream's high-water mark opens a hole
+      per skipped value (``cdn_client_gap_events`` — counted live, not
+      at wrap-up);
+    - a late arrival filling a tracked hole HEALS it
+      (``cdn_client_gap_healed`` — an at-least-once redelivery or
+      reorder, which stays legal);
+    - a re-delivery of an already-seen value is a duplicate and touches
+      neither counter.
+
+    Outstanding loss as this client sees it is ``events - healed``
+    (equivalently :attr:`open_gaps`); harness wrap-up loss checks read
+    that instead of diffing delivery logs after the fact. The first
+    observation of a stream anchors its high-water mark — joining late
+    is not a gap. Hole tracking is bounded (``MAX_OPEN`` per stream,
+    oldest evicted first); an evicted hole can no longer heal, which
+    over-counts residual loss only in runs already losing thousands of
+    frames per stream."""
+
+    MAX_OPEN = 4096
+
+    __slots__ = ("_hi", "_holes", "events", "healed", "unique",
+                 "duplicates")
+
+    def __init__(self) -> None:
+        self._hi: Dict[int, int] = {}       # stream -> highest seq + 1
+        self._holes: Dict[int, set] = {}    # stream -> open (missed) seqs
+        self.events = 0
+        self.healed = 0
+        self.unique = 0
+        self.duplicates = 0
+
+    def observe(self, stream: int, seq: int) -> None:
+        hi = self._hi.get(stream)
+        if hi is None:
+            self._hi[stream] = seq + 1
+            self.unique += 1
+            return
+        if seq >= hi:
+            missed = seq - hi
+            if missed:
+                self.events += missed
+                metrics_mod.CLIENT_GAP_EVENTS.inc(missed)
+                holes = self._holes.setdefault(stream, set())
+                holes.update(range(max(hi, seq - self.MAX_OPEN), seq))
+                while len(holes) > self.MAX_OPEN:
+                    holes.discard(min(holes))  # rare: cap the tracker
+            self._hi[stream] = seq + 1
+            self.unique += 1
+            return
+        holes = self._holes.get(stream)
+        if holes is not None and seq in holes:
+            holes.discard(seq)
+            self.healed += 1
+            self.unique += 1
+            metrics_mod.CLIENT_GAP_HEALED.inc()
+            return
+        self.duplicates += 1
+
+    @property
+    def open_gaps(self) -> int:
+        """Holes still unfilled — the live residual-loss figure."""
+        return sum(len(h) for h in self._holes.values())
+
+
 def decode_received(items) -> List[Message]:
     """Decode a ``Connection.recv_frames`` drain into Message objects —
     the client receive path's batch decoder, shared with the benches so
@@ -128,6 +199,12 @@ class ClientConfig:
     subscribed_topics: Set[int] = field(default_factory=set)
     use_local_authority: bool = True
     limiter: Limiter = NO_LIMIT
+    # live gap detection (ISSUE 20): maps a delivered message to its
+    # (stream, sequence) pair, or None for messages that carry no
+    # sequence. Setting it arms :class:`GapDetector` on the receive
+    # path (``Client.gap_detector``).
+    seq_extractor: Optional[Callable[[Message],
+                                     Optional[Tuple[int, int]]]] = None
 
 
 class Client:
@@ -163,6 +240,10 @@ class Client:
         # (Migrate processed -> new home live), read by the swarm soak
         # harness for its re-home latency percentiles
         self.rehome_ms: List[float] = []
+        # live gap detection (armed only when the config supplies a
+        # sequence extractor — zero cost otherwise)
+        self.gap_detector: Optional[GapDetector] = \
+            GapDetector() if config.seq_extractor is not None else None
 
     def _shed_error(self, message: AuthenticateResponse) -> Error:
         """A post-handshake ``permit=0`` response is the broker's typed
@@ -381,13 +462,26 @@ class Client:
         await self.send_message(Direct(recipient=recipient_public_key,
                                        message=payload))
 
+    def _observe_gaps(self, messages) -> None:
+        """Feed delivered messages through the live gap detector (no-op
+        unless the config armed one)."""
+        extract = self.config.seq_extractor
+        det = self.gap_detector
+        for m in messages:
+            key = extract(m)
+            if key is not None:
+                det.observe(key[0], key[1])
+
     async def receive_message(self) -> Message:
         while True:
             if self._pending_shed is not None:
                 err, self._pending_shed = self._pending_shed, None
                 raise err
             if self._migration_backlog:
-                return self._migration_backlog.popleft()
+                m = self._migration_backlog.popleft()
+                if self.gap_detector is not None:
+                    self._observe_gaps((m,))
+                return m
             if self._pending_migrate is not None:
                 mig, self._pending_migrate = self._pending_migrate, None
                 await self._complete_migration(mig)
@@ -410,6 +504,8 @@ class Client:
                 tr = getattr(message, "trace", None)
                 if tr is not None:
                     trace_mod.emit("delivery", tr)
+            if self.gap_detector is not None:
+                self._observe_gaps((message,))
             return message
 
     async def receive_messages(self, max_messages: int = 1024
@@ -432,6 +528,8 @@ class Client:
             if self._migration_backlog:
                 out = list(self._migration_backlog)
                 self._migration_backlog.clear()
+                if self.gap_detector is not None:
+                    self._observe_gaps(out)
                 return out
             if self._pending_migrate is not None:
                 mig, self._pending_migrate = self._pending_migrate, None
@@ -488,6 +586,8 @@ class Client:
                     tr = getattr(m, "trace", None)
                     if tr is not None:
                         trace_mod.emit("delivery", tr)
+            if self.gap_detector is not None:
+                self._observe_gaps(out)
             return out
 
     # -- subscriptions -------------------------------------------------------

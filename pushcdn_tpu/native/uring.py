@@ -112,6 +112,9 @@ def _compile() -> Optional[ctypes.CDLL]:
     lib.pcu_telem_test_observe.restype = ctypes.c_int
     lib.pcu_telem_test_observe.argtypes = [P, ctypes.c_int, ctypes.c_int,
                                            _u64, _u64]
+    lib.pcu_telem_test_count.restype = ctypes.c_int
+    lib.pcu_telem_test_count.argtypes = [P, ctypes.c_int, ctypes.c_int,
+                                         _u64]
     return lib
 
 
@@ -196,7 +199,10 @@ TM_PEER_FD_OFF = TM_CLASS_BYTES_OFF + TM_CLASSES
 TM_PEER_FRAMES_OFF = TM_PEER_FD_OFF + TM_PEERS
 TM_PEER_BYTES_OFF = TM_PEER_FRAMES_OFF + TM_PEERS
 TM_PEER_USED_OFF = TM_PEER_BYTES_OFF + TM_PEERS
-TM_WORDS = TM_PEER_USED_OFF + 1
+# frame-fate ledger (ISSUE 20): per-class pump-drop counters, appended
+# at the end of pcu_telem so every prior snapshot offset stays stable
+TM_DROP_FRAMES_OFF = TM_PEER_USED_OFF + 1
+TM_WORDS = TM_DROP_FRAMES_OFF + TM_CLASSES
 
 STAGE_NAMES = ("plan", "submit", "wire", "total")
 CHAIN_NAMES = ("enter", "chain")
@@ -230,6 +236,9 @@ def parse_telemetry(words):
                          for i in range(TM_CLASSES)},
         "class_bytes": {CLASS_NAMES[i]: int(words[TM_CLASS_BYTES_OFF + i])
                         for i in range(TM_CLASSES)},
+        "class_drop_frames": {CLASS_NAMES[i]:
+                              int(words[TM_DROP_FRAMES_OFF + i])
+                              for i in range(TM_CLASSES)},
     }
     used = min(int(words[TM_PEER_USED_OFF]), TM_PEERS)
     out["peers"] = [
@@ -434,6 +443,13 @@ class Ring:
             return -1
         return int(self._lib.pcu_telem_test_observe(
             self._h, kind, idx, ns, n))
+
+    def telemetry_test_count(self, which: int, idx: int, n: int = 1) -> int:
+        """Test hook: bump a flat per-class counter (which 0=class_frames
+        1=fate_drop_frames) so the ledger fold is testable pump-free."""
+        if not self._h:
+            return -1
+        return int(self._lib.pcu_telem_test_count(self._h, which, idx, n))
 
     def pbuf_read(self, bid: int, nbytes: int) -> bytes:
         """Copy a provided buffer's payload out (the one copy the recv

@@ -117,6 +117,35 @@ def class_of_topics(topics) -> int:
     return LIVE
 
 
+def frame_class(data) -> int:
+    """Class of ONE serialized frame from its wire bytes — the shared
+    sender/receiver rule the per-link conservation ledger tables use
+    (ISSUE 20), so both ends of a mesh link classify identically:
+    Broadcast → class of its first topic byte, Direct → ``live``, every
+    other kind (auth / subscribe / sync / retained / control) →
+    ``control``. Mirrors :func:`class_of_topics` and route_plan.cpp."""
+    n = len(data)
+    if not n:
+        return CONTROL
+    kind = data[0]
+    if kind == 4 or kind == 0x84:        # Direct (plain / traced)
+        return LIVE
+    if kind == 5:                        # Broadcast: <u16 ntopics> topics
+        if n >= 4 and (data[1] or data[2]):
+            return int(_active_table[data[3]])
+        return LIVE
+    if kind == 0x85:                     # traced Broadcast (rare, sampled)
+        try:
+            from pushcdn_tpu.proto.message import unpack_trace
+            _tr, off = unpack_trace(memoryview(data), 1)
+            if n >= off + 3 and (data[off] or data[off + 1]):
+                return int(_active_table[data[off + 2]])
+        except Exception:
+            pass
+        return LIVE
+    return CONTROL
+
+
 def bincount_classes(classes: np.ndarray, lens=None):
     """(frames[4], bytes[4]) over a per-frame class array (u8; values
     >= N_CLASSES — e.g. CLASS_NONE — are excluded). ``lens`` adds 4

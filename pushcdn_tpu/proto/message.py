@@ -50,6 +50,7 @@ KIND_TOPIC_SYNC = 9
 KIND_MIGRATE = 10
 KIND_SUBSCRIBE_FROM = 11
 KIND_RETAINED = 12
+KIND_LEDGER_SYNC = 13
 
 # sequence sentinels for SubscribeFrom (durable topics, ISSUE 14): the
 # top of the u64 range can never be a real retention sequence (rings
@@ -299,6 +300,22 @@ class TopicSync:
 
 
 @dataclass(frozen=True, slots=True)
+class LedgerSync:
+    """Broker ↔ broker: opaque JSON balance sheet of the sender's
+    frame-fate conservation ledger (ISSUE 20) — monotone per-link
+    sent/received counters exchanged over the existing sync task so
+    each hop can compute its deficit against its upstream with no
+    per-frame wire overhead. Interior produced by
+    ``proto.ledger.Ledger.sheet``; a receiver that cannot parse it
+    ignores it (last-writer-wins per peer, no CRDT merge needed —
+    counters are monotone snapshots)."""
+
+    payload: BytesLike
+
+    kind = KIND_LEDGER_SYNC
+
+
+@dataclass(frozen=True, slots=True)
 class Migrate:
     """Broker → user: re-home to ``target`` (ISSUE 12 elastic membership).
 
@@ -368,6 +385,7 @@ Message = Union[
     Unsubscribe,
     UserSync,
     TopicSync,
+    LedgerSync,
     Migrate,
     SubscribeFrom,
     Retained,
@@ -386,6 +404,7 @@ _ALL_KINDS = {
     KIND_MIGRATE,
     KIND_SUBSCRIBE_FROM,
     KIND_RETAINED,
+    KIND_LEDGER_SYNC,
 }
 
 
@@ -426,7 +445,7 @@ def serialize(msg: Message) -> bytes:
             _U16.pack_into(out, 1, len(topics))
             out[3:] = bytes(topics)
             frame = bytes(out)
-        elif kind in (KIND_USER_SYNC, KIND_TOPIC_SYNC):
+        elif kind in (KIND_USER_SYNC, KIND_TOPIC_SYNC, KIND_LEDGER_SYNC):
             frame = bytes([kind]) + bytes(msg.payload)
         elif kind == KIND_AUTHENTICATE_WITH_KEY:
             pk, sig = msg.public_key, msg.signature
@@ -497,6 +516,8 @@ def deserialize(frame: BytesLike) -> Message:
             return UserSync(payload=view[1:])
         if kind == KIND_TOPIC_SYNC:
             return TopicSync(payload=view[1:])
+        if kind == KIND_LEDGER_SYNC:
+            return LedgerSync(payload=view[1:])
         if kind == KIND_AUTHENTICATE_WITH_KEY:
             off = 1
             (pklen,) = _U32.unpack_from(view, off)
@@ -600,8 +621,10 @@ def materialize(msg: Message) -> Message:
         if msg.trace is not None:
             return TracedBroadcast(msg.topics, bytes(msg.message), msg.trace)
         return Broadcast(topics=msg.topics, message=bytes(msg.message))
-    if kind in (KIND_USER_SYNC, KIND_TOPIC_SYNC) and isinstance(msg.payload, memoryview):
-        cls = UserSync if kind == KIND_USER_SYNC else TopicSync
+    if kind in (KIND_USER_SYNC, KIND_TOPIC_SYNC, KIND_LEDGER_SYNC) \
+            and isinstance(msg.payload, memoryview):
+        cls = (UserSync if kind == KIND_USER_SYNC
+               else TopicSync if kind == KIND_TOPIC_SYNC else LedgerSync)
         return cls(payload=bytes(msg.payload))
     if kind == KIND_RETAINED and isinstance(msg.payload, memoryview):
         return Retained(topic=msg.topic, seq=msg.seq, payload=bytes(msg.payload))

@@ -552,6 +552,8 @@ def update_native_telemetry(totals: Optional[dict]) -> None:
         URING_CHAIN_SECONDS.update(stat, h)
     for cls, h in totals["class_delay"].items():
         PUMP_CLASS_DELAY_SECONDS.update(cls, h)
+    # lazy: ledger.py imports this module for its metric families
+    from pushcdn_tpu.proto import ledger as ledger_mod
     for i, cls in enumerate(_CLASS_NAMES):
         for kind, child_row, series in (
                 ("frames", CLASS_FRAMES_OUT, totals["class_frames"]),
@@ -561,6 +563,30 @@ def update_native_telemetry(totals: Optional[dict]) -> None:
             if cur > last:
                 child_row[i].inc(cur - last)
             _native_class_last[(kind, cls)] = max(cur, last)
+        # conservation fold (ISSUE 20): a pumped frame's queued credit and
+        # terminal fate land in the SAME delta (delivered = class_frames,
+        # dropped = fate_drop_frames), so pump in-flight is invisible to
+        # the identity by construction and the balance sheet never shows
+        # a transient pumped deficit.
+        delivered = 0
+        cur = int(totals["class_frames"].get(cls, 0))
+        last = _native_class_last.get(("ledger_frames", cls), 0)
+        if cur > last:
+            delivered = cur - last
+        _native_class_last[("ledger_frames", cls)] = max(cur, last)
+        dropped = 0
+        cur = int(totals.get("class_drop_frames", {}).get(cls, 0))
+        last = _native_class_last.get(("ledger_drops", cls), 0)
+        if cur > last:
+            dropped = cur - last
+        _native_class_last[("ledger_drops", cls)] = max(cur, last)
+        if delivered or dropped:
+            ledger_mod.note_queued(i, delivered + dropped)
+            if delivered:
+                ledger_mod.record_fate("delivered", "pumped", i, delivered)
+            if dropped:
+                ledger_mod.record_fate("dropped", "pump_peer_poison", i,
+                                       dropped)
 
 
 # Callables run before every render: components whose counters move on
@@ -582,6 +608,22 @@ BLS_PK_CACHE_MISSES = BLS_PK_CACHE.labels(stat="misses")
 BLS_PK_CACHE_EVICTIONS = BLS_PK_CACHE.labels(stat="evictions")
 BLS_PK_CACHE_ENTRIES = BLS_PK_CACHE.labels(stat="entries")
 BLS_PK_CACHE_BYTES = BLS_PK_CACHE.labels(stat="bytes")
+
+# Client-side live gap detector (ISSUE 20): the subscriber's view of
+# the frame-fate ledger. A gap EVENT is a sequence hole opening in a
+# stream the client follows (frames skipped past); a HEAL is a late
+# arrival filling a tracked hole (an at-least-once redelivery or
+# reorder — legal). Outstanding loss as the client sees it is
+# events - healed; wrap-up loss checks read these live counters
+# instead of post-hoc log diffing. Duplicates never touch either.
+CLIENT_GAP_EVENTS = Counter(
+    "cdn_client_gap_events",
+    "Delivery-sequence holes opened in streams this client follows "
+    "(frames skipped past; late arrivals may still heal them)")
+CLIENT_GAP_HEALED = Counter(
+    "cdn_client_gap_healed",
+    "Previously-open delivery gaps filled by a late arrival "
+    "(at-least-once redelivery or reorder — legal)")
 
 # Message-lifecycle tracing (proto/trace.py): per-hop latency from the
 # traced message's origin. Defined here (not in trace.py) so every

@@ -42,6 +42,7 @@ from pushcdn_tpu.proto.message import (
     serialize,
 )
 from pushcdn_tpu.proto import flightrec
+from pushcdn_tpu.proto import ledger as ledger_mod
 from pushcdn_tpu.proto import metrics as metrics_mod
 
 # Live connections (weak), for the metrics writer-queue-depth pre-render
@@ -311,6 +312,13 @@ class Connection:
         # readable at /debug/flightrec
         self.flightrec = flightrec.FlightRecorder(label)
         self.flightrec.record("connect")
+        # frame-fate ledger attribution (ISSUE 20): broker links carry
+        # their peer identifier so dequeues count as relayed{peer} in the
+        # per-link conservation tables; teardown drains attribute their
+        # dropped frames to this reason (send_failed / parting_expiry)
+        # instead of the generic writer_teardown
+        self.ledger_peer: Optional[str] = None
+        self.ledger_drop_reason: Optional[str] = None
         LIVE_CONNECTIONS.add(self)
         qsize = limiter.queue_size()
         self._send_q: asyncio.Queue = asyncio.Queue(maxsize=qsize)
@@ -357,6 +365,10 @@ class Connection:
                 if item is _CLOSE or isinstance(item, Error):
                     continue
                 if isinstance(item, tuple):  # entry: (payload, done, stamp)
+                    stamp = item[2] if len(item) > 2 else None
+                    if stamp is not None and stamp[4]:
+                        ledger_mod.record_fate("dropped", "writer_teardown",
+                                               stamp[1], stamp[4])
                     item = item[0]
                     if type(item) is PreEncoded:
                         continue
@@ -496,6 +508,7 @@ class Connection:
                             # is in neither the queue nor `batch` — its
                             # permits and flush future are ours to settle
                             if item is not _CLOSE:
+                                self._account_dropped(item, None)
                                 payload, done = item[0], item[1]
                                 if type(payload) is list:
                                     for p in payload:
@@ -540,11 +553,15 @@ class Connection:
 
     def _account_entry(self, entry, now: float) -> None:
         """Per-class flow accounting at dequeue: the entry's enqueue stamp
-        is ``(t_enq, class, frames, bytes)`` — observe the writer-queue
-        delay for its class and fold the frame/byte counts into the egress
-        class counters. Accounts entries dequeued FOR writing (a flush
-        that subsequently fails is still counted here; ``BYTES_SENT``
-        remains the flushed-bytes ground truth)."""
+        is ``(t_enq, class, frames, bytes, real_frames)`` — observe the
+        writer-queue delay for its class and fold the frame/byte counts
+        into the egress class counters. ``frames``/``bytes`` may be 0
+        when the caller pre-counted the volume at the routing decision;
+        ``real_frames`` always carries the entry's actual frame count so
+        the conservation ledger stays exact either way. Accounts entries
+        dequeued FOR writing (a flush that subsequently fails is still
+        counted here; ``BYTES_SENT`` remains the flushed-bytes ground
+        truth, and the mesh audit's link deficit catches wire loss)."""
         stamp = entry[2]
         if stamp is None:
             return
@@ -553,6 +570,17 @@ class Connection:
             metrics_mod.CLASS_FRAMES_OUT[stamp[1]].inc(stamp[2])
         if stamp[3]:
             metrics_mod.CLASS_BYTES_OUT[stamp[1]].inc(stamp[3])
+        ledger_mod.on_dequeued(stamp[1], stamp[4], self.ledger_peer)
+
+    def _account_dropped(self, item, err: Optional[Error]) -> None:
+        """Fate accounting for one drained (never-written) send-queue
+        entry."""
+        stamp = item[2] if type(item) is tuple and len(item) > 2 else None
+        if stamp is None or not stamp[4]:
+            return
+        reason = self.ledger_drop_reason or (
+            "conn_poisoned" if err is not None else "writer_teardown")
+        ledger_mod.record_fate("dropped", reason, stamp[1], stamp[4])
 
     async def _writer_item(self, item, encoder_cell, enc_cap,
                            batch: list) -> bool:
@@ -1009,6 +1037,7 @@ class Connection:
                 break
             if item is _CLOSE:
                 continue
+            self._account_dropped(item, err)
             payload, done = item[0], item[1]
             if type(payload) is list:
                 for p in payload:
@@ -1103,10 +1132,11 @@ class Connection:
                 # the volume counters move
                 metrics_mod.CLASS_FRAMES_OUT[cls & 3].inc()
                 metrics_mod.CLASS_BYTES_OUT[cls & 3].inc(len(data) + 4)
+                ledger_mod.on_transit(cls & 3, 1, self.ledger_peer)
                 return
         done = asyncio.get_running_loop().create_future() if flush else None
         nb = (len(raw.data) if isinstance(raw, Bytes) else len(raw)) + 4
-        stamp = (time.monotonic(), cls & 3, 1, nb)
+        stamp = (time.monotonic(), cls & 3, 1, nb, 1)
         q = self._send_q
         if q.maxsize <= 0:
             # unbounded (the default): skip the awaited put's coroutine
@@ -1118,6 +1148,7 @@ class Connection:
             q.put_nowait((raw, done, stamp))
         else:
             await q.put((raw, done, stamp))
+        ledger_mod.note_queued(cls & 3, 1)
         self._ensure_writer()
         if self._error is not None:  # poisoned while enqueueing
             raise self._error
@@ -1134,10 +1165,11 @@ class Connection:
         nb = (len(raw.data) if isinstance(raw, Bytes) else len(raw)) + 4
         try:
             self._send_q.put_nowait(
-                (raw, None, (time.monotonic(), cls, 1, nb)))
+                (raw, None, (time.monotonic(), cls, 1, nb, 1)))
         except asyncio.QueueFull:
             self.flightrec.record("backpressure", "send queue full")
             raise
+        ledger_mod.note_queued(cls, 1)
         self._ensure_writer()
         if self._error is not None:
             raise self._error
@@ -1172,14 +1204,16 @@ class Connection:
         if nbytes is None:
             nbytes = sum(len(p.data) if isinstance(p, Bytes) else len(p)
                          for p in raws) + 4 * len(raws)
-        stamp = (time.monotonic(), cls & 3, nframes, nbytes)
+        stamp = (time.monotonic(), cls & 3, nframes, nbytes, len(raws))
         try:
             q = self._send_q
             if q.maxsize <= 0:
                 q.put_nowait((raws, done, stamp))  # unbounded: no coroutine hop
+                ledger_mod.note_queued(cls & 3, len(raws))
                 self._ensure_writer()
             else:
                 await q.put((raws, done, stamp))  # bounded: behind waiters
+                ledger_mod.note_queued(cls & 3, len(raws))
                 self._ensure_writer()
         except BaseException:
             # cancelled while blocked on a bounded queue: never inserted
@@ -1197,7 +1231,8 @@ class Connection:
             await done
 
     def send_encoded_nowait(self, data, owner=None, cls: int = 2,
-                            nframes: int = 0, nbytes=None) -> None:
+                            nframes: int = 0, nbytes=None,
+                            count: Optional[int] = None) -> None:
         """Queue an ALREADY length-delimited byte stream (one or many
         frames, each u32-BE-prefixed) to be written verbatim — the
         device-plane egress path: the native engine frames a whole step's
@@ -1208,23 +1243,28 @@ class Connection:
 
         The stream is opaque here (already framed), so callers that know
         the frame count pass ``nframes``; ``nbytes`` defaults to the
-        stream's length (header bytes included — it IS the wire image)."""
+        stream's length (header bytes included — it IS the wire image).
+        ``count`` is the REAL frame count for the conservation ledger
+        when ``nframes`` deliberately stays 0 (class volume pre-counted
+        at the routing decision); it defaults to ``nframes``."""
         self._check()
         if nbytes is None:
             nbytes = len(data)
-        stamp = (time.monotonic(), cls & 3, nframes, nbytes)
+        stamp = (time.monotonic(), cls & 3, nframes, nbytes,
+                 nframes if count is None else count)
         try:
             self._send_q.put_nowait((PreEncoded(data, owner), None, stamp))
         except asyncio.QueueFull:
             self.flightrec.record("backpressure", "send queue full")
             raise
+        ledger_mod.note_queued(cls & 3, stamp[4])
         self._ensure_writer()
         if self._error is not None:
             raise self._error
 
     async def send_encoded(self, data, owner=None, flush: bool = False,
                            cls: int = 2, nframes: int = 0,
-                           nbytes=None) -> None:
+                           nbytes=None, count: Optional[int] = None) -> None:
         """Awaited twin of :meth:`send_encoded_nowait`: queues behind a
         bounded send queue instead of raising ``QueueFull`` — the routing
         loops' pre-encoded egress handoff (one writer entry, one verbatim
@@ -1233,13 +1273,15 @@ class Connection:
         done = asyncio.get_running_loop().create_future() if flush else None
         if nbytes is None:
             nbytes = len(data)
+        real = nframes if count is None else count
         q = self._send_q
         entry = (PreEncoded(data, owner), done,
-                 (time.monotonic(), cls & 3, nframes, nbytes))
+                 (time.monotonic(), cls & 3, nframes, nbytes, real))
         if q.maxsize <= 0:
             q.put_nowait(entry)  # unbounded: no coroutine hop
         else:
             await q.put(entry)
+        ledger_mod.note_queued(cls & 3, real)
         self._ensure_writer()
         if self._error is not None:
             raise self._error
@@ -1259,7 +1301,9 @@ class Connection:
                 nbytes = sum(len(p.data) if isinstance(p, Bytes) else len(p)
                              for p in raws) + 4 * len(raws)
             self._send_q.put_nowait(
-                (raws, None, (time.monotonic(), cls & 3, nframes, nbytes)))
+                (raws, None,
+                 (time.monotonic(), cls & 3, nframes, nbytes, len(raws))))
+            ledger_mod.note_queued(cls & 3, len(raws))
             self._ensure_writer()
         except BaseException:
             for p in raws:

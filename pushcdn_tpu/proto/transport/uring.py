@@ -240,8 +240,8 @@ def _tm_merge(dst: dict, src: Optional[dict]) -> dict:
             db = d["buckets"]
             for k, c in enumerate(h["buckets"]):
                 db[k] += c
-    for key in ("class_frames", "class_bytes"):
-        for name, v in src[key].items():
+    for key in ("class_frames", "class_bytes", "class_drop_frames"):
+        for name, v in src.get(key, {}).items():
             dst[key][name] = dst[key].get(name, 0) + v
     dst["peers"].extend(src.get("peers", ()))
     return dst

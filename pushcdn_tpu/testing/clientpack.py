@@ -7,10 +7,15 @@ of wedging one loop. Two modes:
 
 - ``soak``: connect N clients, subscribe each to ``seed % topics``, and
   run a receive loop per client. Every broadcast payload carries a
-  4-byte big-endian per-topic sequence number; each client records the
-  de-duplicated arrival order so the parent can assert the elastic
-  invariant (no delivered-message loss or reorder across a live drain,
-  duplicates legal). Re-home latencies come from ``Client.rehome_ms``.
+  4-byte big-endian per-topic sequence number; the client library's own
+  LIVE gap detector (``Client.gap_detector``, armed via
+  ``ClientConfig.seq_extractor``) accounts every arrival as it lands —
+  holes opened (``cdn_client_gap_events``), holes healed by late
+  arrivals, duplicates — so the parent's wrap-up loss check reads the
+  detector's residual instead of diffing delivery logs post-hoc
+  (duplicates stay legal, at-least-once). Re-home latencies come from
+  ``Client.rehome_ms``; ``--metrics-endpoint`` exposes the gap counters
+  on a live /metrics scrape.
 
 - ``storm``: a pool of M clients performs Q full reconnect cycles
   (marshal auth -> broker permit redemption over real TCP) as fast as
@@ -33,6 +38,7 @@ import time
 from typing import List, Optional
 
 from pushcdn_tpu.client.client import Client, ClientConfig, backoff_delay
+from pushcdn_tpu.proto import metrics as metrics_mod
 from pushcdn_tpu.proto.crypto.signature import DEFAULT_SCHEME
 from pushcdn_tpu.proto.error import Error, ErrorKind
 from pushcdn_tpu.proto.message import Broadcast, Direct
@@ -54,42 +60,55 @@ def _seq(payload) -> int:
     return int.from_bytes(bytes(payload)[:4], "big")
 
 
+def make_seq_extractor(topic: int):
+    """The soak's ``ClientConfig.seq_extractor``: every Broadcast/Direct
+    payload opens with a 4-byte big-endian per-topic sequence number;
+    control frames carry no sequence."""
+    def extract(m):
+        if isinstance(m, (Broadcast, Direct)):
+            return (topic, _seq(m.message))
+        return None
+    return extract
+
+
 class SoakClient:
-    """One subscriber: counts deliveries, tracks the dedup'd arrival
-    order (gaps + reorders), rides out errors elastically."""
+    """One subscriber: drains deliveries and rides out errors
+    elastically. Loss accounting lives in the client library's LIVE
+    gap detector — this wrapper only reads it out."""
 
     def __init__(self, client: Client, topic: int):
         self.client = client
         self.topic = topic
-        self.delivered = 0          # raw deliveries, dups included
-        self.seen: set = set()
-        self.min_seq: Optional[int] = None
-        self.max_seq: Optional[int] = None
-        self.last_new: Optional[int] = None
-        self.reorders = 0           # first-seen seq below an earlier one
         self.hard_reconnects = 0    # non-migration connection losses
 
-    def _on_seq(self, s: int) -> None:
-        self.delivered += 1
-        if s in self.seen:
-            return                  # at-least-once handoff duplicate
-        self.seen.add(s)
-        if self.last_new is not None and s < self.last_new:
-            self.reorders += 1
-        self.last_new = s
-        self.min_seq = s if self.min_seq is None else min(self.min_seq, s)
-        self.max_seq = s if self.max_seq is None else max(self.max_seq, s)
+    @property
+    def delivered(self) -> int:
+        det = self.client.gap_detector
+        return det.unique + det.duplicates
+
+    @property
+    def unique(self) -> int:
+        return self.client.gap_detector.unique
 
     @property
     def gaps(self) -> int:
-        if self.min_seq is None:
-            return 0
-        return (self.max_seq - self.min_seq + 1) - len(self.seen)
+        """Residual loss as the live detector sees it RIGHT NOW —
+        holes opened and never healed by a late arrival."""
+        return self.client.gap_detector.open_gaps
+
+    @property
+    def reorders(self) -> int:
+        """Healed holes: a frame arrived after a later one (legal for
+        at-least-once delivery, but the soak's elastic invariant
+        requires zero)."""
+        return self.client.gap_detector.healed
 
     async def run(self, stop: asyncio.Event) -> None:
         while not stop.is_set():
             try:
-                messages = await self.client.receive_messages()
+                # the client's armed gap detector observes every
+                # delivery inside receive_messages — nothing to do here
+                await self.client.receive_messages()
             except asyncio.CancelledError:
                 raise
             except Error:
@@ -101,9 +120,6 @@ class SoakClient:
                 self.hard_reconnects += 1
                 await asyncio.sleep(backoff_delay(0))
                 continue
-            for m in messages:
-                if isinstance(m, (Broadcast, Direct)):
-                    self._on_seq(_seq(m.message))
 
 
 async def _read_commands(queue: "asyncio.Queue[str]") -> None:
@@ -131,24 +147,34 @@ def _soak_snapshot(packs: List[SoakClient]) -> dict:
         "live": live,
         "rehomed": sum(1 for p in packs if p.client.rehome_ms),
         "delivered": sum(p.delivered for p in packs),
-        "unique": sum(len(p.seen) for p in packs),
+        "unique": sum(p.unique for p in packs),
         "gaps": sum(p.gaps for p in packs),
         "reorders": sum(p.reorders for p in packs),
         "hard_reconnects": sum(p.hard_reconnects for p in packs),
         "rehome_ms": rehome_ms,
+        # process-wide live counters — the same numbers a /metrics
+        # scrape of this worker shows (cdn_client_gap_*)
+        "gap_events": metrics_mod.CLIENT_GAP_EVENTS.value,
+        "gap_healed": metrics_mod.CLIENT_GAP_HEALED.value,
     }
 
 
 async def run_soak(args) -> int:
+    metrics_server = None
+    if args.metrics_endpoint:
+        metrics_server = await metrics_mod.serve_metrics(
+            args.metrics_endpoint)
     packs: List[SoakClient] = []
     for i in range(args.clients):
+        topic = i % args.topics
         client = Client(ClientConfig(
             marshal_endpoint=args.marshal_endpoint,
             keypair=DEFAULT_SCHEME.generate_keypair(seed=args.seed_base + i),
             protocol=Tcp,
-            subscribed_topics={i % args.topics},
+            subscribed_topics={topic},
+            seq_extractor=make_seq_extractor(topic),
         ))
-        packs.append(SoakClient(client, i % args.topics))
+        packs.append(SoakClient(client, topic))
 
     sem = asyncio.Semaphore(args.connect_concurrency)
 
@@ -197,6 +223,8 @@ async def run_soak(args) -> int:
     snap = _soak_snapshot(packs)
     for p in packs:
         p.client.close()
+    if metrics_server is not None:
+        metrics_server.close()
     emit("result", mode="soak", **snap)
     return 0
 
@@ -289,6 +317,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed-base", type=int, required=True)
     p.add_argument("--topics", type=int, default=8)
     p.add_argument("--connect-concurrency", type=int, default=25)
+    p.add_argument("--metrics-endpoint", default="",
+                   help="soak mode: serve /metrics here so the live "
+                        "cdn_client_gap_* counters are scrapeable")
     p.add_argument("--report-every-s", type=float, default=2.0)
     p.add_argument("--settle-s", type=float, default=2.0)
     p.add_argument("--storm-connections", type=int, default=1000,

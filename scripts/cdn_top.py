@@ -27,8 +27,19 @@ Modes:
                      manifest) into DIR/bundle-<stamp>/ — on demand with
                      --once, and automatically when any /readyz flips
                      unready in watch mode (once per failure episode)
+    --audit          frame-fate conservation audit (ISSUE 20): merge every
+                     process's ``/debug/ledger`` into one cluster balance
+                     sheet — per-process queued/fate/violation totals and
+                     the per-link (sender-claimed sent vs receiver-counted
+                     recv) deficits. Deficits toward peers absent from the
+                     scrape set are ATTRIBUTED to that peer's death;
+                     deficits between two live processes after drain are
+                     unattributed loss. With --once: one fetch, one
+                     report, exit 0 only when zero conservation
+                     violations and zero unattributed deficit.
 
-Exit code: 0 on a clean run, 1 when --once could not reach ANY endpoint.
+Exit code: 0 on a clean run, 1 when --once could not reach ANY endpoint
+(or, with --audit --once, when the mesh balance sheet does not balance).
 """
 
 from __future__ import annotations
@@ -424,6 +435,148 @@ def render(rows: dict, head: dict, poll: int, dt: float) -> str:
 
 
 # ---------------------------------------------------------------------------
+# conservation audit (ISSUE 20)
+
+
+def fetch_ledger(endpoint: str):
+    """One process's /debug/ledger document, or None."""
+    res = http_get(endpoint, "/debug/ledger", timeout=3.0)
+    if res is None or res[0] != 200:
+        return None
+    try:
+        return json.loads(res[1])
+    except ValueError:
+        return None
+
+
+def _sheet_total(table: dict) -> int:
+    return sum(int(v) for v in (table or {}).values())
+
+
+def merge_audit(ledgers: dict) -> dict:
+    """Merge per-process /debug/ledger docs into one cluster balance
+    sheet. ``ledgers`` maps process name -> doc (or None when the
+    endpoint had no ledger — e.g. the marshal or a client)."""
+    procs = {}
+    ident_to_name = {}
+    for name, doc in ledgers.items():
+        if not doc:
+            continue
+        local = doc.get("local") or {}
+        ident = str(local.get("ident") or "") or name
+        ident_to_name[ident] = name
+        fates = local.get("fates") or {}
+        by_fate = {"delivered": 0, "relayed": 0, "dropped": 0}
+        drop_reasons = {}
+        for key, row in fates.items():
+            fate, _, reason = key.partition("/")
+            if fate in by_fate:
+                by_fate[fate] += _sheet_total(row)
+            if fate == "dropped":
+                drop_reasons[reason] = (drop_reasons.get(reason, 0)
+                                        + _sheet_total(row))
+        procs[name] = {
+            "ident": ident,
+            "queued": _sheet_total(local.get("queued")),
+            "ingress": _sheet_total(local.get("ingress")),
+            **by_fate,
+            "drop_reasons": drop_reasons,
+            "in_queue": _sheet_total(local.get("in_queue_derived")),
+            "violations": int(local.get("violations") or 0),
+        }
+    links = []
+    for name, doc in ledgers.items():
+        if not doc:
+            continue
+        local = doc.get("local") or {}
+        src = str(local.get("ident") or "") or name
+        for dst, sent in (local.get("link_sent") or {}).items():
+            dst_name = ident_to_name.get(dst)
+            alive = dst_name is not None
+            recv = {}
+            if alive:
+                dst_local = ledgers[dst_name].get("local") or {}
+                recv = (dst_local.get("link_recv") or {}).get(src) or {}
+            for cls, s in sorted(sent.items()):
+                s = int(s)
+                r = int(recv.get(cls, 0))
+                if s == 0 and r == 0:
+                    continue
+                links.append({"src": src, "dst": dst, "class": cls,
+                              "sent": s, "recv": r, "deficit": s - r,
+                              "dst_alive": alive})
+    unattributed = sum(l["deficit"] for l in links
+                       if l["dst_alive"] and l["deficit"] > 0)
+    attributed = sum(l["deficit"] for l in links
+                     if not l["dst_alive"] and l["deficit"] > 0)
+    return {
+        "procs": procs,
+        "links": links,
+        "violations": sum(p["violations"] for p in procs.values()),
+        "unattributed_deficit": unattributed,
+        "attributed_deficit": attributed,
+    }
+
+
+def render_audit(audit: dict) -> str:
+    """The cluster balance sheet, one screen. The final ``[audit]``
+    summary line is the machine-readable verdict local_cluster asserts
+    against."""
+    out = [f"cdn_top audit — {len(audit['procs'])} ledgers, "
+           f"{audit['violations']} conservation violations"]
+    out.append("")
+    out.append(f"{'PROC':<12} {'QUEUED':>9} {'DELIV':>9} {'RELAY':>9} "
+               f"{'DROP':>7} {'IN-Q':>6} {'VIOL':>5}")
+    for name in sorted(audit["procs"]):
+        p = audit["procs"][name]
+        out.append(f"{name:<12} {p['queued']:>9,} {p['delivered']:>9,} "
+                   f"{p['relayed']:>9,} {p['dropped']:>7,} "
+                   f"{p['in_queue']:>6,} {p['violations']:>5}")
+        if p["drop_reasons"]:
+            reasons = " ".join(f"{k}={v}" for k, v in
+                               sorted(p["drop_reasons"].items()))
+            out.append(f"{'':<12}   drops: {reasons}")
+    residual = [l for l in audit["links"] if l["deficit"] != 0]
+    if residual:
+        out.append("")
+        out.append("links with residual deficit (sender claim - "
+                   "receiver count):")
+        for l in residual:
+            state = ("peer dead — attributed" if not l["dst_alive"]
+                     else "peer alive — in-flight or LOSS")
+            out.append(f"  {l['src']} -> {l['dst']} [{l['class']}]: "
+                       f"sent {l['sent']:,} recv {l['recv']:,} "
+                       f"deficit {l['deficit']:,} ({state})")
+    out.append("")
+    out.append(f"[audit] violations={audit['violations']} "
+               f"unattributed_deficit={audit['unattributed_deficit']} "
+               f"attributed_deficit={audit['attributed_deficit']}")
+    return "\n".join(out)
+
+
+def run_audit(args, endpoints: dict) -> int:
+    """--audit driver: fetch + merge + render, once or on an interval.
+    Exit 0 (with --once) only when the mesh balances."""
+    while True:
+        ledgers = {n: fetch_ledger(ep) for n, ep in endpoints.items()}
+        if not any(ledgers.values()):
+            print("[cdn_top] no endpoint served /debug/ledger",
+                  file=sys.stderr)
+            return 1
+        audit = merge_audit(ledgers)
+        print(render_audit(audit))
+        if args.record:
+            with open(args.record, "a") as fh:
+                fh.write(json.dumps({"t": time.time(), "audit": audit})
+                         + "\n")
+        if args.once:
+            ok = (audit["violations"] == 0
+                  and audit["unattributed_deficit"] == 0)
+            return 0 if ok else 1
+        time.sleep(args.interval)
+
+
+# ---------------------------------------------------------------------------
 # bundle
 
 
@@ -528,6 +681,10 @@ def main() -> int:
                          "and on any /readyz failure in watch mode")
     ap.add_argument("--no-clear", action="store_true",
                     help="don't ANSI-clear between repaints (log-friendly)")
+    ap.add_argument("--audit", action="store_true",
+                    help="conservation audit: merge /debug/ledger across "
+                         "processes into one cluster balance sheet "
+                         "(--once exits 0 only when it balances)")
     args = ap.parse_args()
 
     endpoints = discover_endpoints(args)
@@ -536,6 +693,12 @@ def main() -> int:
         return 1
     print(f"[cdn_top] watching {len(endpoints)} endpoints: "
           f"{', '.join(sorted(endpoints))}", file=sys.stderr)
+
+    if args.audit:
+        try:
+            return run_audit(args, endpoints)
+        except KeyboardInterrupt:
+            return 0
 
     prev: dict = {}
     poll = 0

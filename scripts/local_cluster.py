@@ -342,6 +342,125 @@ def check_collector(metrics_ports: dict, broker_ports: dict,
     return True
 
 
+def _audit_once(metrics_ports: dict, logdir: str):
+    """One ``cdn_top --audit --once`` sweep against the brokers' ledger
+    endpoints. Returns ``(rc, output, summary)`` where ``summary`` is the
+    machine-readable ``[audit] violations=... unattributed_deficit=...
+    attributed_deficit=...`` verdict line."""
+    eps = ",".join(f"{n}=127.0.0.1:{p}" for n, p in metrics_ports.items())
+    cmd = [sys.executable, os.path.join(REPO, "scripts", "cdn_top.py"),
+           "--endpoints", eps, "--audit", "--once",
+           "--record", os.path.join(logdir, "audit_timeline.jsonl")]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=60)
+    except subprocess.TimeoutExpired:
+        return -1, "cdn_top --audit timed out", ""
+    summary = next((ln for ln in proc.stdout.splitlines()
+                    if ln.startswith("[audit]")), "")
+    return proc.returncode, proc.stdout + proc.stderr, summary
+
+
+def _audit_until_balanced(metrics_ports: dict, logdir: str, label: str,
+                          deadline_s: float = 30.0) -> bool:
+    """Re-run the mesh audit until it balances: decision-time link
+    counters legitimately lead the receiver's ingress count while frames
+    are in flight, so a clean balance is an eventually-quiescent property
+    — but one that MUST arrive within the deadline."""
+    deadline = time.time() + deadline_s
+    while True:
+        rc, out, summary = _audit_once(metrics_ports, logdir)
+        if rc == 0 and "violations=0" in summary \
+                and "unattributed_deficit=0" in summary:
+            print(f"[cluster] audit OK ({label}): {summary}")
+            return True
+        if time.time() >= deadline:
+            print(f"[cluster] FAIL: conservation audit ({label}) never "
+                  f"balanced (rc={rc}): {summary or '(no verdict line)'}\n"
+                  f"{out[-2000:]}")
+            return False
+        time.sleep(1.0)
+
+
+def check_audit(metrics_ports: dict, broker_ports: dict,
+                logdir: str) -> bool:
+    """``--audit`` clean leg: merge every broker's /debug/ledger into one
+    cluster balance sheet (scripts/cdn_top.py --audit --once) and require
+    zero conservation violations and zero unattributed mesh deficit —
+    every frame either reached a terminal fate or is visibly in flight."""
+    audit_ports = {k: v for k, v in metrics_ports.items()
+                   if k in broker_ports}   # only brokers serve ledgers
+    return _audit_until_balanced(audit_ports, logdir, "clean")
+
+
+def check_audit_chaos(procs, replace_proc, spawn_broker,
+                      metrics_ports: dict, broker_ports: dict,
+                      logdir: str) -> bool:
+    """``--audit`` chaos leg: SIGKILL broker1 mid-stream and prove the
+    balance sheet stays honest — every frame the survivor committed
+    toward the dead peer shows up as ATTRIBUTED deficit (charged to the
+    dead incarnation), never as silent unattributed loss; after the
+    respawn, the link-epoch reset returns the mesh to a clean balance."""
+    victim = "broker1"
+    audit_ports = {k: v for k, v in metrics_ports.items()
+                   if k in broker_ports and k != victim}
+    proc = _proc_of(procs, victim)
+    print(f"[cluster] audit chaos: SIGKILL {victim} mid-stream")
+    proc.kill()
+    proc.wait(timeout=10)
+
+    ok = True
+    # the survivor notices the dead link (EOF => failure-is-removal),
+    # drains its queue with counted drop fates, and the merged audit must
+    # balance with the dead peer's whole residual attributed to it
+    attributed = None
+    deadline = time.time() + 30.0
+    while True:
+        rc, out, summary = _audit_once(audit_ports, logdir)
+        m = re.search(r" attributed_deficit=(\d+)", summary)
+        if rc == 0 and "violations=0" in summary \
+                and "unattributed_deficit=0" in summary and m:
+            attributed = int(m.group(1))
+            break
+        if time.time() >= deadline:
+            print(f"[cluster] FAIL: post-kill audit never balanced "
+                  f"(rc={rc}): {summary or '(no verdict line)'}\n"
+                  f"{out[-2000:]}")
+            return False
+        time.sleep(1.0)
+    if attributed > 0:
+        print(f"[cluster] audit chaos: {attributed} undelivered frame(s) "
+              f"fully attributed to the dead {victim}")
+    else:
+        print(f"[cluster] FAIL: {victim}'s link carried no accounted "
+              "frames — the attribution leg proved nothing")
+        ok = False
+
+    # respawn the victim; the fresh incarnation reuses its canonical
+    # identity, so the re-formed link's epoch reset (plus the boot stamp
+    # in its first LedgerSync) must converge the mesh back to clean
+    replace_proc(victim, spawn_broker(int(victim[-1])))
+
+    def mesh_reformed() -> bool:
+        for port in broker_ports.values():
+            topo = fetch_topology(port)
+            if topo is None or topo.get("num_brokers", 0) != 1:
+                return False
+        return True
+
+    deadline = time.time() + 60.0
+    while time.time() < deadline and not mesh_reformed():
+        time.sleep(0.3)
+    if not mesh_reformed():
+        print(f"[cluster] FAIL: mesh never re-formed after the audit "
+              f"chaos {victim} kill")
+        return False
+    full_ports = {k: v for k, v in metrics_ports.items()
+                  if k in broker_ports}
+    ok = _audit_until_balanced(full_ports, logdir, "post-respawn") and ok
+    return ok
+
+
 def check_shard_plane(port: int, num_shards: int) -> bool:
     """Sharded broker0: the merged topology must show users spread across
     2+ worker shards and the handoff rings having carried records — the
@@ -1158,6 +1277,15 @@ def main() -> int:
                          "--bundle against the live cluster and verify "
                          "the pane, timeline, and postmortem bundle "
                          "(ISSUE 19)")
+    ap.add_argument("--audit", action="store_true",
+                    help="drive scripts/cdn_top.py --audit --once against "
+                         "the live mesh (ISSUE 20): clean leg requires "
+                         "zero conservation violations and zero "
+                         "unattributed deficit; a broker-SIGKILL chaos "
+                         "leg requires the dead peer's undelivered frames "
+                         "fully attributed, then a clean balance again "
+                         "after the respawn (forces the scalar data "
+                         "plane: PUSHCDN_PUMP=off)")
     ap.add_argument("--chaos", action="store_true",
                     help="scripted chaos events after the baseline checks: "
                          "broker SIGKILL (a shard-worker kill under "
@@ -1191,6 +1319,15 @@ def main() -> int:
     if args.pump:
         os.environ["PUSHCDN_PUMP"] = args.pump
         print(f"[cluster] pump: {args.pump}")
+
+    if args.audit:
+        # pumped frames move below the Python per-link tables (the C
+        # counters are fd-keyed, not peer-identity-resolvable yet), so
+        # the conservation audit legs pin the scalar data plane
+        os.environ["PUSHCDN_PUMP"] = "off"
+        if args.pump == "auto":
+            print("[cluster] --audit overrides --pump auto: per-link "
+                  "ledger tables are scalar-plane only")
 
     if args.trace_log:
         os.makedirs(args.trace_log, exist_ok=True)
@@ -1285,6 +1422,15 @@ def main() -> int:
             # client for the full 60 s reference TTL
             chaos_flags = ["--heartbeat-interval", "1",
                            "--membership-ttl", "5"]
+        audit_flags = []
+        if args.audit:
+            # fast anti-entropy so LedgerSync balance sheets (and, after
+            # the chaos-leg respawn, the fresh incarnation's boot epoch)
+            # propagate inside the audit deadlines; the SIGKILL leg also
+            # needs the dead broker aged out of placement quickly
+            audit_flags = ["--sync-interval", "2",
+                           "--heartbeat-interval", "1",
+                           "--membership-ttl", "5"]
         return spawn(
             "broker",
             "--discovery-endpoint", db,
@@ -1295,7 +1441,7 @@ def main() -> int:
             "--user-transport", "tcp",   # plain tcp for the local demo
             "--metrics-bind-endpoint",
             f"127.0.0.1:{metrics_ports[f'broker{i}']}",
-            *shard_flags, *chaos_flags,
+            *shard_flags, *chaos_flags, *audit_flags,
             *(["--device-plane"] if args.device_plane else []),
             env_extra=env,
             log_path=os.path.join(logdir, f"broker{i}.log"))
@@ -1403,6 +1549,11 @@ def main() -> int:
             # pump-stage-telemetry assertions
             ok = check_collector(metrics_ports, broker_ports, logdir) \
                 and ok
+        if args.audit:
+            # ---- conservation audit (ISSUE 20), clean leg: the live
+            # mesh must merge to zero violations and zero unattributed
+            # deficit in cdn_top --audit --once
+            ok = check_audit(metrics_ports, broker_ports, logdir) and ok
         if args.shards > 1:
             # ---- sharded data plane (ISSUE 6): users on 2+ workers and
             # cross-shard directs carried by the handoff rings
@@ -1430,6 +1581,14 @@ def main() -> int:
                                  e.strip() for e in
                                  args.chaos_events.split(",") if e.strip()
                              )) and ok
+        if args.audit:
+            # ---- conservation audit (ISSUE 20), chaos leg: SIGKILL
+            # broker1, require its undelivered frames fully attributed,
+            # respawn, require a clean balance again; runs after the
+            # other checks because it kills a process they assume stable
+            ok = check_audit_chaos(procs, replace_proc, spawn_broker,
+                                   metrics_ports, broker_ports, logdir) \
+                and ok
         # drain LAST: SIGINT broker1 and watch readiness flip before its
         # listeners close (the client may briefly reconnect after; every
         # earlier check has already run)

@@ -152,6 +152,36 @@ def test_local_cluster_collector():
                     reason="PUSHCDN_SKIP_CLUSTER_TEST=1")
 @pytest.mark.skipif(not _loopback_available(),
                     reason="no loopback TCP in this sandbox")
+def test_local_cluster_conservation_audit():
+    """ISSUE 20: the mesh-wide conservation audit against a REAL
+    cluster — ``--audit`` drives ``scripts/cdn_top.py --audit --once``
+    over both brokers' /debug/ledger endpoints. The clean leg must merge
+    to zero conservation violations and zero unattributed deficit; the
+    chaos leg SIGKILLs broker1 mid-stream and requires every frame the
+    survivor committed toward it to surface as ATTRIBUTED deficit (never
+    silent loss), then a clean balance again once the respawned
+    incarnation's fresh link epoch propagates."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--duration", "25", "--base-port", "0",
+         "--audit"],
+        env=env, capture_output=True, text=True, timeout=240)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"audit cluster failed:\n{out[-6000:]}"
+    assert "audit OK (clean): [audit] violations=0 " \
+           "unattributed_deficit=0" in out, out[-6000:]
+    assert "fully attributed to the dead broker1" in out, out[-6000:]
+    assert "audit OK (post-respawn): [audit] violations=0 " \
+           "unattributed_deficit=0 attributed_deficit=0" in out, out[-6000:]
+    assert "OK: end-to-end echo through real processes" in out, out[-6000:]
+    assert "FAIL" not in out, out[-6000:]
+
+
+@pytest.mark.skipif(os.environ.get("PUSHCDN_SKIP_CLUSTER_TEST") == "1",
+                    reason="PUSHCDN_SKIP_CLUSTER_TEST=1")
+@pytest.mark.skipif(not _loopback_available(),
+                    reason="no loopback TCP in this sandbox")
 def test_local_cluster_load_shed():
     """ISSUE 7: forced subscribe-rate overload against a REAL broker —
     the shed reaches the client as a typed Error (never a silent drop),
@@ -374,5 +404,6 @@ def test_swarm_soak_quick():
     assert proc.returncode == 0, f"swarm_bench failed:\n{out[-6000:]}"
     assert "rehome OK" in out, out[-6000:]
     assert "storm OK" in out, out[-6000:]
-    assert "loss check: gaps 0, reorders 0" in out, out[-6000:]
+    assert "loss check (live gap detector): open gaps 0" in out, out[-6000:]
+    assert "reorders 0" in out, out[-6000:]
     assert "[swarm] OK" in out, out[-6000:]

@@ -64,7 +64,7 @@ async def test_single_process_group_routes_and_directory(tmp_path):
         def __init__(self):
             self.streams = []
 
-        def send_encoded_nowait(self, data, owner=None):
+        def send_encoded_nowait(self, data, owner=None, cls=2, nframes=0):
             self.streams.append(bytes(data))
 
     class FakeConnections:

@@ -27,7 +27,10 @@ SCRIPT = os.path.join(REPO, "benches", "route_bench.py")
 def test_route_bench_smoke(tmp_path):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
-    out_json = str(tmp_path / "BENCH_smoke.json")
+    # the _r99 suffix pins the artifact's round stamp via the filename
+    # (the real producer path) — asserting the bare-name fallback
+    # constant went stale every PR round
+    out_json = str(tmp_path / "BENCH_r99.json")
     proc = subprocess.run(
         [sys.executable, SCRIPT, "--quick", "--churn-rows",
          "--out-json", out_json],
@@ -197,7 +200,7 @@ def test_route_bench_smoke(tmp_path):
     # with the headline block (the BENCH_r10.json producer)
     with open(out_json) as fh:
         doc = json.load(fh)
-    assert doc["round"] == 17
+    assert doc["round"] == 99
     assert "route_bench" in doc
     assert isinstance(doc["route_bench"]["rows"], list)
     assert "headline" in doc["route_bench"]
